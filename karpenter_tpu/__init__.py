@@ -1,0 +1,21 @@
+"""karpenter_tpu: a TPU-native Kubernetes node-provisioning autoscaler.
+
+Same capabilities as the reference Karpenter (watch unschedulable pods →
+evaluate constraints → bin-pack onto instance types → launch/bind →
+deprovision), with the scheduling hot loop formulated as a vectorized
+assignment problem solved with JAX/XLA on TPU.
+
+Layout:
+- api/            Provisioner CRD types + constraint algebra (host reference)
+- ops/            device kernels: encode/interning, feasibility, pack
+- models/         solver formulations (FFD-parity, cost-minimizing, consolidation)
+- parallel/       device mesh + pods-axis sharding (shard_map)
+- solver/         end-to-end solve orchestration + host oracle + C++ fallback
+- scheduling/     batcher, scheduler (constraint grouping), topology
+- controllers/    provisioning, selection, node, termination, counter, pvc, metrics
+- cloudprovider/  SPI + fake + aws providers
+- runtime/        in-memory kube API (envtest equivalent), manager, workqueue
+- utils/          quantities, predicates, injectable clock
+"""
+
+__version__ = "0.1.0"

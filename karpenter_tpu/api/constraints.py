@@ -1,0 +1,119 @@
+"""Constraints, Taints and Limits — the Provisioner's scheduling algebra.
+
+Reference: pkg/apis/provisioning/v1alpha5/{constraints.go,taints.go,limits.go}.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from karpenter_tpu.api.core import Pod, Taint, Toleration
+from karpenter_tpu.api.requirements import Requirements, pod_requirements
+from karpenter_tpu.utils.resources import ResourceList
+
+
+class SchedulingError(Exception):
+    """Pod requirements incompatible with constraints."""
+
+
+class Taints(list):
+    """Decorated list of Taint (taints.go:24-78)."""
+
+    def with_pod(self, pod: Pod) -> "Taints":
+        """Generate per-node taints matching pod tolerations (taints.go:27-53).
+        Only Equal tolerations generate taints; empty effect taints both
+        NoSchedule and NoExecute."""
+        ts = Taints(self)
+        for toleration in pod.spec.tolerations:
+            if toleration.operator != "Equal":
+                continue
+            if toleration.effect:
+                generated = [Taint(key=toleration.key, value=toleration.value, effect=toleration.effect)]
+            else:
+                generated = [
+                    Taint(key=toleration.key, value=toleration.value, effect="NoSchedule"),
+                    Taint(key=toleration.key, value=toleration.value, effect="NoExecute"),
+                ]
+            for taint in generated:
+                if not ts.has(taint):
+                    ts.append(taint)
+        return ts
+
+    def has(self, taint: Taint) -> bool:
+        """True if a taint with the same key+effect exists (taints.go:56-63)."""
+        return any(t.key == taint.key and t.effect == taint.effect for t in self)
+
+    def tolerates(self, pod: Pod) -> List[str]:
+        """Errors for every taint the pod does not tolerate (taints.go:66-78).
+        Empty list means tolerated."""
+        errs = []
+        for taint in self:
+            if not any(t.tolerates_taint(taint) for t in pod.spec.tolerations):
+                errs.append(f"did not tolerate {taint.key}={taint.value}:{taint.effect}")
+        return errs
+
+
+@dataclass
+class Limits:
+    """Resource ceilings per Provisioner (limits.go:23-41)."""
+
+    resources: Optional[ResourceList] = None
+
+    def exceeded_by(self, usage: ResourceList) -> Optional[str]:
+        if not self.resources:
+            return None
+        for name, used in usage.items():
+            limit = self.resources.get(name)
+            if limit is not None and used.cmp(limit) >= 0:
+                return f"{name} resource usage of {used} exceeds limit of {limit}"
+        return None
+
+
+@dataclass
+class KubeletConfiguration:
+    cluster_dns: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Constraints:
+    """Node constraints applied by a Provisioner (constraints.go:24-43)."""
+
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: Taints = field(default_factory=Taints)
+    requirements: Requirements = field(default_factory=Requirements)
+    kubelet_configuration: KubeletConfiguration = field(default_factory=KubeletConfiguration)
+    # Cloud-provider vendor block (spec.provider RawExtension equivalent):
+    # opaque to the core, round-tripped by the provider's codec.
+    provider: Optional[Dict[str, Any]] = None
+
+    def validate_pod(self, pod: Pod) -> Optional[str]:
+        """Error if pod requirements are unmet (constraints.go:46-66)."""
+        errs = self.taints.tolerates(pod)
+        if errs:
+            return errs[0]
+        podreqs = pod_requirements(pod)
+        for key in podreqs.keys():
+            own = self.requirements.requirement(key)
+            if own is None or len(own) == 0:
+                return f"invalid nodeSelector {key!r}, {sorted(podreqs.requirement(key) or [])} not in {sorted(own or [])}"
+        combined = self.requirements.add(*podreqs.items)
+        for key in podreqs.keys():
+            if len(combined.requirement(key) or ()) == 0:
+                return f"invalid nodeSelector {key!r}, {sorted(podreqs.requirement(key) or [])} not in {sorted(self.requirements.requirement(key) or [])}"
+        return None
+
+    def tighten(self, pod: Pod) -> "Constraints":
+        """Constraints ∧ pod requirements, consolidated, well-known-only
+        (constraints.go:68-76)."""
+        return Constraints(
+            labels=self.labels,
+            taints=self.taints,
+            requirements=self.requirements.add(*pod_requirements(pod).items).consolidate().well_known(),
+            kubelet_configuration=self.kubelet_configuration,
+            provider=self.provider,
+        )
+
+    def deepcopy(self) -> "Constraints":
+        return copy.deepcopy(self)

@@ -1,0 +1,49 @@
+"""The Provisioner custom resource.
+
+Reference: pkg/apis/provisioning/v1alpha5/{provisioner.go,provisioner_status.go}.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from karpenter_tpu.api.constraints import Constraints, Limits
+from karpenter_tpu.api.core import ObjectMeta
+from karpenter_tpu.utils.resources import ResourceList
+
+
+@dataclass
+class ProvisionerSpec:
+    constraints: Constraints = field(default_factory=Constraints)
+    # Seconds after a node is empty (only daemonset/static pods) before it is
+    # deleted; None disables emptiness deprovisioning (provisioner.go:36-41).
+    ttl_seconds_after_empty: Optional[int] = None
+    # Seconds after creation before a node is expired and recycled; None
+    # disables expiry (provisioner.go:43-50).
+    ttl_seconds_until_expired: Optional[int] = None
+    limits: Limits = field(default_factory=Limits)
+
+
+@dataclass
+class Condition:
+    type: str = ""
+    status: str = "Unknown"
+    reason: str = ""
+
+
+@dataclass
+class ProvisionerStatus:
+    last_scale_time: Optional[float] = None
+    conditions: List[Condition] = field(default_factory=list)
+    # Aggregated capacity of this provisioner's nodes, maintained by the
+    # counter controller and consumed by the limits check.
+    resources: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class Provisioner:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ProvisionerSpec = field(default_factory=ProvisionerSpec)
+    status: ProvisionerStatus = field(default_factory=ProvisionerStatus)
+    kind: str = "Provisioner"

@@ -1,0 +1,146 @@
+"""Requirements: the node-selector constraint algebra.
+
+Host reference implementation of the set semantics in
+pkg/apis/provisioning/v1alpha5/requirements.go. A requirement list evaluates,
+per key, to ``(∩ of all In sets) ∖ (∪ of all NotIn sets)``; ``None`` means
+"unconstrained". The vectorized (interned bitset) twin of this algebra is
+karpenter_tpu/ops/feasibility.py, property-tested against this module; any
+semantic change here must be mirrored there.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterable, List, Optional
+
+from karpenter_tpu.api.core import NodeSelectorRequirement, Pod
+from karpenter_tpu.api import wellknown
+
+IN = "In"
+NOT_IN = "NotIn"
+
+
+class Requirements:
+    """Decorated list of NodeSelectorRequirements (requirements.go:73-74)."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items: Optional[Iterable[NodeSelectorRequirement]] = None):
+        self.items: List[NodeSelectorRequirement] = list(items or [])
+
+    # -- construction -------------------------------------------------------
+    def add(self, *reqs: NodeSelectorRequirement) -> "Requirements":
+        """Append normalized requirements, returning a new list
+        (requirements.go:96-98)."""
+        return Requirements(self.items + Requirements(reqs).normalize().items)
+
+    def normalize(self) -> "Requirements":
+        """Translate aliased label keys to well-known ones
+        (requirements.go:101-111)."""
+        out = []
+        for r in self.items:
+            key = wellknown.NORMALIZED_LABELS.get(r.key, r.key)
+            out.append(NodeSelectorRequirement(key=key, operator=r.operator, values=list(r.values)))
+        return Requirements(out)
+
+    def consolidate(self) -> "Requirements":
+        """Collapse to one In requirement per key (requirements.go:119-128).
+        A NotIn with no In collapses to [] permanently — quirk preserved."""
+        out = Requirements()
+        for key in self.keys():
+            out = out.add(NodeSelectorRequirement(
+                key=key, operator=IN, values=sorted(self.requirement(key) or set())))
+        return out
+
+    def well_known(self) -> "Requirements":
+        """Keep only well-known keys (requirements.go:157-164)."""
+        out = Requirements()
+        for r in self.items:
+            if r.key in wellknown.WELL_KNOWN_LABELS:
+                out = out.add(r)
+        return out
+
+    # -- evaluation ---------------------------------------------------------
+    def keys(self) -> List[str]:
+        seen = []
+        for r in self.items:
+            if r.key not in seen:
+                seen.append(r.key)
+        return seen
+
+    def requirement(self, key: str) -> Optional[FrozenSet[str]]:
+        """Allowed values for key: (∩ In) ∖ (∪ NotIn); None if unconstrained
+        (requirements.go:176-195)."""
+        result: Optional[set] = None
+        for r in self.items:
+            if r.key == key and r.operator == IN:
+                vals = set(r.values)
+                result = vals if result is None else (result & vals)
+        for r in self.items:
+            if r.key == key and r.operator == NOT_IN:
+                # Go quirk: nil.Difference(x) returns a non-nil empty set, so
+                # a NotIn with no In collapses to "nothing allowed", not
+                # "unconstrained" (requirements.go:189-194).
+                result = (result or set()) - set(r.values)
+        return frozenset(result) if result is not None else None
+
+    # -- well-known accessors (requirements.go:76-94) -----------------------
+    def zones(self) -> Optional[FrozenSet[str]]:
+        return self.requirement(wellknown.LABEL_TOPOLOGY_ZONE)
+
+    def instance_types(self) -> Optional[FrozenSet[str]]:
+        return self.requirement(wellknown.LABEL_INSTANCE_TYPE)
+
+    def architectures(self) -> Optional[FrozenSet[str]]:
+        return self.requirement(wellknown.LABEL_ARCH)
+
+    def operating_systems(self) -> Optional[FrozenSet[str]]:
+        return self.requirement(wellknown.LABEL_OS)
+
+    def capacity_types(self) -> Optional[FrozenSet[str]]:
+        return self.requirement(wellknown.LABEL_CAPACITY_TYPE)
+
+    def __iter__(self):
+        return iter(self.items)
+
+    def __len__(self):
+        return len(self.items)
+
+    def __repr__(self):
+        return f"Requirements({[(r.key, r.operator, r.values) for r in self.items]})"
+
+
+def has_value(s: Optional[FrozenSet[str]], value: str) -> bool:
+    """Membership against a possibly-unconstrained (None) requirement set.
+
+    Go's sets.String.Has(nil) is false; callers in the reference always
+    materialize the full universe before querying, so None here means
+    "no constraint" only at sites that treat it so explicitly. We keep the
+    strict Go behavior: None → False.
+    """
+    return s is not None and value in s
+
+
+def label_requirements(labels: Dict[str, str]) -> Requirements:
+    """Labels as In requirements (requirements.go:130-135)."""
+    r = Requirements()
+    for key, value in labels.items():
+        r = r.add(NodeSelectorRequirement(key=key, operator=IN, values=[value]))
+    return r
+
+
+def pod_requirements(pod: Pod) -> Requirements:
+    """Extract scheduling requirements from a pod (requirements.go:137-155):
+    nodeSelector + heaviest preferred term + first required term."""
+    r = Requirements()
+    for key, value in pod.spec.node_selector.items():
+        r = r.add(NodeSelectorRequirement(key=key, operator=IN, values=[value]))
+    affinity = pod.spec.affinity
+    if affinity is None or affinity.node_affinity is None:
+        return r
+    na = affinity.node_affinity
+    if na.preferred:
+        heaviest = max(na.preferred, key=lambda t: t.weight)
+        r = r.add(*heaviest.preference.match_expressions)
+    if na.required:
+        r = r.add(*na.required[0].match_expressions)
+    return r

@@ -1,0 +1,57 @@
+"""Well-known labels, annotations and domains.
+
+Reference: pkg/apis/provisioning/v1alpha5/{requirements.go:24-71,register.go:43-47}.
+"""
+
+from __future__ import annotations
+
+# k8s node labels
+LABEL_TOPOLOGY_ZONE = "topology.kubernetes.io/zone"
+LABEL_INSTANCE_TYPE = "node.kubernetes.io/instance-type"
+LABEL_ARCH = "kubernetes.io/arch"
+LABEL_OS = "kubernetes.io/os"
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+
+# legacy/beta aliases
+LABEL_FAILURE_DOMAIN_BETA_ZONE = "failure-domain.beta.kubernetes.io/zone"
+LABEL_BETA_ARCH = "beta.kubernetes.io/arch"
+LABEL_BETA_OS = "beta.kubernetes.io/os"
+LABEL_BETA_INSTANCE_TYPE = "beta.kubernetes.io/instance-type"
+
+ARCHITECTURE_AMD64 = "amd64"
+ARCHITECTURE_ARM64 = "arm64"
+OPERATING_SYSTEM_LINUX = "linux"
+
+# karpenter domain (register.go:43-47)
+KARPENTER_DOMAIN = "karpenter.sh"
+PROVISIONER_NAME_LABEL = KARPENTER_DOMAIN + "/provisioner-name"
+NOT_READY_TAINT_KEY = KARPENTER_DOMAIN + "/not-ready"
+DO_NOT_EVICT_ANNOTATION = KARPENTER_DOMAIN + "/do-not-evict"
+EMPTINESS_TIMESTAMP_ANNOTATION = KARPENTER_DOMAIN + "/emptiness-timestamp"
+TERMINATION_FINALIZER = KARPENTER_DOMAIN + "/termination"
+LABEL_CAPACITY_TYPE = KARPENTER_DOMAIN + "/capacity-type"
+
+CAPACITY_TYPE_SPOT = "spot"
+CAPACITY_TYPE_ON_DEMAND = "on-demand"
+
+WELL_KNOWN_LABELS = frozenset({
+    LABEL_TOPOLOGY_ZONE,
+    LABEL_INSTANCE_TYPE,
+    LABEL_ARCH,
+    LABEL_OS,
+    LABEL_CAPACITY_TYPE,
+    LABEL_HOSTNAME,  # used internally for hostname topology spread
+})
+
+# NormalizedLabels (requirements.go:65-70): aliased concepts → well-known
+NORMALIZED_LABELS = {
+    LABEL_FAILURE_DOMAIN_BETA_ZONE: LABEL_TOPOLOGY_ZONE,
+    LABEL_BETA_ARCH: LABEL_ARCH,
+    LABEL_BETA_OS: LABEL_OS,
+    LABEL_BETA_INSTANCE_TYPE: LABEL_INSTANCE_TYPE,
+}
+
+# Restricted label machinery (requirements.go:29-50)
+RESTRICTED_LABELS = frozenset({EMPTINESS_TIMESTAMP_ANNOTATION, LABEL_HOSTNAME})
+ALLOWED_LABEL_DOMAINS = frozenset({"kops.k8s.io"})
+RESTRICTED_LABEL_DOMAINS = frozenset({"kubernetes.io", "k8s.io", KARPENTER_DOMAIN})

@@ -1,0 +1,23 @@
+"""Test configuration: force a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; all sharding tests run on
+XLA's host platform with 8 virtual devices. This must run before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_clock():
+    from karpenter_tpu.utils import clock
+
+    clock.DEFAULT.reset()
+    yield
+    clock.DEFAULT.reset()

@@ -1,0 +1,149 @@
+"""Requirements algebra + constraints parity with v1alpha5 semantics."""
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.constraints import Constraints, Limits, Taints
+from karpenter_tpu.api.core import (
+    Affinity, Container, NodeAffinity, NodeSelectorRequirement as Req,
+    NodeSelectorTerm, Pod, PodSpec, PreferredSchedulingTerm, ResourceRequirements,
+    Taint, Toleration,
+)
+from karpenter_tpu.api.requirements import Requirements, pod_requirements
+from karpenter_tpu.utils.resources import parse_resource_list
+
+
+def make_pod(node_selector=None, tolerations=None, requests=None, preferred=None, required=None):
+    affinity = None
+    if preferred or required:
+        affinity = Affinity(node_affinity=NodeAffinity(
+            required=required,
+            preferred=preferred or [],
+        ))
+    return Pod(spec=PodSpec(
+        node_selector=node_selector or {},
+        tolerations=tolerations or [],
+        affinity=affinity,
+        containers=[Container(resources=ResourceRequirements.make(requests=requests or {"cpu": "1"}))],
+    ))
+
+
+class TestRequirements:
+    def test_in_intersection(self):
+        r = Requirements().add(
+            Req(key="k", operator="In", values=["a", "b"]),
+            Req(key="k", operator="In", values=["b", "c"]),
+        )
+        assert r.requirement("k") == {"b"}
+
+    def test_notin_difference(self):
+        r = Requirements().add(
+            Req(key="k", operator="In", values=["a", "b", "c"]),
+            Req(key="k", operator="NotIn", values=["b"]),
+        )
+        assert r.requirement("k") == {"a", "c"}
+
+    def test_unconstrained_is_none(self):
+        assert Requirements().requirement("missing") is None
+
+    def test_normalize_aliases(self):
+        r = Requirements().add(Req(key="beta.kubernetes.io/arch", operator="In", values=["amd64"]))
+        assert r.architectures() == {"amd64"}
+
+    def test_consolidate(self):
+        r = Requirements().add(
+            Req(key="k", operator="In", values=["a", "b"]),
+            Req(key="k", operator="NotIn", values=["a"]),
+        ).consolidate()
+        assert len(r.items) == 1
+        assert r.items[0].operator == "In"
+        assert set(r.items[0].values) == {"b"}
+
+    def test_well_known_filters(self):
+        r = Requirements().add(
+            Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In", values=["z1"]),
+            Req(key="custom/label", operator="In", values=["v"]),
+        ).well_known()
+        assert r.keys() == [wellknown.LABEL_TOPOLOGY_ZONE]
+
+    def test_pod_requirements_heaviest_preferred_and_first_required(self):
+        pod = make_pod(
+            node_selector={"ns": "v"},
+            preferred=[
+                PreferredSchedulingTerm(weight=1, preference=NodeSelectorTerm(
+                    match_expressions=[Req(key="light", operator="In", values=["x"])])),
+                PreferredSchedulingTerm(weight=10, preference=NodeSelectorTerm(
+                    match_expressions=[Req(key="heavy", operator="In", values=["y"])])),
+            ],
+            required=[
+                NodeSelectorTerm(match_expressions=[Req(key="req1", operator="In", values=["a"])]),
+                NodeSelectorTerm(match_expressions=[Req(key="req2", operator="In", values=["b"])]),
+            ],
+        )
+        r = pod_requirements(pod)
+        keys = set(r.keys())
+        assert "ns" in keys and "heavy" in keys and "req1" in keys
+        assert "light" not in keys and "req2" not in keys
+
+
+class TestTaints:
+    def test_tolerates(self):
+        ts = Taints([Taint(key="team", value="a", effect="NoSchedule")])
+        ok = make_pod(tolerations=[Toleration(key="team", operator="Equal", value="a", effect="NoSchedule")])
+        bad = make_pod()
+        assert ts.tolerates(ok) == []
+        assert ts.tolerates(bad) != []
+
+    def test_exists_toleration(self):
+        ts = Taints([Taint(key="team", value="a", effect="NoSchedule")])
+        pod = make_pod(tolerations=[Toleration(key="team", operator="Exists")])
+        assert ts.tolerates(pod) == []
+
+    def test_with_pod_generates_both_effects(self):
+        ts = Taints().with_pod(make_pod(tolerations=[Toleration(key="k", operator="Equal", value="v")]))
+        assert len(ts) == 2
+        assert {t.effect for t in ts} == {"NoSchedule", "NoExecute"}
+
+    def test_with_pod_ignores_exists(self):
+        ts = Taints().with_pod(make_pod(tolerations=[Toleration(key="k", operator="Exists")]))
+        assert len(ts) == 0
+
+
+class TestConstraints:
+    def make_constraints(self):
+        return Constraints(requirements=Requirements().add(
+            Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In", values=["z1", "z2"]),
+            Req(key=wellknown.LABEL_ARCH, operator="In", values=["amd64"]),
+        ))
+
+    def test_validate_pod_ok(self):
+        c = self.make_constraints()
+        assert c.validate_pod(make_pod(node_selector={wellknown.LABEL_TOPOLOGY_ZONE: "z1"})) is None
+
+    def test_validate_pod_unknown_key(self):
+        c = self.make_constraints()
+        assert c.validate_pod(make_pod(node_selector={"unknown": "v"})) is not None
+
+    def test_validate_pod_incompatible_value(self):
+        c = self.make_constraints()
+        assert c.validate_pod(make_pod(node_selector={wellknown.LABEL_TOPOLOGY_ZONE: "z9"})) is not None
+
+    def test_validate_pod_taints(self):
+        c = self.make_constraints()
+        c.taints = Taints([Taint(key="t", value="v", effect="NoSchedule")])
+        assert c.validate_pod(make_pod()) is not None
+
+    def test_tighten(self):
+        c = self.make_constraints()
+        t = c.tighten(make_pod(node_selector={wellknown.LABEL_TOPOLOGY_ZONE: "z1", "custom": "x"}))
+        assert t.requirements.zones() == {"z1"}
+        # non-well-known keys are dropped
+        assert t.requirements.requirement("custom") is None
+
+
+class TestLimits:
+    def test_no_limits(self):
+        assert Limits().exceeded_by(parse_resource_list({"cpu": "100"})) is None
+
+    def test_exceeded(self):
+        l = Limits(resources=parse_resource_list({"cpu": "10"}))
+        assert l.exceeded_by(parse_resource_list({"cpu": "10"})) is not None
+        assert l.exceeded_by(parse_resource_list({"cpu": "9"})) is None

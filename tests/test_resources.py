@@ -1,0 +1,64 @@
+"""Quantity parsing/arithmetic parity with k8s resource.Quantity."""
+
+from karpenter_tpu.utils.resources import (
+    Quantity, merge, parse_resource_list, requests_for_pods,
+)
+from karpenter_tpu.api.core import Container, Pod, PodSpec, ResourceRequirements
+
+
+def q(s):
+    return Quantity.parse(s)
+
+
+def test_parse_milli():
+    assert q("100m").milli_value() == 100
+    assert q("1").milli_value() == 1000
+    assert q("1.5").milli_value() == 1500
+    assert q("2500m").value() == 3  # rounds up like k8s Value()
+
+
+def test_parse_binary():
+    assert q("1Ki").value() == 1024
+    assert q("512Mi").value() == 512 * 1024**2
+    assert q("2Gi").value() == 2 * 1024**3
+    assert q("1.5Gi").value() == 3 * 1024**3 // 2
+
+
+def test_parse_decimal_suffix():
+    assert q("1k").value() == 1000
+    assert q("2G").value() == 2 * 10**9
+    assert q("1e3").value() == 1000
+
+
+def test_cmp_add():
+    assert q("1").cmp(q("1000m")) == 0
+    assert q("1100m").cmp(q("1")) == 1
+    assert q("900m").cmp(q("1")) == -1
+    assert q("1").add(q("500m")).milli_value() == 1500
+    assert q("0").is_zero()
+
+
+def test_ordering_hash():
+    assert q("1") == q("1000m")
+    assert hash(q("1")) == hash(q("1000m"))
+    assert q("1") < q("2")
+    assert sorted([q("3"), q("1"), q("2")]) == [q("1"), q("2"), q("3")]
+
+
+def test_merge():
+    a = parse_resource_list({"cpu": "1", "memory": "1Gi"})
+    b = parse_resource_list({"cpu": "500m", "pods": "1"})
+    m = merge(a, b)
+    assert m["cpu"].milli_value() == 1500
+    assert m["memory"].value() == 1024**3
+    assert m["pods"].value() == 1
+
+
+def test_requests_for_pods():
+    pod = Pod(spec=PodSpec(containers=[
+        Container(resources=ResourceRequirements.make(requests={"cpu": "1", "memory": "1Gi"})),
+        Container(resources=ResourceRequirements.make(requests={"cpu": "250m"})),
+    ]))
+    r = requests_for_pods(pod)
+    assert r["cpu"].milli_value() == 1250
+    assert r["memory"].value() == 1024**3
