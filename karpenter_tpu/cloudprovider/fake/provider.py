@@ -1,0 +1,159 @@
+"""Fake cloud provider: in-memory capacity substrate for tests.
+
+Reference: pkg/cloudprovider/fake/{cloudprovider.go,instancetype.go}. Nodes
+are fabricated as API objects honoring zone/capacity-type requirements; the
+synthetic catalog generator matches the reference fixture exactly (i-th type
+= (i+1) vCPU, 2(i+1) Gi, 10(i+1) pods) so benchmark workloads are comparable.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import List, Optional, Sequence
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.api.core import Node, NodeSpec, NodeStatus, ObjectMeta
+from karpenter_tpu.cloudprovider import spi
+from karpenter_tpu.cloudprovider.spi import CloudProvider, InstanceType, Offering
+from karpenter_tpu.utils.resources import Quantity, parse_resource_list
+
+_DEFAULT_OFFERINGS = [
+    Offering("spot", "test-zone-1"),
+    Offering("spot", "test-zone-2"),
+    Offering("on-demand", "test-zone-1"),
+    Offering("on-demand", "test-zone-2"),
+    Offering("on-demand", "test-zone-3"),
+]
+
+_name_counter = itertools.count()
+
+
+def make_instance_type(
+    name: str,
+    offerings: Optional[List[Offering]] = None,
+    architecture: str = "amd64",
+    operating_systems: frozenset = frozenset({"linux", "windows", "darwin"}),
+    cpu: str = "4",
+    memory: str = "4Gi",
+    pods: str = "5",
+    nvidia_gpus: str = "0",
+    amd_gpus: str = "0",
+    aws_neurons: str = "0",
+    aws_pod_eni: str = "0",
+    price: float = 0.0,
+) -> InstanceType:
+    """fake.NewInstanceType defaults (instancetype.go:27-52)."""
+    return InstanceType(
+        name=name,
+        offerings=list(offerings) if offerings else list(_DEFAULT_OFFERINGS),
+        architecture=architecture,
+        operating_systems=operating_systems,
+        cpu=Quantity.parse(cpu),
+        memory=Quantity.parse(memory),
+        pods=Quantity.parse(pods),
+        nvidia_gpus=Quantity.parse(nvidia_gpus),
+        amd_gpus=Quantity.parse(amd_gpus),
+        aws_neurons=Quantity.parse(aws_neurons),
+        aws_pod_eni=Quantity.parse(aws_pod_eni),
+        overhead=parse_resource_list({"cpu": "100m", "memory": "10Mi"}),
+        price=price,
+    )
+
+
+def instance_types(total: int) -> List[InstanceType]:
+    """Synthetic incrementing catalog (instancetype.go:73-84): i-th type =
+    (i+1) vCPU, 2(i+1) Gi, 10(i+1) pods."""
+    return [
+        make_instance_type(
+            name=f"fake-it-{i}",
+            cpu=str(i + 1),
+            memory=f"{(i + 1) * 2}Gi",
+            pods=str((i + 1) * 10),
+        )
+        for i in range(total)
+    ]
+
+
+def default_catalog() -> List[InstanceType]:
+    """The 7-type default catalog (fake/cloudprovider.go:85-115)."""
+    return [
+        make_instance_type("default-instance-type"),
+        make_instance_type("pod-eni-instance-type", aws_pod_eni="1"),
+        make_instance_type("small-instance-type", cpu="2", memory="2Gi"),
+        make_instance_type("nvidia-gpu-instance-type", nvidia_gpus="2"),
+        make_instance_type("amd-gpu-instance-type", amd_gpus="2"),
+        make_instance_type("aws-neuron-instance-type", aws_neurons="2"),
+        make_instance_type("arm-instance-type", architecture="arm64"),
+    ]
+
+
+class FakeCloudProvider(CloudProvider):
+    """In-memory provider fabricating Node objects (fake/cloudprovider.go:37-79)."""
+
+    def __init__(self, catalog: Optional[Sequence[InstanceType]] = None):
+        self.catalog = list(catalog) if catalog is not None else None
+        self.created: List[Node] = []
+        self.deleted: List[str] = []
+        # fault injection: zero-capacity (name, zone, capacity_type) triples,
+        # analog of the AWS fake's InsufficientCapacityPools
+        self.insufficient_capacity: set = set()
+        self._lock = threading.Lock()
+
+    def create(self, constraints, instance_types_, quantity, bind):
+        errs: List[Optional[str]] = []
+        for _ in range(quantity):
+            n = next(_name_counter)
+            name = f"fake-node-{n}"
+            instance = instance_types_[0]
+            zone = capacity_type = ""
+            cts = constraints.requirements.capacity_types() or frozenset()
+            zones = constraints.requirements.zones() or frozenset()
+            for o in instance.offerings:
+                if o.capacity_type in cts and o.zone in zones:
+                    zone, capacity_type = o.zone, o.capacity_type
+                    break
+            if (instance.name, zone, capacity_type) in self.insufficient_capacity:
+                errs.append(f"insufficient capacity for {instance.name} in {zone}")
+                continue
+            node = Node(
+                metadata=ObjectMeta(
+                    name=name,
+                    namespace="",
+                    labels={
+                        wellknown.LABEL_TOPOLOGY_ZONE: zone,
+                        wellknown.LABEL_INSTANCE_TYPE: instance.name,
+                        wellknown.LABEL_CAPACITY_TYPE: capacity_type,
+                    },
+                ),
+                spec=NodeSpec(provider_id=f"fake:///{name}/{zone}"),
+                status=NodeStatus(
+                    capacity=parse_resource_list({
+                        "pods": str(instance.pods), "cpu": str(instance.cpu),
+                        "memory": str(instance.memory)}),
+                    allocatable=parse_resource_list({
+                        "pods": str(instance.pods), "cpu": str(instance.cpu),
+                        "memory": str(instance.memory)}),
+                ),
+            )
+            with self._lock:
+                self.created.append(node)
+            errs.append(bind(node))
+        return errs
+
+    def delete(self, node: Node) -> Optional[str]:
+        with self._lock:
+            self.deleted.append(node.metadata.name)
+        return None
+
+    def get_instance_types(self, constraints: Constraints) -> List[InstanceType]:
+        if self.catalog is not None:
+            return list(self.catalog)
+        return default_catalog()
+
+    def name(self) -> str:
+        return "fake"
+
+
+spi.register("fake", FakeCloudProvider)

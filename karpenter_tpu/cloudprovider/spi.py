@@ -1,0 +1,110 @@
+"""CloudProvider SPI — the plugin boundary.
+
+Preserves the reference's provider contract (pkg/cloudprovider/types.go:29-76)
+so provider implementations are interchangeable: Create is callback-based to
+let providers batch node launches; GetInstanceTypes returns the live catalog
+filtered by constraints; Default/Validate hook into admission.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.api.core import Node
+from karpenter_tpu.utils.resources import Quantity, ResourceList
+
+
+@dataclass(frozen=True)
+class Offering:
+    """A (capacity type, zone) pair an instance type is available in
+    (types.go:73-76)."""
+
+    capacity_type: str  # "spot" | "on-demand"
+    zone: str
+
+
+@dataclass
+class InstanceType:
+    """Concrete instance type description (types.go:55-69).
+
+    The reference models this as an interface over provider data; here it is
+    a value type every provider materializes. ``price`` is an extension used
+    by the cost-minimizing solver model (absent in the reference, which
+    delegates price decisions to EC2 Fleet).
+    """
+
+    name: str
+    offerings: List[Offering] = field(default_factory=list)
+    architecture: str = "amd64"
+    operating_systems: frozenset = frozenset({"linux"})
+    cpu: Quantity = field(default_factory=lambda: Quantity(0))
+    memory: Quantity = field(default_factory=lambda: Quantity(0))
+    pods: Quantity = field(default_factory=lambda: Quantity(0))
+    nvidia_gpus: Quantity = field(default_factory=lambda: Quantity(0))
+    amd_gpus: Quantity = field(default_factory=lambda: Quantity(0))
+    aws_neurons: Quantity = field(default_factory=lambda: Quantity(0))
+    aws_pod_eni: Quantity = field(default_factory=lambda: Quantity(0))
+    overhead: ResourceList = field(default_factory=dict)
+    price: float = 0.0
+
+
+BindCallback = Callable[[Node], Optional[str]]
+
+
+class CloudProvider(abc.ABC):
+    """Provider contract (types.go:29-46)."""
+
+    @abc.abstractmethod
+    def create(
+        self,
+        constraints: Constraints,
+        instance_types: Sequence[InstanceType],
+        quantity: int,
+        bind: BindCallback,
+    ) -> List[Optional[str]]:
+        """Launch ``quantity`` nodes drawn from ``instance_types`` and invoke
+        ``bind`` for each created node. Returns per-node errors (None=ok)."""
+
+    @abc.abstractmethod
+    def delete(self, node: Node) -> Optional[str]:
+        """Terminate the capacity backing ``node``."""
+
+    @abc.abstractmethod
+    def get_instance_types(self, constraints: Constraints) -> List[InstanceType]:
+        """The catalog viable for these constraints (cached by providers)."""
+
+    def default(self, constraints: Constraints) -> None:
+        """Defaulting webhook hook (registry/register.go:25-31)."""
+
+    def validate(self, constraints: Constraints) -> Optional[str]:
+        """Validation webhook hook; None means valid."""
+
+    @abc.abstractmethod
+    def name(self) -> str:
+        ...
+
+
+# ---------------------------------------------------------------------------
+# Registry: runtime provider selection. The reference selects at compile time
+# via build tags (registry/aws.go); a Python framework selects by name with
+# the fake provider as the default fallback (registry/fake.go).
+# ---------------------------------------------------------------------------
+
+_REGISTRY = {}
+
+
+def register(name: str, factory) -> None:
+    _REGISTRY[name] = factory
+
+
+def resolve(name: str, **kwargs) -> CloudProvider:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown cloud provider {name!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+def registered() -> List[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,260 @@
+"""Provisioning controller + per-Provisioner worker.
+
+Reference: pkg/controllers/provisioning/{controller.go,provisioner.go}.
+- The controller reconciles Provisioner CRs into in-memory workers (one
+  thread each, the Go goroutine analog), refreshes global requirements from
+  the live instance-type catalog, and restarts workers on spec change.
+- The worker owns the hot loop: batch → filter → schedule → TPU solve →
+  launch → bind.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.api.core import Node, NodeSelectorRequirement as Req, Pod, Taint
+from karpenter_tpu.api.provisioner import Provisioner
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.cloudprovider.spi import CloudProvider, InstanceType
+from karpenter_tpu.metrics.registry import HISTOGRAMS
+from karpenter_tpu.runtime.kubecore import AlreadyExists, Conflict, KubeCore, NotFound
+from karpenter_tpu.scheduling.batcher import Batcher
+from karpenter_tpu.scheduling.scheduler import Scheduler
+from karpenter_tpu.solver.solve import SolveResult, SolverConfig, solve
+from karpenter_tpu.utils import pod as podutil
+
+log = logging.getLogger("karpenter.provisioning")
+
+
+def global_requirements(instance_types: List[InstanceType]) -> Requirements:
+    """Inject supported zones/types/arch/OS/capacity-types as requirements
+    (controller.go:141-162): the 'universe' that makes unconstrained keys
+    concrete before they reach the solver."""
+    zones, names, archs, oss, cts = set(), set(), set(), set(), set()
+    for it in instance_types:
+        names.add(it.name)
+        archs.add(it.architecture)
+        oss |= set(it.operating_systems)
+        for o in it.offerings:
+            zones.add(o.zone)
+            cts.add(o.capacity_type)
+    return Requirements().add(
+        Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In", values=sorted(zones)),
+        Req(key=wellknown.LABEL_INSTANCE_TYPE, operator="In", values=sorted(names)),
+        Req(key=wellknown.LABEL_ARCH, operator="In", values=sorted(archs)),
+        Req(key=wellknown.LABEL_OS, operator="In", values=sorted(oss)),
+        Req(key=wellknown.LABEL_CAPACITY_TYPE, operator="In", values=sorted(cts)),
+    )
+
+
+class ProvisionerWorker:
+    """One worker per Provisioner CR (provisioner.go:41-76)."""
+
+    def __init__(
+        self,
+        provisioner: Provisioner,
+        kube: KubeCore,
+        cloud_provider: CloudProvider,
+        solver_config: Optional[SolverConfig] = None,
+        batcher: Optional[Batcher] = None,
+    ):
+        self.provisioner = provisioner
+        self.kube = kube
+        self.cloud_provider = cloud_provider
+        self.solver_config = solver_config or SolverConfig()
+        self.batcher = batcher or Batcher()
+        self.scheduler = Scheduler(kube)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"provisioner-{self.provisioner.metadata.name}",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.batcher.stop()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.provision()
+            except Exception:
+                log.exception("provisioning failed")
+
+    # -- API for the selection controller -----------------------------------
+    def add(self, pod: Pod) -> threading.Event:
+        """Enqueue a pod; returns the gate to block on (provisioner.go:80-82)."""
+        return self.batcher.add(pod)
+
+    # -- the hot loop (provisioner.go:84-120) --------------------------------
+    def provision(self) -> Optional[SolveResult]:
+        items, window = self.batcher.wait()
+        try:
+            if not items or self._stop.is_set():
+                return None
+            log.info("batched %d pods in %.2fs", len(items), window)
+            pods = [p for p in items if self._is_provisionable(p)]
+            with HISTOGRAMS.time("scheduling_duration_seconds",
+                                 provisioner=self.provisioner.metadata.name):
+                schedules = self.scheduler.solve(self.provisioner, pods)
+            last_result = None
+            for schedule in schedules:
+                with HISTOGRAMS.time("binpacking_duration_seconds",
+                                     provisioner=self.provisioner.metadata.name):
+                    result = solve(
+                        schedule.constraints,
+                        schedule.pods,
+                        self.cloud_provider.get_instance_types(schedule.constraints),
+                        daemons=self._get_daemons(schedule.constraints),
+                        config=self.solver_config,
+                    )
+                last_result = result
+                for packing in result.packings:
+                    err = self._launch(schedule.constraints, packing)
+                    if err is not None:
+                        log.error("could not launch node: %s", err)
+            return last_result
+        finally:
+            self.batcher.flush()
+
+    def _is_provisionable(self, candidate: Pod) -> bool:
+        """Re-GET each pod to avoid duplicate binds (provisioner.go:126-135)."""
+        try:
+            stored = self.kube.get("Pod", candidate.metadata.name,
+                                   candidate.metadata.namespace)
+        except NotFound:
+            return False
+        return not podutil.is_scheduled(stored)
+
+    def _get_daemons(self, constraints: Constraints) -> List[Pod]:
+        """Daemonset pods that would schedule on these nodes (packer.go:148-162)."""
+        daemons = []
+        for ds in self.kube.list("DaemonSet"):
+            pod = Pod(spec=ds.spec.template.spec)
+            if constraints.validate_pod(pod) is None:
+                daemons.append(pod)
+        return daemons
+
+    def _launch(self, constraints: Constraints, packing) -> Optional[str]:
+        """Limits check + CloudProvider.Create with bind callback
+        (provisioner.go:137-157)."""
+        try:
+            latest = self.kube.get("Provisioner", self.provisioner.metadata.name)
+        except NotFound:
+            return "provisioner deleted"
+        err = self.provisioner.spec.limits.exceeded_by(latest.status.resources)
+        if err is not None:
+            return err
+        pods_per_node = list(packing.pods)
+
+        def bind(node: Node) -> Optional[str]:
+            node.metadata.labels.update(constraints.labels)
+            node.spec.taints.extend(constraints.taints)
+            return self._bind(node, pods_per_node.pop(0) if pods_per_node else [])
+
+        errs = self.cloud_provider.create(
+            constraints, packing.instance_type_options, packing.node_quantity, bind)
+        errs = [e for e in errs if e]
+        return "; ".join(errs) if errs else None
+
+    def _bind(self, node: Node, pods: List[Pod]) -> Optional[str]:
+        """Create the node object (finalizer + not-ready taint) and bind pods
+        (provisioner.go:159-198)."""
+        with HISTOGRAMS.time("bind_duration_seconds",
+                             provisioner=self.provisioner.metadata.name):
+            node.metadata.namespace = ""
+            node.metadata.finalizers.append(wellknown.TERMINATION_FINALIZER)
+            node.metadata.labels.setdefault(
+                wellknown.PROVISIONER_NAME_LABEL, self.provisioner.metadata.name)
+            # prevent the kube scheduler racing our binds (provisioner.go:164-176)
+            node.spec.taints.append(Taint(key=wellknown.NOT_READY_TAINT_KEY,
+                                          effect="NoSchedule"))
+            try:
+                self.kube.create(node)
+            except AlreadyExists:
+                pass  # self-registered first — idempotent (provisioner.go:177-186)
+            bound = 0
+            for pod in pods:
+                try:
+                    self.kube.bind_pod(pod, node.metadata.name)
+                    bound += 1
+                except (NotFound, Conflict) as e:
+                    log.error("failed to bind %s/%s to %s: %s",
+                              pod.metadata.namespace, pod.metadata.name,
+                              node.metadata.name, e)
+            log.info("bound %d pod(s) to node %s", bound, node.metadata.name)
+            return None
+
+
+class ProvisioningController:
+    """Reconciles Provisioner CRs into workers (controller.go:44-128)."""
+
+    REQUEUE_SECONDS = 5 * 60  # catch zone/type drift (controller.go:82-83)
+
+    def __init__(self, kube: KubeCore, cloud_provider: CloudProvider,
+                 solver_config: Optional[SolverConfig] = None,
+                 batcher_factory: Optional[Callable[[], Batcher]] = None):
+        self.kube = kube
+        self.cloud_provider = cloud_provider
+        self.solver_config = solver_config
+        self.batcher_factory = batcher_factory or Batcher
+        self.workers: Dict[str, ProvisionerWorker] = {}
+        self._hashes: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+
+    def kind(self) -> str:
+        return "Provisioner"
+
+    def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
+        try:
+            provisioner = self.kube.get("Provisioner", name, namespace)
+        except NotFound:
+            with self._lock:
+                worker = self.workers.pop(name, None)
+                self._hashes.pop(name, None)
+            if worker:
+                worker.stop()
+            return None
+        if provisioner.metadata.deletion_timestamp is not None:
+            return None
+
+        # refresh global requirements from the live catalog
+        catalog = self.cloud_provider.get_instance_types(provisioner.spec.constraints)
+        provisioner.spec.constraints.requirements = (
+            provisioner.spec.constraints.requirements.add(
+                *global_requirements(catalog).items))
+
+        key = _spec_hash(provisioner)
+        with self._lock:
+            if self._hashes.get(name) == key:
+                return float(self.REQUEUE_SECONDS)
+            old = self.workers.get(name)
+            if old:
+                old.stop()
+            worker = ProvisionerWorker(
+                provisioner, self.kube, self.cloud_provider,
+                solver_config=self.solver_config, batcher=self.batcher_factory())
+            worker.start()
+            self.workers[name] = worker
+            self._hashes[name] = key
+        return float(self.REQUEUE_SECONDS)
+
+
+def _spec_hash(p: Provisioner) -> tuple:
+    c = p.spec.constraints
+    return (
+        tuple(sorted((r.key, r.operator, tuple(sorted(r.values)))
+                     for r in c.requirements.items)),
+        tuple(sorted((t.key, t.value, t.effect) for t in c.taints)),
+        tuple(sorted(c.labels.items())),
+        p.spec.ttl_seconds_after_empty,
+        p.spec.ttl_seconds_until_expired,
+    )

@@ -1,0 +1,217 @@
+"""Selection controller: route provisionable pods to a Provisioner worker.
+
+Reference: pkg/controllers/selection/{controller.go,preferences.go,
+volumetopology.go}. Watches all pods; filters to provisionable; validates
+supported features; relaxes preferences on retries; injects volume topology;
+picks the first Provisioner whose constraints validate the pod; blocks on
+the batch gate so the kube side can re-verify after the provisioning pass.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.core import (
+    Affinity, NodeAffinity, NodeSelectorRequirement, NodeSelectorTerm, Pod,
+)
+from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
+from karpenter_tpu.utils import clock
+from karpenter_tpu.utils import pod as podutil
+
+log = logging.getLogger("karpenter.selection")
+
+RELAXATION_TTL_SECONDS = 5 * 60  # preferences.go ExpirationTTL
+
+
+def is_provisionable(p: Pod) -> bool:
+    """controller.go:115-121."""
+    return (
+        not podutil.is_scheduled(p)
+        and not podutil.is_preempting(p)
+        and podutil.failed_to_schedule(p)
+        and not podutil.is_owned_by_daemonset(p)
+        and not podutil.is_owned_by_node(p)
+    )
+
+
+def validate(p: Pod) -> Optional[str]:
+    """Supported-feature validation (controller.go:123-174)."""
+    errs: List[str] = []
+    if p.spec.affinity is not None:
+        if p.spec.affinity.pod_affinity is not None:
+            errs.append("pod affinity is not supported")
+        if p.spec.affinity.pod_anti_affinity is not None:
+            errs.append("pod anti-affinity is not supported")
+        na = p.spec.affinity.node_affinity
+        if na is not None:
+            terms = list(na.required or [])
+            terms += [t.preference for t in na.preferred]
+            for term in terms:
+                if term.match_fields:
+                    errs.append("node selector term with matchFields is not supported")
+                for r in term.match_expressions:
+                    if r.operator not in ("In", "NotIn"):
+                        errs.append(f"unsupported operator {r.operator}")
+    for c in p.spec.topology_spread_constraints:
+        if c.topology_key not in (wellknown.LABEL_HOSTNAME, wellknown.LABEL_TOPOLOGY_ZONE):
+            errs.append(f"unsupported topology key {c.topology_key}")
+    return "; ".join(errs) if errs else None
+
+
+class Preferences:
+    """Iterative preference relaxation with TTL reset (preferences.go:40-106)."""
+
+    def __init__(self):
+        self._cache: Dict[str, Tuple[Optional[Affinity], float]] = {}
+        self._lock = threading.Lock()
+
+    def relax(self, pod: Pod) -> None:
+        now = clock.now()
+        uid = pod.metadata.uid or f"{pod.metadata.namespace}/{pod.metadata.name}"
+        with self._lock:
+            self._cache = {k: v for k, v in self._cache.items()
+                           if now - v[1] < RELAXATION_TTL_SECONDS}
+            entry = self._cache.get(uid)
+            if entry is None:
+                self._cache[uid] = (pod.spec.affinity, now)
+                return
+            pod.spec.affinity = entry[0]
+            if self._relax(pod):
+                self._cache[uid] = (pod.spec.affinity, now)
+
+    def _relax(self, pod: Pod) -> bool:
+        return (self._remove_preferred_term(pod)
+                or self._remove_required_term(pod))
+
+    def _remove_preferred_term(self, pod: Pod) -> bool:
+        """Strip the heaviest preferred term (preferences.go:78-92)."""
+        a = pod.spec.affinity
+        if a is None or a.node_affinity is None or not a.node_affinity.preferred:
+            return False
+        terms = sorted(a.node_affinity.preferred, key=lambda t: -t.weight)
+        a.node_affinity.preferred = terms[1:]
+        log.debug("relaxed: removed preferred term weight=%s", terms[0].weight)
+        return True
+
+    def _remove_required_term(self, pod: Pod) -> bool:
+        """Strip the first required OR-term, never the last
+        (preferences.go:94-106)."""
+        a = pod.spec.affinity
+        if (a is None or a.node_affinity is None or a.node_affinity.required is None
+                or len(a.node_affinity.required) <= 1):
+            return False
+        a.node_affinity.required = a.node_affinity.required[1:]
+        log.debug("relaxed: removed required term")
+        return True
+
+
+class VolumeTopology:
+    """PVC/PV/StorageClass topology → pod node affinity
+    (volumetopology.go:37-128)."""
+
+    def __init__(self, kube: KubeCore):
+        self.kube = kube
+
+    def inject(self, pod: Pod) -> None:
+        requirements: List[NodeSelectorRequirement] = []
+        for volume in pod.spec.volumes:
+            requirements.extend(self._get_requirements(pod, volume))
+        if not requirements:
+            return
+        if pod.spec.affinity is None:
+            pod.spec.affinity = Affinity()
+        if pod.spec.affinity.node_affinity is None:
+            pod.spec.affinity.node_affinity = NodeAffinity()
+        na = pod.spec.affinity.node_affinity
+        if na.required is None:
+            na.required = []
+        if not na.required:
+            na.required.append(NodeSelectorTerm())
+        na.required[0].match_expressions.extend(requirements)
+
+    def _get_requirements(self, pod: Pod, volume) -> List[NodeSelectorRequirement]:
+        if volume.persistent_volume_claim is None:
+            return []
+        pvc = self.kube.get("PersistentVolumeClaim",
+                            volume.persistent_volume_claim.claim_name,
+                            pod.metadata.namespace)
+        if pvc.spec.volume_name:
+            return self._pv_requirements(pvc)
+        if pvc.spec.storage_class_name:
+            return self._storage_class_requirements(pvc)
+        return []
+
+    def _pv_requirements(self, pvc) -> List[NodeSelectorRequirement]:
+        pv = self.kube.get("PersistentVolume", pvc.spec.volume_name, "default")
+        if pv.spec.node_affinity is None or pv.spec.node_affinity.required is None:
+            return []
+        terms = pv.spec.node_affinity.required
+        return list(terms[0].match_expressions) if terms else []
+
+    def _storage_class_requirements(self, pvc) -> List[NodeSelectorRequirement]:
+        sc = self.kube.get("StorageClass", pvc.spec.storage_class_name, "default")
+        if not sc.allowed_topologies:
+            return []
+        return [
+            NodeSelectorRequirement(key=r.key, operator="In", values=list(r.values))
+            for r in sc.allowed_topologies[0].match_label_expressions
+        ]
+
+
+class SelectionController:
+    """controller.go:59-111."""
+
+    REQUEUE_SECONDS = 5.0  # re-verify scheduling after the batch
+
+    def __init__(self, kube: KubeCore, provisioning_controller):
+        self.kube = kube
+        self.provisioning = provisioning_controller
+        self.preferences = Preferences()
+        self.volume_topology = VolumeTopology(kube)
+
+    def kind(self) -> str:
+        return "Pod"
+
+    def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
+        try:
+            pod = self.kube.get("Pod", name, namespace)
+        except NotFound:
+            return None
+        if not is_provisionable(pod):
+            return None
+        err = validate(pod)
+        if err is not None:
+            log.debug("ignoring pod %s: %s", name, err)
+            return None
+        err = self._select_provisioner(pod)
+        if err is not None:
+            log.debug("could not schedule pod %s: %s", name, err)
+        return self.REQUEUE_SECONDS
+
+    def _select_provisioner(self, pod: Pod) -> Optional[str]:
+        """controller.go:84-111: relax → volume topology → first matching
+        provisioner → block on its batch gate."""
+        self.preferences.relax(pod)
+        try:
+            self.volume_topology.inject(pod)
+        except NotFound as e:
+            return f"getting volume topology requirements: {e}"
+        workers = list(self.provisioning.workers.values())
+        if not workers:
+            return None
+        errs = []
+        chosen = None
+        for worker in workers:
+            err = worker.provisioner.spec.constraints.validate_pod(pod)
+            if err is None:
+                chosen = worker
+                break
+            errs.append(f"tried provisioner/{worker.provisioner.metadata.name}: {err}")
+        if chosen is None:
+            return f"matched 0/{len(errs)} provisioners: " + "; ".join(errs)
+        gate = chosen.add(pod)
+        gate.wait(timeout=30.0)
+        return None

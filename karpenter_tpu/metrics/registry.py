@@ -1,0 +1,166 @@
+"""Prometheus-style metrics registry (self-contained).
+
+Reference: pkg/metrics/constants.go (namespace "karpenter", duration buckets
+5 ms … 60 s, Measure defer-timer) and the gauge/histogram inventory in
+SURVEY.md rows 18/20 and §5.1. Exposition follows the Prometheus text
+format so any scraper can consume /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterable, List, Optional, Tuple
+
+NAMESPACE = "karpenter"
+
+# constants.go:33-38
+DURATION_BUCKETS = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60]
+
+LabelValues = Tuple[Tuple[str, str], ...]
+
+
+def _lv(labels: Dict[str, str]) -> LabelValues:
+    return tuple(sorted(labels.items()))
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: Dict[LabelValues, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_lv(labels)] = value
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        with self._lock:
+            self._values[_lv(labels)] = self._values.get(_lv(labels), 0.0) + amount
+
+    def delete(self, **labels) -> None:
+        with self._lock:
+            self._values.pop(_lv(labels), None)
+
+    def delete_matching(self, **labels) -> None:
+        """Drop every series whose labels include the given subset — the
+        stale-series cleanup used by the node metrics controller
+        (metrics/node/controller.go:196-208)."""
+        subset = set(labels.items())
+        with self._lock:
+            self._values = {
+                lv: v for lv, v in self._values.items() if not subset <= set(lv)
+            }
+
+    def collect(self) -> Dict[LabelValues, float]:
+        with self._lock:
+            return dict(self._values)
+
+
+class Counter(Gauge):
+    pass
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "", buckets: Optional[List[float]] = None):
+        self.name = name
+        self.help = help_
+        self.buckets = list(buckets or DURATION_BUCKETS)
+        self._counts: Dict[LabelValues, List[int]] = {}
+        self._sums: Dict[LabelValues, float] = {}
+        self._totals: Dict[LabelValues, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels) -> None:
+        lv = _lv(labels)
+        with self._lock:
+            counts = self._counts.setdefault(lv, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[lv] = self._sums.get(lv, 0.0) + value
+            self._totals[lv] = self._totals.get(lv, 0) + 1
+
+    @contextmanager
+    def time(self, **labels):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0, **labels)
+
+    def collect(self):
+        with self._lock:
+            return {lv: (list(c), self._sums[lv], self._totals[lv])
+                    for lv, c in self._counts.items()}
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help_))
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help_))
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Optional[List[float]] = None) -> Histogram:
+        return self._get_or_create(name, lambda: Histogram(name, help_, buckets))
+
+    def _get_or_create(self, name: str, factory):
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = factory()
+            return self._metrics[name]
+
+    @contextmanager
+    def time(self, name: str, **labels):
+        with self.histogram(name).time(**labels):
+            yield
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = dict(self._metrics)
+        for name, metric in sorted(metrics.items()):
+            full = f"{NAMESPACE}_{name}"
+            if isinstance(metric, Histogram):
+                lines.append(f"# TYPE {full} histogram")
+                for lv, (counts, sum_, total) in metric.collect().items():
+                    base = _fmt_labels(lv)
+                    cum = 0
+                    for b, c in zip(metric.buckets, counts):
+                        cum = c
+                        lines.append(f'{full}_bucket{{{_join(base, ("le", str(b)))}}} {cum}')
+                    lines.append(f'{full}_bucket{{{_join(base, ("le", "+Inf"))}}} {total}')
+                    lines.append(f"{full}_sum{{{_fmt(base)}}} {sum_}")
+                    lines.append(f"{full}_count{{{_fmt(base)}}} {total}")
+            else:
+                kind = "counter" if isinstance(metric, Counter) else "gauge"
+                lines.append(f"# TYPE {full} {kind}")
+                for lv, v in metric.collect().items():
+                    lines.append(f"{full}{{{_fmt(lv)}}} {v}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_labels(lv: LabelValues) -> List[Tuple[str, str]]:
+    return list(lv)
+
+
+def _fmt(pairs) -> str:
+    return ",".join(f'{k}="{v}"' for k, v in pairs)
+
+
+def _join(pairs, extra) -> str:
+    return _fmt(list(pairs) + [extra])
+
+
+# Process-wide default registry (the controller-runtime registry analog).
+DEFAULT = Registry()
+HISTOGRAMS = DEFAULT

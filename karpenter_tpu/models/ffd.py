@@ -1,0 +1,201 @@
+"""Device-FFD model: orchestrates encode → pack_chunk loop → decode.
+
+One of the framework's solver "model families": exact parity with the
+reference Go packer (the others: cost-minimizing pack, consolidation).
+Produces the same HostSolveResult structure as the host oracle so callers
+and tests are representation-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.ops.encode import EncodedProblem, encode
+from karpenter_tpu.solver.host_ffd import (
+    HostPacking, HostSolveResult, MAX_INSTANCE_TYPES, Packable, R_MEMORY,
+    R_PODS, Vec,
+)
+
+DEFAULT_CHUNK_ITERS = 64
+MAX_CHUNKS = 4096  # hard safety valve; each iteration provably makes progress
+_INT32_MAX = 2**31 - 1
+
+
+def instance_options(packables: Sequence[Packable], chosen: int,
+                     max_instance_types: int = MAX_INSTANCE_TYPES) -> List[int]:
+    """Viable instance-type options for a node packed on ``chosen``
+    (packer.go:184-191): the next ≤20 ascending types with memory and pods
+    not smaller than the chosen type's."""
+    base = packables[chosen]
+    options = []
+    for j in range(chosen, min(chosen + max_instance_types, len(packables))):
+        if (base.total[R_MEMORY] <= packables[j].total[R_MEMORY]
+                and base.total[R_PODS] <= packables[j].total[R_PODS]):
+            options.append(packables[j].index)
+    return options
+
+
+def solve_ffd_device(
+    pod_vecs: Sequence[Vec],
+    pod_ids: Sequence[int],
+    packables: Sequence[Packable],
+    max_instance_types: int = MAX_INSTANCE_TYPES,
+    chunk_iters: int = DEFAULT_CHUNK_ITERS,
+) -> Optional[HostSolveResult]:
+    """Solve on device; None when the problem is not device-encodable
+    (caller falls back to the host oracle). Pods may arrive unsorted; the
+    same descending total order as the host oracle is applied here."""
+    import jax
+
+    from karpenter_tpu.ops.pack import pack_chunk_flat, unpack_flat
+
+    if not packables:
+        return HostSolveResult(packings=[], unschedulable=list(pod_ids))
+
+    enc = encode(pod_vecs, pod_ids, packables)
+    if enc is None:
+        return None
+
+    S, L = enc.shapes.shape[0], chunk_iters
+    # one host→device transfer for the whole problem (tunnel-latency bound)
+    dev = jax.device_put((
+        enc.shapes, enc.counts, np.zeros_like(enc.counts), enc.totals,
+        enc.reserved0, enc.valid,
+        np.asarray(enc.last_valid, np.int32), np.asarray(enc.pods_unit, np.int32),
+    ))
+    shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit = dev
+
+    records = []  # (chosen, qty, packed-vector)
+    dropped_h = None
+    for _ in range(MAX_CHUNKS):
+        buf = pack_chunk_flat(
+            shapes, counts, dropped, totals, reserved0, valid, last_valid,
+            pods_unit, num_iters=chunk_iters)
+        # one device→host fetch per chunk; typical solves need one chunk
+        counts_h, dropped_h, done, chosen_h, q_h, packed_h = unpack_flat(
+            np.asarray(buf), S, L)
+        for i in range(L):
+            if q_h[i] > 0:
+                records.append((int(chosen_h[i]), int(q_h[i]), packed_h[i]))
+        if done:
+            break
+        counts, dropped = jax.device_put((counts_h, dropped_h))
+    else:
+        return None  # did not converge — impossible by construction, but safe
+
+    return _decode(enc, records, dropped_h, packables, max_instance_types)
+
+
+def solve_ffd_numpy(
+    pod_vecs: Sequence[Vec],
+    pod_ids: Sequence[int],
+    packables: Sequence[Packable],
+    max_instance_types: int = MAX_INSTANCE_TYPES,
+) -> Optional[HostSolveResult]:
+    """Numpy mirror of the device kernel (ops/pack.py), shape-level greedy
+    with the same fast-forward. Fast enough for 50k-pod parity checks where
+    the naive per-pod oracle (host_ffd.pack) is O(pods × types × nodes).
+    Differential tests pin: host_ffd.pack ≡ solve_ffd_numpy ≡ device."""
+    from karpenter_tpu.solver.host_ffd import R_PODS as _R_PODS
+
+    if not packables:
+        return HostSolveResult(packings=[], unschedulable=list(pod_ids))
+    enc = encode(pod_vecs, pod_ids, packables)
+    if enc is None:
+        return None
+
+    S, T = enc.num_shapes, enc.num_types
+    shapes = enc.shapes[:S].astype(np.int64)
+    counts = enc.counts[:S].astype(np.int64).copy()
+    totals = enc.totals[:T].astype(np.int64)
+    reserved0 = enc.reserved0[:T].astype(np.int64)
+    pods_one = np.zeros(shapes.shape[1], np.int64)
+    pods_one[_R_PODS] = enc.pods_unit
+
+    avail0 = totals - reserved0
+    with np.errstate(divide="ignore"):
+        kr0 = np.where(shapes[:, None, :] > 0,
+                       avail0[None, :, :] // np.maximum(shapes[:, None, :], 1), _INT32_MAX)
+    maxfit = np.min(kr0, axis=-1).max(axis=1)  # (S,)
+
+    dropped = np.zeros(S, np.int64)
+    records = []
+    while counts.any():
+        has = counts > 0
+        largest = int(np.argmax(has))
+        smallest = S - 1 - int(np.argmax(has[::-1]))
+        smallest_fits = np.maximum(shapes[smallest] - pods_one, 0)
+
+        reserved = reserved0.copy()
+        stopped = np.zeros(T, bool)
+        npacked = np.zeros(T, np.int64)
+        k_all = np.zeros((S, T), np.int64)
+        for s in range(S):
+            if counts[s] == 0:
+                continue
+            active = ~stopped
+            avail = totals - reserved
+            kr = np.where(shapes[s][None, :] > 0,
+                          avail // np.maximum(shapes[s][None, :], 1), _INT32_MAX)
+            k = np.clip(kr.min(axis=1), 0, counts[s]) * active
+            failure = active & (k < counts[s])
+            reserved = reserved + k[:, None] * shapes[s][None, :]
+            full = np.any((totals > 0) & (reserved + smallest_fits[None, :] >= totals), axis=1)
+            npacked = npacked + k
+            stopped |= failure & (full | (npacked == 0))
+            k_all[s] = k
+
+        max_pods = int(npacked[T - 1])
+        if max_pods == 0:
+            dropped[largest] += counts[largest]
+            counts[largest] = 0
+            continue
+        chosen = int(np.argmax(npacked == max_pods))
+        packedv = k_all[:, chosen]
+        terms = np.where(packedv > 0, (counts - maxfit) // np.maximum(packedv, 1), _INT32_MAX)
+        q = int(1 + max(0, terms.min()))
+        counts = counts - q * packedv
+        records.append((chosen, q, packedv))
+    return _decode(enc, records, dropped, packables, max_instance_types)
+
+
+def _decode(
+    enc: EncodedProblem,
+    records,
+    dropped: np.ndarray,
+    packables: Sequence[Packable],
+    max_instance_types: int,
+) -> HostSolveResult:
+    """Materialize packings: map per-shape counts back to pod ids and dedupe
+    by instance-option set (the hash dedupe in packer.go:130-139)."""
+    queues = [list(p) for p in enc.shape_pods]
+    heads = [0] * len(queues)
+    packings: List[HostPacking] = []
+    by_options = {}
+    for chosen, qty, packedv in records:
+        options = instance_options(packables, chosen, max_instance_types)
+        key = tuple(options)
+        for _ in range(qty):
+            node_pods: List[int] = []
+            for s in range(enc.num_shapes):
+                n = int(packedv[s])
+                if n:
+                    node_pods.extend(queues[s][heads[s]:heads[s] + n])
+                    heads[s] += n
+            if key in by_options:
+                main = by_options[key]
+                main.node_quantity += 1
+                main.pod_ids.append(node_pods)
+            else:
+                p = HostPacking(pod_ids=[node_pods], instance_type_indices=options)
+                by_options[key] = p
+                packings.append(p)
+    unschedulable: List[int] = []
+    for s in range(enc.num_shapes):
+        n = int(dropped[s])
+        if n:
+            unschedulable.extend(queues[s][heads[s]:heads[s] + n])
+            heads[s] += n
+    return HostSolveResult(packings=packings, unschedulable=unschedulable)

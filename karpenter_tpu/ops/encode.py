@@ -1,0 +1,140 @@
+"""Encode a packing problem into dense int32 device tensors.
+
+Pods collapse to unique resource *shapes* with counts — the key TPU-first
+transformation: the greedy pack then scans over shapes (dozens) instead of
+pods (tens of thousands), vectorized over all instance types at once.
+
+Quantities are nano-unit integers on the host; each resource dimension is
+divided by the GCD of all its values so realistic problems (milli CPUs,
+Mi-aligned memory) fit int32 exactly. If any dimension cannot be encoded
+exactly below 2**31, encoding fails and the caller falls back to the host
+oracle — exactness is never traded for speed (the ±1 node-count target).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.solver.host_ffd import NUM_RESOURCES, Packable, R_PODS, Vec
+
+INT32_LIMIT = 2**31 - 1
+
+# Pad shapes/types to these static sizes so XLA compiles one executable per
+# bucket pair instead of one per batch (SURVEY.md §7 "ragged shapes").
+SHAPE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+TYPE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def bucket(n: int, buckets: Sequence[int]) -> Optional[int]:
+    for b in buckets:
+        if n <= b:
+            return b
+    return None
+
+
+@dataclass
+class EncodedProblem:
+    shapes: np.ndarray        # (S, R) int32, reserve semantics (pods includes +1)
+    counts: np.ndarray        # (S,) int32
+    totals: np.ndarray        # (T, R) int32
+    reserved0: np.ndarray     # (T, R) int32
+    valid: np.ndarray         # (T,) bool
+    last_valid: int           # index of the largest viable type
+    num_shapes: int           # unpadded S
+    num_types: int            # unpadded T
+    shape_pods: List[List[int]]   # pod ids per shape, pack order
+    scales: Tuple[int, ...]   # per-resource divisor (nano → device units)
+    pods_unit: int = 1        # one pod in device units (10**9 / scales[R_PODS])
+
+
+def _gcd_scale(columns: List[List[int]]) -> Optional[Tuple[int, ...]]:
+    scales = []
+    for vals in columns:
+        g = 0
+        for v in vals:
+            g = math.gcd(g, v)
+        g = g or 1
+        if max((v // g for v in vals), default=0) > INT32_LIMIT:
+            return None
+        scales.append(g)
+    return tuple(scales)
+
+
+def encode(
+    pod_vecs: Sequence[Vec],
+    pod_ids: Sequence[int],
+    packables: Sequence[Packable],
+) -> Optional[EncodedProblem]:
+    """Returns None when the problem can't be encoded exactly (host fallback).
+
+    ``pod_vecs`` may be in any order: pods dedupe to shapes via hashing
+    (O(pods)) and only the small shape set is sorted — the device solve
+    never sorts the pod axis. Pods within a shape are interchangeable.
+    ``packables`` must be ascending (adapter.build_packables output).
+
+    All nano-unit arithmetic stays in Python ints until after GCD scaling
+    (nano memory values overflow int64 beyond ~9Gi).
+    """
+    if not packables:
+        return None
+
+    # -- dedupe pods into shapes ------------------------------------------
+    by_vec: Dict[Vec, List[int]] = {}
+    for vec, pid in zip(pod_vecs, pod_ids):
+        by_vec.setdefault(vec, []).append(pid)
+    # descending by full resource vector: the same total order the host
+    # oracle sorts pods with (host_ffd.pack), so tie-breaking agrees
+    ordered = sorted(by_vec.items(), key=lambda kv: tuple(-v for v in kv[0]))
+    shape_vecs: List[List[int]] = []
+    counts: List[int] = []
+    shape_pods: List[List[int]] = []
+    for vec, pids in ordered:
+        reserve_vec = list(vec)
+        reserve_vec[R_PODS] += 10**9  # implicit pods:1 in nano units
+        shape_vecs.append(reserve_vec)
+        counts.append(len(pids))
+        shape_pods.append(pids)
+
+    S, T = len(shape_vecs), len(packables)
+    SB, TB = bucket(S, SHAPE_BUCKETS), bucket(T, TYPE_BUCKETS)
+    if SB is None or TB is None:
+        return None
+
+    # -- per-resource exact scaling -----------------------------------------
+    columns = []
+    for r in range(NUM_RESOURCES):
+        col = [sv[r] for sv in shape_vecs]
+        col += [p.total[r] for p in packables]
+        col += [p.reserved[r] for p in packables]
+        if r == R_PODS:
+            # the kernel subtracts the implicit pods:1 for the early-exit
+            # vector, so the scale must divide one pod exactly
+            col.append(10**9)
+        columns.append(col)
+    scales = _gcd_scale(columns)
+    if scales is None:
+        return None
+
+    shapes = np.zeros((SB, NUM_RESOURCES), np.int32)
+    counts_a = np.zeros((SB,), np.int32)
+    for s in range(S):
+        shapes[s] = [v // g for v, g in zip(shape_vecs[s], scales)]
+        counts_a[s] = counts[s]
+    totals = np.zeros((TB, NUM_RESOURCES), np.int32)
+    reserved0 = np.zeros((TB, NUM_RESOURCES), np.int32)
+    valid = np.zeros((TB,), bool)
+    for t, p in enumerate(packables):
+        totals[t] = [v // g for v, g in zip(p.total, scales)]
+        reserved0[t] = [v // g for v, g in zip(p.reserved, scales)]
+        valid[t] = True
+
+    return EncodedProblem(
+        shapes=shapes, counts=counts_a, totals=totals, reserved0=reserved0,
+        valid=valid, last_valid=T - 1, num_shapes=S, num_types=T,
+        shape_pods=shape_pods, scales=scales,
+        pods_unit=10**9 // scales[R_PODS],
+    )

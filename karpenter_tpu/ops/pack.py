@@ -1,0 +1,151 @@
+"""The TPU bin-packing kernel: FFD with exact Go-packer parity.
+
+Replaces the reference's sequential hot loop (packer.go:114-141 +
+packable.go:111-130, O(pods × types × resources) on one CPU core) with an
+XLA program whose sequential axis is *distinct packing decisions*, not pods:
+
+- inner ``lax.scan`` over unique pod shapes (S ≈ dozens), each step a
+  vectorized fit over ALL instance types at once (T×R int32 math on the VPU);
+- outer ``lax.scan`` over node-packing iterations, with an exact
+  *fast-forward*: when the remaining shape counts dominate every type's
+  capacity-bound fit, the same packing provably repeats, so q identical
+  nodes are committed in one step (the device analog of the reference's
+  dedupe-by-hash NodeQuantity++, packer.go:130-139).
+
+Semantics preserved per quirk list in solver/host_ffd.py; differential tests
+in tests/test_pack_parity.py enforce exact node-count equality.
+
+All tensors are int32 (TPU-native); encode.py guarantees exactness.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from karpenter_tpu.solver.host_ffd import R_PODS
+
+INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+@functools.partial(jax.jit, static_argnames=("num_iters",))
+def pack_chunk(
+    shapes: jax.Array,     # (S, R) int32, descending, reserve semantics
+    counts: jax.Array,     # (S,) int32 remaining pods per shape
+    dropped: jax.Array,    # (S,) int32 accumulated unschedulable pods
+    totals: jax.Array,     # (T, R) int32
+    reserved0: jax.Array,  # (T, R) int32 overhead+daemons reservation
+    valid: jax.Array,      # (T,) bool
+    last_valid: jax.Array,  # () int32 index of largest viable type
+    pods_unit: jax.Array,  # () int32 one pod in device units
+    num_iters: int,
+):
+    """Run up to ``num_iters`` node-packing iterations; host loops chunks
+    until ``done``. Returns (counts, dropped, done, chosen[L], qty[L],
+    packed[L,S])."""
+    S, R = shapes.shape
+    T = totals.shape[0]
+    pods_one = jnp.zeros((R,), jnp.int32).at[R_PODS].set(pods_unit)
+
+    # Upper bound on any type's capacity fit per shape, from the initial
+    # reservation (reserved only grows during a node pack). Used by the
+    # fast-forward validity condition: count_s >= maxfit_s ⇒ every type is
+    # capacity-bound for shape s ⇒ the greedy outcome can't depend on count.
+    avail0 = totals - reserved0  # (T, R)
+    kr0 = jnp.where(shapes[:, None, :] > 0,
+                    avail0[None, :, :] // jnp.maximum(shapes[:, None, :], 1),
+                    INT32_MAX)
+    kfit0 = jnp.min(kr0, axis=-1)  # (S, T)
+    maxfit = jnp.max(jnp.where(valid[None, :], kfit0, -1), axis=1)  # (S,)
+
+    def node_iter(carry, _):
+        counts, dropped, done = carry
+        has = counts > 0
+        largest_idx = jnp.argmax(has)                       # first shape remaining
+        smallest_idx = S - 1 - jnp.argmax(has[::-1])        # last shape remaining
+        # fits() uses raw requests (no implicit pods:1) — packable.go:118,146
+        smallest_fits = jnp.maximum(shapes[smallest_idx] - pods_one, 0)
+
+        def shape_step(c2, s):
+            reserved, stopped, npacked = c2
+            shape = shapes[s]          # (R,)
+            count = counts[s]
+            active = (count > 0) & (~stopped)
+            avail = totals - reserved  # (T, R)
+            kr = jnp.where(shape[None, :] > 0,
+                           avail // jnp.maximum(shape[None, :], 1), INT32_MAX)
+            kfit = jnp.min(kr, axis=1)                      # (T,)
+            k = jnp.where(active, jnp.clip(kfit, 0, count), 0)
+            failure = active & (k < count)
+            reserved = reserved + k[:, None] * shape[None, :]
+            # early-exit: smallest remaining pod reaches/exceeds a nonzero total
+            full = jnp.any((totals > 0) &
+                           (reserved + smallest_fits[None, :] >= totals), axis=1)
+            npacked = npacked + k
+            stopped = stopped | (failure & (full | (npacked == 0)))
+            return (reserved, stopped, npacked), k
+
+        # inits derive from inputs so varying-axis types line up under shard_map
+        init = (reserved0, ~valid, jnp.zeros_like(totals[:, 0]))
+        (_, _, npacked), k_all = jax.lax.scan(shape_step, init, jnp.arange(S))
+        # k_all: (S, T) pods of each shape packed per candidate type
+
+        max_pods = npacked[last_valid]
+        chosen = jnp.argmax(valid & (npacked == max_pods))   # first (smallest) type
+        packedv = k_all[:, chosen]                           # (S,)
+        nothing = max_pods == 0
+
+        # exact fast-forward: q identical nodes in one iteration
+        terms = jnp.where(packedv > 0,
+                          (counts - maxfit) // jnp.maximum(packedv, 1), INT32_MAX)
+        q = 1 + jnp.maximum(0, jnp.min(terms))
+        q = jnp.where(nothing | done, 0, q)
+
+        # drop path: largest remaining shape fits nowhere (packer.go:124-128);
+        # every pod of that shape fails identically, so drop them all at once
+        drop_here = nothing & ~done
+        drop_vec = jnp.where((jnp.arange(S) == largest_idx) & drop_here, counts, 0)
+
+        new_counts = jnp.where(done, counts, counts - q * packedv - drop_vec)
+        new_dropped = dropped + drop_vec
+        new_done = ~jnp.any(new_counts > 0)
+        rec = (jnp.where(q > 0, chosen, -1), q, packedv)
+        return (new_counts, new_dropped, new_done), rec
+
+    (counts_f, dropped_f, done_f), (chosen_seq, q_seq, packed_seq) = jax.lax.scan(
+        node_iter, (counts, dropped, ~jnp.any(counts > 0)), None, length=num_iters)
+    return counts_f, dropped_f, done_f, chosen_seq, q_seq, packed_seq
+
+
+@functools.partial(jax.jit, static_argnames=("num_iters",))
+def pack_chunk_flat(
+    shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
+    num_iters: int,
+):
+    """pack_chunk with all outputs flattened into ONE int32 buffer so a solve
+    costs exactly one device→host fetch. The TPU here sits behind a tunnel
+    with tens-of-ms round-trip latency; the 200 ms p99 budget is spent on
+    RTTs, not FLOPs. Layout: [counts S | dropped S | done 1 | chosen L |
+    q L | packed L*S]."""
+    counts_f, dropped_f, done_f, chosen_seq, q_seq, packed_seq = pack_chunk(
+        shapes, counts, dropped, totals, reserved0, valid, last_valid,
+        pods_unit, num_iters=num_iters)
+    return jnp.concatenate([
+        counts_f, dropped_f, done_f.astype(jnp.int32)[None],
+        chosen_seq.astype(jnp.int32), q_seq, packed_seq.reshape(-1),
+    ])
+
+
+def unpack_flat(buf, S: int, L: int):
+    """Split a pack_chunk_flat buffer (host numpy) back into components."""
+    counts_f = buf[:S]
+    dropped_f = buf[S:2 * S]
+    done = bool(buf[2 * S])
+    o = 2 * S + 1
+    chosen = buf[o:o + L]
+    q = buf[o + L:o + 2 * L]
+    packed = buf[o + 2 * L:o + 2 * L + L * S].reshape(L, S)
+    return counts_f, dropped_f, done, chosen, q, packed

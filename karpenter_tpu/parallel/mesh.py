@@ -1,0 +1,36 @@
+"""Device mesh construction for the sharded solver.
+
+Axes:
+- "batch": independent packing problems (schedules). The provisioning plane
+  produces many isomorphic-constraint groups per solve window; each is an
+  independent FFD instance, so the batch axis shards perfectly with no
+  cross-device communication (the analog of the reference's per-Provisioner
+  goroutines, provisioner.go:53-60 — but data-parallel on ICI instead of
+  host threads).
+
+Multi-host: jax initializes the global device set; the same mesh spec spans
+slices (DCN between hosts is handled by XLA's collectives). Nothing here is
+TPU-count-specific — tests use a virtual 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def solver_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    import numpy as np
+
+    return Mesh(np.array(devs), axis_names=("batch",))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("batch"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

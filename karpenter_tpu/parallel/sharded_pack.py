@@ -1,0 +1,89 @@
+"""Batched, sharded packing: many schedules solved concurrently on a mesh.
+
+The provisioning hot path yields a *batch* of independent packing problems
+(one per isomorphic-constraint schedule, scheduler.go:87-125). Each problem
+is small after shape-dedupe; throughput comes from solving the whole batch
+at once: ``vmap`` over problems within a device, ``shard_map`` over the
+"batch" mesh axis across devices. No collectives are needed in the solve
+itself (problems are independent); results are gathered by the host.
+
+This is the framework's multi-chip scaling story (SURVEY.md §5.7): the
+solve dimension that grows with cluster size is the number of concurrent
+schedules × shapes, and it rides ICI by sharding the batch axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from karpenter_tpu.ops.pack import pack_chunk
+
+
+def _pack_one_problem(shapes, counts, dropped, totals, reserved0, valid,
+                      last_valid, pods_unit, num_iters: int):
+    return pack_chunk(shapes, counts, dropped, totals, reserved0, valid,
+                      last_valid, pods_unit, num_iters=num_iters)
+
+
+@functools.partial(jax.jit, static_argnames=("num_iters", "mesh"))
+def pack_batch_sharded(
+    shapes,      # (B, S, R) int32
+    counts,      # (B, S) int32
+    dropped,     # (B, S) int32
+    totals,      # (B, T, R) int32
+    reserved0,   # (B, T, R) int32
+    valid,       # (B, T) bool
+    last_valid,  # (B,) int32
+    pods_unit,   # (B,) int32
+    *,
+    num_iters: int,
+    mesh: Mesh,
+):
+    """Solve B independent packing problems, sharded over the mesh's "batch"
+    axis. B must be a multiple of the mesh size (pad with empty problems)."""
+    vmapped = jax.vmap(
+        functools.partial(_pack_one_problem, num_iters=num_iters),
+        in_axes=(0, 0, 0, 0, 0, 0, 0, 0))
+    spec = P("batch")
+    return shard_map(
+        vmapped, mesh=mesh,
+        in_specs=(spec,) * 8,
+        out_specs=(spec,) * 6,
+    )(shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit)
+
+
+def pad_problems(problems, mesh_size: int):
+    """Stack EncodedProblems into batch tensors, padding every problem to the
+    largest S/T bucket in the batch and the batch to a mesh-size multiple."""
+    import numpy as np
+
+    S = max(p.shapes.shape[0] for p in problems)
+    T = max(p.totals.shape[0] for p in problems)
+    R = problems[0].shapes.shape[1]
+    B = len(problems)
+    Bpad = -(-B // mesh_size) * mesh_size
+
+    shapes = np.zeros((Bpad, S, R), np.int32)
+    counts = np.zeros((Bpad, S), np.int32)
+    totals = np.zeros((Bpad, T, R), np.int32)
+    reserved0 = np.zeros((Bpad, T, R), np.int32)
+    valid = np.zeros((Bpad, T), bool)
+    last_valid = np.zeros((Bpad,), np.int32)
+    pods_unit = np.ones((Bpad,), np.int32)
+    for b, p in enumerate(problems):
+        s, t = p.shapes.shape[0], p.totals.shape[0]
+        shapes[b, :s] = p.shapes
+        counts[b, :s] = p.counts
+        totals[b, :t] = p.totals
+        reserved0[b, :t] = p.reserved0
+        valid[b, :t] = p.valid
+        last_valid[b] = p.last_valid
+        pods_unit[b] = p.pods_unit
+    dropped = np.zeros_like(counts)
+    return shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit, B

@@ -1,0 +1,154 @@
+"""Controller manager: watch-driven reconcile loops.
+
+Reference: pkg/controllers/{manager.go,types.go}. Every controller exposes
+``kind()`` (what it watches) and ``reconcile(name, namespace) ->
+requeue_after_seconds | None``. The manager runs one watch pump per
+controller plus a worker pool draining a dedup-ing queue, with
+requeue-after timers — the controller-runtime workqueue model.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Protocol, Set, Tuple
+
+from karpenter_tpu.runtime.kubecore import KubeCore
+
+log = logging.getLogger("karpenter.manager")
+
+
+class Controller(Protocol):
+    def kind(self) -> str: ...
+
+    def reconcile(self, name: str, namespace: str = "default") -> Optional[float]: ...
+
+
+class _WorkQueue:
+    """Deduplicating work queue with delayed re-adds (the client-go
+    workqueue analog used throughout the reference)."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._pending: List[Tuple[str, str]] = []
+        self._in_set: Set[Tuple[str, str]] = set()
+        self._delayed: List[Tuple[float, Tuple[str, str]]] = []
+        self._shutdown = False
+
+    def add(self, item: Tuple[str, str]) -> None:
+        with self._lock:
+            if item not in self._in_set:
+                self._pending.append(item)
+                self._in_set.add(item)
+                self._lock.notify()
+
+    def add_after(self, item: Tuple[str, str], delay: float) -> None:
+        with self._lock:
+            heapq.heappush(self._delayed, (time.monotonic() + delay, item))
+            self._lock.notify()
+
+    def get(self, timeout: float = 0.2) -> Optional[Tuple[str, str]]:
+        with self._lock:
+            self._drain_delayed()
+            deadline = time.monotonic() + timeout
+            while not self._pending and not self._shutdown:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._lock.wait(timeout=min(remaining, self._next_delay()))
+                self._drain_delayed()
+            if self._shutdown and not self._pending:
+                return None
+            item = self._pending.pop(0)
+            self._in_set.discard(item)
+            return item
+
+    def _next_delay(self) -> float:
+        if not self._delayed:
+            return 0.2
+        return max(0.0, min(0.2, self._delayed[0][0] - time.monotonic()))
+
+    def _drain_delayed(self) -> None:
+        now = time.monotonic()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, item = heapq.heappop(self._delayed)
+            if item not in self._in_set:
+                self._pending.append(item)
+                self._in_set.add(item)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+
+class Manager:
+    """manager.go:NewManagerOrDie equivalent (no leader election needed for
+    a single in-process control plane; the state-in-API design makes
+    restart-resume free, SURVEY.md §5.4)."""
+
+    def __init__(self, kube: KubeCore):
+        self.kube = kube
+        self._controllers: List[Tuple[Controller, int]] = []
+        self._threads: List[threading.Thread] = []
+        self._queues: List[_WorkQueue] = []
+        self._stop = threading.Event()
+
+    def register(self, controller: Controller, workers: int = 1) -> None:
+        self._controllers.append((controller, workers))
+
+    def start(self) -> None:
+        for controller, workers in self._controllers:
+            wq = _WorkQueue()
+            self._queues.append(wq)
+            watch_q = self.kube.watch(controller.kind())
+
+            def pump(watch_q=watch_q, wq=wq):
+                while not self._stop.is_set():
+                    try:
+                        event = watch_q.get(timeout=0.2)
+                    except queue.Empty:
+                        continue
+                    meta = event.obj.metadata
+                    wq.add((meta.name, meta.namespace))
+
+            def work(controller=controller, wq=wq):
+                while not self._stop.is_set():
+                    item = wq.get(timeout=0.2)
+                    if item is None:
+                        continue
+                    name, namespace = item
+                    try:
+                        requeue = controller.reconcile(name, namespace)
+                    except Exception:
+                        log.exception("reconcile %s %s/%s failed",
+                                      controller.kind(), namespace, name)
+                        wq.add_after(item, 1.0)
+                        continue
+                    if requeue is not None:
+                        wq.add_after(item, requeue)
+
+            t = threading.Thread(target=pump, daemon=True,
+                                 name=f"pump-{controller.kind()}")
+            t.start()
+            self._threads.append(t)
+            for i in range(workers):
+                t = threading.Thread(target=work, daemon=True,
+                                     name=f"work-{controller.kind()}-{i}")
+                t.start()
+                self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for wq in self._queues:
+            wq.shutdown()
+        for controller, _ in self._controllers:
+            stop = getattr(controller, "stop_all", None)
+            if stop:
+                stop()
+
+    def healthz(self) -> bool:
+        return all(t.is_alive() for t in self._threads) if self._threads else True

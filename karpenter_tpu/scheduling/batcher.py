@@ -1,0 +1,75 @@
+"""Windowed pod batcher.
+
+Reference: pkg/controllers/provisioning/batcher.go. Separates a stream of
+add() calls into windows: 1 s idle / 10 s max / 2,000 items — but the item
+cap is configurable and defaults higher here because the TPU solver's cost
+is sublinear in pods (shape-deduped), removing the reference's memory-bound
+2k cap (SURVEY.md §5.7).
+
+Callers block on the gate returned by add(); the provisioning worker flushes
+the gate after a provisioning pass so selection reconcilers can re-verify.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, List, Optional, Tuple
+
+
+class Batcher:
+    def __init__(
+        self,
+        idle_seconds: float = 1.0,
+        max_seconds: float = 10.0,
+        max_items: int = 50_000,
+    ):
+        self.idle_seconds = idle_seconds
+        self.max_seconds = max_seconds
+        self.max_items = max_items
+        self._queue: "queue.Queue[Any]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._gate = threading.Event()
+        self._running = True
+
+    def add(self, item: Any) -> threading.Event:
+        """Enqueue an item; returns the gate event the caller may wait on
+        (batcher.go:61-69)."""
+        self._queue.put(item)
+        with self._lock:
+            return self._gate
+
+    def flush(self) -> None:
+        """Release all waiters and open a new gate (batcher.go:72-77)."""
+        with self._lock:
+            self._gate.set()
+            self._gate = threading.Event()
+
+    def stop(self) -> None:
+        self._running = False
+        self._queue.put(None)  # unblock wait()
+
+    def wait(self) -> Tuple[List[Any], float]:
+        """Collect one windowed batch (batcher.go:80-103): starts at the
+        first item; extends on arrivals up to idle/max/size limits."""
+        items: List[Any] = []
+        first = self._queue.get()
+        if first is None or not self._running:
+            return items, 0.0
+        items.append(first)
+        start = time.monotonic()
+        deadline = start + self.max_seconds
+        while self._running and len(items) < self.max_items:
+            now = time.monotonic()
+            timeout = min(self.idle_seconds, deadline - now)
+            if timeout <= 0:
+                break
+            try:
+                item = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                break
+            if item is None:
+                break
+            items.append(item)
+        return items, time.monotonic() - start

@@ -1,0 +1,72 @@
+"""Scheduler: constraint solve — group pods into isomorphic schedules.
+
+Reference: pkg/controllers/provisioning/scheduling/scheduler.go. Topology is
+injected first (as JIT node selectors), then pods group by
+hash(tightened constraints + GPU requests); each group bin-packs
+independently — which is exactly what makes the batch axis of the sharded
+device solver (parallel/sharded_pack.py) embarrassingly parallel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.api.core import Pod
+from karpenter_tpu.api.provisioner import Provisioner
+from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.utils import resources as res
+
+import logging
+
+log = logging.getLogger("karpenter.scheduler")
+
+
+@dataclass
+class Schedule:
+    """Equivalently-schedulable pods + their tightened constraints
+    (scheduler.go:53-57)."""
+
+    constraints: Constraints
+    pods: List[Pod] = field(default_factory=list)
+
+
+def _constraints_key(c: Constraints, gpu_requests) -> tuple:
+    """Structural hash of tightened constraints + GPU requests
+    (scheduler.go:100-110). SlicesAsSets semantics: order-insensitive."""
+    reqs = tuple(sorted(
+        (r.key, r.operator, tuple(sorted(r.values))) for r in c.requirements.items))
+    taints = tuple(sorted((t.key, t.value, t.effect) for t in c.taints))
+    labels = tuple(sorted(c.labels.items()))
+    gpus = tuple(sorted((k, q.nano) for k, q in gpu_requests.items()))
+    return (reqs, taints, labels, gpus)
+
+
+class Scheduler:
+    def __init__(self, kube: KubeCore):
+        self.kube = kube
+        self.topology = Topology(kube)
+
+    def solve(self, provisioner: Provisioner, pods: List[Pod]) -> List[Schedule]:
+        """scheduler.go:66-82."""
+        constraints = provisioner.spec.constraints.deepcopy()
+        self.topology.inject(constraints, pods)
+        return self._get_schedules(constraints, pods)
+
+    def _get_schedules(self, constraints: Constraints, pods: List[Pod]) -> List[Schedule]:
+        """scheduler.go:87-125."""
+        schedules: Dict[tuple, Schedule] = {}
+        for pod in pods:
+            err = constraints.validate_pod(pod)
+            if err is not None:
+                log.info("unable to schedule pod %s/%s: %s",
+                         pod.metadata.namespace, pod.metadata.name, err)
+                continue
+            tightened = constraints.tighten(pod)
+            key = _constraints_key(tightened, res.gpu_limits_for(pod))
+            if key not in schedules:
+                schedules[key] = Schedule(constraints=tightened, pods=[])
+            schedules[key].pods.append(pod)
+        return list(schedules.values())

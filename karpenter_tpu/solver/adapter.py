@@ -1,0 +1,180 @@
+"""Adapter: k8s objects + instance catalog → integer-vector packing problem.
+
+Mirrors PackablesFor (packable.go:44-91): viability validators, kubelet/system
+overhead reservation, daemonset overhead packing, and the GPU-class-aware
+ascending sort. Output feeds both the host oracle and the device encoder.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.api.core import Pod
+from karpenter_tpu.cloudprovider.spi import InstanceType
+from karpenter_tpu.solver.host_ffd import (
+    NUM_RESOURCES, Packable, R_AMD, R_CPU, R_EXOTIC, R_MEMORY, R_NEURON,
+    R_NVIDIA, R_POD_ENI, R_PODS, Vec, pack_one,
+)
+from karpenter_tpu.utils import resources as res
+
+_WELL_KNOWN_RESOURCE_INDEX = {
+    res.CPU: R_CPU,
+    res.MEMORY: R_MEMORY,
+    res.PODS: R_PODS,
+    res.NVIDIA_GPU: R_NVIDIA,
+    res.AMD_GPU: R_AMD,
+    res.AWS_NEURON: R_NEURON,
+    res.AWS_POD_ENI: R_POD_ENI,
+}
+
+
+def pod_vector(pod: Pod) -> Vec:
+    """Sum of container requests as an 8-dim nano-unit vector. Any request
+    outside the well-known seven maps onto the EXOTIC dimension (total is
+    always 0 there), reproducing Go's zero-value map lookup that makes such
+    pods unreservable (packable.go:157-167)."""
+    v = [0] * NUM_RESOURCES
+    for c in pod.spec.containers:
+        for name, q in c.resources.requests.items():
+            idx = _WELL_KNOWN_RESOURCE_INDEX.get(name)
+            if idx is None:
+                if q.nano > 0:
+                    v[R_EXOTIC] = 1
+            else:
+                v[idx] += q.nano
+    return tuple(v)
+
+
+def resource_list_vector(rl: res.ResourceList) -> Vec:
+    v = [0] * NUM_RESOURCES
+    for name, q in rl.items():
+        idx = _WELL_KNOWN_RESOURCE_INDEX.get(name)
+        if idx is None:
+            if q.nano > 0:
+                v[R_EXOTIC] = 1
+        else:
+            v[idx] += q.nano
+    return tuple(v)
+
+
+def instance_totals(it: InstanceType) -> Vec:
+    """PackableFor totals (packable.go:93-106)."""
+    v = [0] * NUM_RESOURCES
+    v[R_CPU] = it.cpu.nano
+    v[R_MEMORY] = it.memory.nano
+    v[R_PODS] = it.pods.nano
+    v[R_NVIDIA] = it.nvidia_gpus.nano
+    v[R_AMD] = it.amd_gpus.nano
+    v[R_NEURON] = it.aws_neurons.nano
+    v[R_POD_ENI] = it.aws_pod_eni.nano
+    return tuple(v)
+
+
+def _pods_require(pods: Sequence[Pod], resource_name: str) -> bool:
+    """requiresResource (packable.go:221-233): requests OR limits."""
+    for pod in pods:
+        for c in pod.spec.containers:
+            if resource_name in c.resources.requests or resource_name in c.resources.limits:
+                return True
+    return False
+
+
+def _validate(it: InstanceType, constraints: Constraints, pods: Sequence[Pod]) -> Optional[str]:
+    """Viability validators (packable.go:52-59,175-247). Returns reason or None.
+
+    Note: Go's sets.Has on a nil set is false, so an *unconstrained*
+    requirement rejects here — the provisioning controller always injects
+    the full universe of zones/types/arch/OS/capacity-types before solving
+    (provisioning/controller.go:141-162), and we preserve that contract.
+    """
+    reqs = constraints.requirements
+    # offerings: some offering's (capacity type, zone) allowed
+    cts, zones = reqs.capacity_types(), reqs.zones()
+    if not any(
+        (cts is not None and o.capacity_type in cts) and (zones is not None and o.zone in zones)
+        for o in it.offerings
+    ):
+        return "no viable offering"
+    its = reqs.instance_types()
+    if its is None or it.name not in its:
+        return "instance type not allowed"
+    archs = reqs.architectures()
+    if archs is None or it.architecture not in archs:
+        return "architecture not allowed"
+    oss = reqs.operating_systems()
+    if oss is None or not (set(it.operating_systems) & oss):
+        return "operating system not allowed"
+    # AWS pod ENI (packable.go:235-247): first requesting pod decides
+    if _pods_require(pods, res.AWS_POD_ENI) and it.aws_pod_eni.is_zero():
+        return "aws pod eni required"
+    # GPUs (packable.go:205-219): GPU classes are exclusive both ways
+    for name, qty in ((res.NVIDIA_GPU, it.nvidia_gpus), (res.AMD_GPU, it.amd_gpus),
+                      (res.AWS_NEURON, it.aws_neurons)):
+        required = _pods_require(pods, name)
+        if required and qty.is_zero():
+            return f"{name} is required"
+        if not required and not qty.is_zero():
+            return f"{name} is not required"
+    return None
+
+
+def _gpu_sort_cmp(a: Tuple[Vec, int], b: Tuple[Vec, int]) -> int:
+    """Ascending packable sort (packable.go:74-89): GPU-class equality gate,
+    then CPU, then memory; otherwise by GPU counts."""
+    av, bv = a[0], b[0]
+    if av[R_AMD] == bv[R_AMD] or av[R_NVIDIA] == bv[R_NVIDIA] or av[R_NEURON] == bv[R_NEURON]:
+        if av[R_CPU] == bv[R_CPU]:
+            return -1 if av[R_MEMORY] < bv[R_MEMORY] else (1 if av[R_MEMORY] > bv[R_MEMORY] else 0)
+        return -1 if av[R_CPU] < bv[R_CPU] else 1
+    if av[R_AMD] < bv[R_AMD] or av[R_NVIDIA] < bv[R_NVIDIA] or av[R_NEURON] < bv[R_NEURON]:
+        return -1
+    return 1
+
+
+@dataclass
+class PackingProblem:
+    """A fully-prepared problem: viable sorted packables + pod vectors."""
+
+    packables: List[Packable]  # sorted ascending; .index → instance_types row
+    instance_types: List[InstanceType]  # aligned with packable order
+    pod_vecs: List[Vec]
+    pod_ids: List[int]
+
+
+def build_packables(
+    instance_types: Sequence[InstanceType],
+    constraints: Constraints,
+    pods: Sequence[Pod],
+    daemons: Sequence[Pod],
+) -> Tuple[List[Packable], List[InstanceType]]:
+    """PackablesFor (packable.go:44-91): validate → reserve overhead → pack
+    daemons → sort ascending."""
+    daemon_vecs = [pod_vector(d) for d in daemons]
+    viable: List[Tuple[Vec, InstanceType, Packable]] = []
+    for it in instance_types:
+        if _validate(it, constraints, pods) is not None:
+            continue
+        totals = instance_totals(it)
+        p = Packable(index=-1, total=list(totals), reserved=[0] * NUM_RESOURCES)
+        # kubelet/system overhead (packable.go:63-66)
+        if not p.reserve(resource_list_vector(it.overhead)):
+            continue
+        # daemonset overhead (packable.go:67-71): all daemons must pack, in
+        # list order (the reference does not sort daemons)
+        if daemon_vecs:
+            r = pack_one(p, daemon_vecs, list(range(len(daemon_vecs))))
+            if r.unpacked:
+                continue
+        viable.append((totals, it, p))
+
+    viable.sort(key=functools.cmp_to_key(lambda a, b: _gpu_sort_cmp((a[0], 0), (b[0], 0))))
+    packables: List[Packable] = []
+    sorted_types: List[InstanceType] = []
+    for i, (_, it, p) in enumerate(viable):
+        p.index = i
+        packables.append(p)
+        sorted_types.append(it)
+    return packables, sorted_types

@@ -1,0 +1,92 @@
+"""Public solver entry: constraints + pods + catalog → node packings.
+
+The device path (models/ffd.py) is tried first; the host oracle
+(host_ffd.py) is both the fallback (exotic quantities, encode overflow,
+device errors — the "three rings" failure posture in SURVEY.md §5.3) and
+the differential-test reference.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.api.core import Pod
+from karpenter_tpu.cloudprovider.spi import InstanceType
+from karpenter_tpu.models.ffd import solve_ffd_device
+from karpenter_tpu.solver import host_ffd
+from karpenter_tpu.solver.adapter import build_packables, pod_vector
+
+log = logging.getLogger("karpenter.solver")
+
+
+@dataclass
+class SolverConfig:
+    use_device: bool = True
+    max_instance_types: int = host_ffd.MAX_INSTANCE_TYPES
+    chunk_iters: int = 64
+
+
+@dataclass
+class Packing:
+    """Mirror of binpacking.Packing (packer.go:73-77), with resolved objects."""
+
+    pods: List[List[Pod]]
+    instance_type_options: List[InstanceType]
+    node_quantity: int = 1
+
+
+@dataclass
+class SolveResult:
+    packings: List[Packing] = field(default_factory=list)
+    unschedulable: List[Pod] = field(default_factory=list)
+
+    @property
+    def node_count(self) -> int:
+        return sum(p.node_quantity for p in self.packings)
+
+
+def solve(
+    constraints: Constraints,
+    pods: Sequence[Pod],
+    instance_types: Sequence[InstanceType],
+    daemons: Sequence[Pod] = (),
+    config: Optional[SolverConfig] = None,
+) -> SolveResult:
+    config = config or SolverConfig()
+    packables, sorted_types = build_packables(instance_types, constraints, pods, daemons)
+    if not packables:
+        log.error("no viable instance type options for %d pods", len(pods))
+        return SolveResult(packings=[], unschedulable=[])
+
+    pod_vecs = [pod_vector(p) for p in pods]
+    pod_ids = list(range(len(pods)))
+
+    result = None
+    if config.use_device:
+        try:
+            result = solve_ffd_device(
+                pod_vecs, pod_ids, packables,
+                max_instance_types=config.max_instance_types,
+                chunk_iters=config.chunk_iters)
+        except Exception:  # device failure ring: never drop a provisioning loop
+            log.exception("device solve failed; falling back to host FFD")
+            result = None
+    if result is None:
+        result = host_ffd.pack(pod_vecs, pod_ids, packables,
+                               max_instance_types=config.max_instance_types)
+
+    packings = [
+        Packing(
+            pods=[[pods[i] for i in node] for node in hp.pod_ids],
+            instance_type_options=[sorted_types[j] for j in hp.instance_type_indices],
+            node_quantity=hp.node_quantity,
+        )
+        for hp in result.packings
+    ]
+    return SolveResult(
+        packings=packings,
+        unschedulable=[pods[i] for i in result.unschedulable],
+    )

@@ -57,14 +57,14 @@ class Quantity:
         if not m:
             raise ValueError(f"cannot parse quantity {s!r}")
         num, suffix = m.group(1), m.group(2) or ""
+        if suffix in _BIN_SUFFIX:  # before the exponent branch: "Ei" is exbi
+            return Quantity(_decimal_to_nano(num, _BIN_SUFFIX[suffix]), suffix)
         if suffix[:1] in ("e", "E") and len(suffix) > 1:
             # scientific notation (k8s decimalExponent) — exact integer math
             exp = int(suffix[1:])
             if exp >= 0:
                 return Quantity(_decimal_to_nano(num, 10**exp), "")
             return Quantity(_decimal_to_nano(num, 1, 10**-exp), "")
-        if suffix in _BIN_SUFFIX:
-            return Quantity(_decimal_to_nano(num, _BIN_SUFFIX[suffix]), suffix)
         mult = _DEC_SUFFIX[suffix]
         if isinstance(mult, float):  # n/u/m
             denom = {"n": 10**9, "u": 10**6, "m": 10**3}[suffix]
@@ -197,15 +197,16 @@ def pod_limits(pod) -> ResourceList:
     return merge(*[c.resources.limits for c in pod.spec.containers])
 
 
+_GPU_RESOURCES = (NVIDIA_GPU, AMD_GPU, AWS_NEURON)
+
+
 def gpu_limits_for(pod) -> ResourceList:
     """GPU-class limits on a pod (resources.go GPULimitsFor): used to split
     schedules by accelerator demand."""
-    out: ResourceList = {}
-    for c in pod.spec.containers:
-        for name, q in c.resources.limits.items():
-            if name in (NVIDIA_GPU, AMD_GPU, AWS_NEURON):
-                out[name] = out.get(name, Quantity(0)).add(q)
-    return out
+    return merge(*(
+        {n: q for n, q in c.resources.limits.items() if n in _GPU_RESOURCES}
+        for c in pod.spec.containers
+    ))
 
 
 def quantity(v: Union[str, int, float, Quantity]) -> Quantity:

@@ -6,12 +6,31 @@ XLA's host platform with 8 virtual devices. This must run before jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The environment's TPU plugin (axon) registers itself at interpreter start
+# via sitecustomize and ignores JAX_PLATFORMS; initializing it opens a
+# network tunnel that can block the whole test run. Deregister its backend
+# factory before any backend is initialized so tests are deterministic,
+# CPU-only, and tunnel-free.
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
+
+
+def cpu_mesh_devices(n: int):
+    devs = jax.devices("cpu")
+    assert len(devs) >= n, f"need {n} cpu devices, have {len(devs)}"
+    return devs[:n]
 
 
 @pytest.fixture(autouse=True)

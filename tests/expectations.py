@@ -1,0 +1,104 @@
+"""Expectation DSL for controller tests.
+
+Reference: pkg/test/expectations/expectations.go — drives selection +
+provisioning deterministically against the in-memory API server, plus
+fixture builders (pkg/test/pods.go).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.core import (
+    Container, ObjectMeta, OwnerReference, Pod, PodCondition, PodSpec, PodStatus,
+    ResourceRequirements, Toleration,
+)
+from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
+
+
+def unschedulable_pod(
+    requests: Optional[Dict[str, str]] = None,
+    node_selector: Optional[Dict[str, str]] = None,
+    tolerations: Optional[List[Toleration]] = None,
+    name: Optional[str] = None,
+    namespace: str = "default",
+    **spec_kwargs,
+) -> Pod:
+    """test.UnschedulablePod (pods.go:84-96): pending + Unschedulable
+    condition so the selection controller picks it up."""
+    return Pod(
+        metadata=ObjectMeta(name=name or f"pod-{uuid.uuid4().hex[:8]}",
+                            namespace=namespace, uid=uuid.uuid4().hex),
+        spec=PodSpec(
+            node_selector=node_selector or {},
+            tolerations=tolerations or [],
+            containers=[Container(resources=ResourceRequirements.make(
+                requests=requests or {"cpu": "1", "memory": "512Mi"}))],
+            **spec_kwargs,
+        ),
+        status=PodStatus(phase="Pending", conditions=[
+            PodCondition(type="PodScheduled", status="False", reason="Unschedulable")]),
+    )
+
+
+def daemonset_pod_owned(requests: Dict[str, str], name: str = "ds-pod") -> Pod:
+    pod = unschedulable_pod(requests=requests, name=name)
+    pod.metadata.owner_references.append(
+        OwnerReference(kind="DaemonSet", name="ds", controller=True))
+    return pod
+
+
+def make_provisioner(name: str = "default", constraints: Optional[Constraints] = None,
+                     **spec_kwargs) -> Provisioner:
+    return Provisioner(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=ProvisionerSpec(constraints=constraints or Constraints(), **spec_kwargs),
+    )
+
+
+def expect_provisioned(kube: KubeCore, selection, provisioning, pods: List[Pod],
+                       timeout: float = 15.0) -> List[Pod]:
+    """ExpectProvisioned (expectations.go): create pods, drive selection
+    reconciles concurrently (each blocks on the batch gate), wait for the
+    provisioning worker to bind, return the stored pods."""
+    for pod in pods:
+        kube.create(pod)
+    with ThreadPoolExecutor(max_workers=max(1, len(pods))) as pool:
+        futures = [
+            pool.submit(selection.reconcile, p.metadata.name, p.metadata.namespace)
+            for p in pods
+        ]
+        for f in futures:
+            f.result(timeout=timeout)
+    return [kube.get("Pod", p.metadata.name, p.metadata.namespace) for p in pods]
+
+
+def expect_scheduled(kube: KubeCore, pod: Pod) -> str:
+    stored = kube.get("Pod", pod.metadata.name, pod.metadata.namespace)
+    assert stored.spec.node_name, f"pod {pod.metadata.name} not scheduled"
+    return stored.spec.node_name
+
+
+def expect_not_scheduled(kube: KubeCore, pod: Pod) -> None:
+    stored = kube.get("Pod", pod.metadata.name, pod.metadata.namespace)
+    assert not stored.spec.node_name, (
+        f"pod {pod.metadata.name} unexpectedly scheduled to {stored.spec.node_name}")
+
+
+def eventually(fn, timeout: float = 10.0, interval: float = 0.05):
+    """ExpectEventually-style poller (expectations.go:41-44)."""
+    deadline = time.monotonic() + timeout
+    last_err = None
+    while time.monotonic() < deadline:
+        try:
+            return fn()
+        except AssertionError as e:
+            last_err = e
+            time.sleep(interval)
+    raise last_err or AssertionError("eventually timed out")

@@ -1,0 +1,166 @@
+"""Differential tests: TPU pack kernel vs host FFD oracle.
+
+Node count must match EXACTLY (stronger than the ±1 target in BASELINE.md);
+pod coverage and instance options must be identical packing-for-packing.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.api.core import (
+    Container, NodeSelectorRequirement as Req, Pod, PodSpec, ResourceRequirements,
+)
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.cloudprovider.fake.provider import instance_types, make_instance_type
+from karpenter_tpu.models.ffd import solve_ffd_device, solve_ffd_numpy
+from karpenter_tpu.solver import host_ffd
+from karpenter_tpu.solver.adapter import build_packables, pod_vector
+
+
+def allow_all_constraints(catalog):
+    """Inject the full universe of well-known requirements, as the
+    provisioning controller does (controller.go:141-162)."""
+    zones, names, archs, oss, cts = set(), set(), set(), set(), set()
+    for it in catalog:
+        names.add(it.name)
+        archs.add(it.architecture)
+        oss |= set(it.operating_systems)
+        for o in it.offerings:
+            zones.add(o.zone)
+            cts.add(o.capacity_type)
+    return Constraints(requirements=Requirements().add(
+        Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In", values=sorted(zones)),
+        Req(key=wellknown.LABEL_INSTANCE_TYPE, operator="In", values=sorted(names)),
+        Req(key=wellknown.LABEL_ARCH, operator="In", values=sorted(archs)),
+        Req(key=wellknown.LABEL_OS, operator="In", values=sorted(oss)),
+        Req(key=wellknown.LABEL_CAPACITY_TYPE, operator="In", values=sorted(cts)),
+    ))
+
+
+def make_pod(requests, limits=None):
+    return Pod(spec=PodSpec(containers=[
+        Container(resources=ResourceRequirements.make(requests=requests, limits=limits))]))
+
+
+def solve_both(pods, catalog, daemons=()):
+    constraints = allow_all_constraints(catalog)
+    packables, _ = build_packables(catalog, constraints, pods, daemons)
+    vecs = [pod_vector(p) for p in pods]
+    ids = list(range(len(pods)))
+    host = host_ffd.pack(vecs, ids, packables)
+    device = solve_ffd_device(vecs, ids, packables)
+    assert device is not None, "device path must encode this problem"
+    # the numpy kernel-mirror must agree too (it is the 50k-scale oracle)
+    numpy_result = solve_ffd_numpy(vecs, ids, packables)
+    assert numpy_result is not None
+    assert numpy_result.node_count == host.node_count
+    assert sorted(numpy_result.unschedulable) == sorted(host.unschedulable)
+    return host, device
+
+
+def assert_parity(host, device, n_pods):
+    assert device.node_count == host.node_count
+    # identical packing structure: same (options, node_quantity) multiset
+    h = sorted((tuple(p.instance_type_indices), p.node_quantity) for p in host.packings)
+    d = sorted((tuple(p.instance_type_indices), p.node_quantity) for p in device.packings)
+    assert d == h
+    # identical unschedulable sets and full pod coverage
+    assert sorted(device.unschedulable) == sorted(host.unschedulable)
+    covered = sorted(i for p in device.packings for node in p.pod_ids for i in node)
+    covered_h = sorted(i for p in host.packings for node in p.pod_ids for i in node)
+    assert len(covered) == len(set(covered))
+    assert len(covered) + len(device.unschedulable) == n_pods
+    assert len(covered_h) + len(host.unschedulable) == n_pods
+
+
+class TestParitySmoke:
+    def test_homogeneous_pods(self):
+        pods = [make_pod({"cpu": "1", "memory": "512Mi"}) for _ in range(100)]
+        host, device = solve_both(pods, instance_types(10))
+        assert_parity(host, device, 100)
+        assert host.node_count > 0
+
+    def test_reference_benchmark_fixture(self):
+        # packer_test.go:33-74: 10k pods of 1 CPU/512Mi × 100 synthetic types
+        pods = [make_pod({"cpu": "1", "memory": "512Mi"}) for _ in range(10_000)]
+        host, device = solve_both(pods, instance_types(100))
+        assert_parity(host, device, 10_000)
+
+    def test_mixed_sizes(self):
+        pods = (
+            [make_pod({"cpu": "250m", "memory": "128Mi"}) for _ in range(40)]
+            + [make_pod({"cpu": "2", "memory": "4Gi"}) for _ in range(7)]
+            + [make_pod({"cpu": "500m", "memory": "1Gi"}) for _ in range(21)]
+        )
+        host, device = solve_both(pods, instance_types(20))
+        assert_parity(host, device, len(pods))
+
+    def test_unschedulable_oversized(self):
+        pods = [make_pod({"cpu": "100", "memory": "4Gi"}) for _ in range(3)]
+        host, device = solve_both(pods, instance_types(5))
+        assert_parity(host, device, 3)
+        assert len(device.unschedulable) == 3
+
+    def test_exotic_resource_never_packs(self):
+        pods = [make_pod({"cpu": "1", "example.com/widget": "1"}) for _ in range(4)]
+        host, device = solve_both(pods, instance_types(5))
+        assert_parity(host, device, 4)
+        assert len(device.unschedulable) == 4
+
+    def test_gpu_pods_pack_on_gpu_type_only(self):
+        catalog = [
+            make_instance_type("cpu-type", cpu="8", memory="16Gi", pods="20"),
+            make_instance_type("gpu-type", cpu="8", memory="16Gi", pods="20", nvidia_gpus="4"),
+        ]
+        pods = [make_pod({"cpu": "1", "nvidia.com/gpu": "1"}) for _ in range(8)]
+        host, device = solve_both(pods, catalog)
+        assert_parity(host, device, 8)
+        assert device.node_count == 2  # 4 GPUs per node
+        for p in device.packings:
+            assert all(i == 0 for i in p.instance_type_indices)  # only gpu-type viable
+
+    def test_daemon_overhead(self):
+        daemons = [make_pod({"cpu": "500m", "memory": "256Mi"})]
+        pods = [make_pod({"cpu": "1", "memory": "512Mi"}) for _ in range(50)]
+        host, device = solve_both(pods, instance_types(10), daemons)
+        assert_parity(host, device, 50)
+
+    def test_empty_pods(self):
+        host, device = solve_both([], instance_types(5))
+        assert device.node_count == 0
+        assert host.node_count == 0
+
+    def test_pods_dimension_binds(self):
+        # tiny pods: the pods-per-node cap is the binding constraint
+        pods = [make_pod({"cpu": "10m", "memory": "8Mi"}) for _ in range(500)]
+        host, device = solve_both(pods, instance_types(3))
+        assert_parity(host, device, 500)
+
+
+class TestParityFuzz:
+    @pytest.mark.parametrize("seed", range(12))
+    def test_random_problems(self, seed):
+        rng = random.Random(seed)
+        n_types = rng.randint(1, 30)
+        catalog = instance_types(n_types)
+        if rng.random() < 0.4:
+            catalog.append(make_instance_type(
+                "gpu-extra", cpu="16", memory="32Gi", pods="40", nvidia_gpus="8"))
+        pods = []
+        n_pods = rng.randint(1, 400)
+        kinds = rng.randint(1, 8)
+        shapes = []
+        for _ in range(kinds):
+            shapes.append({
+                "cpu": f"{rng.choice([100, 250, 500, 1000, 1500, 2000, 4000, 64000])}m",
+                "memory": f"{rng.choice([64, 128, 256, 512, 1024, 3072, 8192])}Mi",
+            })
+            if rng.random() < 0.2:
+                shapes[-1]["nvidia.com/gpu"] = str(rng.randint(1, 2))
+        for _ in range(n_pods):
+            pods.append(make_pod(dict(rng.choice(shapes))))
+        host, device = solve_both(pods, catalog)
+        assert_parity(host, device, n_pods)

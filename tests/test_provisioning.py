@@ -1,0 +1,158 @@
+"""End-to-end provisioning: pending pods → TPU solve → nodes created →
+pods bound. Mirrors provisioning/suite_test.go ("should provision nodes").
+"""
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.constraints import Constraints, Limits, Taints
+from karpenter_tpu.api.core import (
+    DaemonSet, DaemonSetSpec, NodeSelectorRequirement as Req, ObjectMeta,
+    PodTemplateSpec, PodSpec, Container, ResourceRequirements, Taint, Toleration,
+)
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.cloudprovider.fake.provider import FakeCloudProvider, instance_types
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.scheduling.batcher import Batcher
+from karpenter_tpu.utils.resources import parse_resource_list
+
+from tests.expectations import (
+    daemonset_pod_owned, expect_not_scheduled, expect_provisioned,
+    expect_scheduled, make_provisioner, unschedulable_pod,
+)
+
+
+@pytest.fixture()
+def env():
+    kube = KubeCore()
+    provider = FakeCloudProvider(catalog=instance_types(10))
+    provisioning = ProvisioningController(
+        kube, provider,
+        batcher_factory=lambda: Batcher(idle_seconds=0.05, max_seconds=2.0))
+    selection = SelectionController(kube, provisioning)
+    yield kube, provider, provisioning, selection
+    for w in provisioning.workers.values():
+        w.stop()
+
+
+def setup_provisioner(kube, provisioning, **spec_kwargs):
+    provisioner = make_provisioner(**spec_kwargs)
+    kube.create(provisioner)
+    provisioning.reconcile(provisioner.metadata.name)
+    return provisioner
+
+
+class TestProvisioning:
+    def test_should_provision_nodes(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pods = [unschedulable_pod() for _ in range(5)]
+        expect_provisioned(kube, selection, provisioning, pods)
+        for pod in pods:
+            expect_scheduled(kube, pod)
+        assert len(provider.created) >= 1
+        node = kube.get("Node", provider.created[0].metadata.name, "")
+        assert wellknown.TERMINATION_FINALIZER in node.metadata.finalizers
+        assert any(t.key == wellknown.NOT_READY_TAINT_KEY for t in node.spec.taints)
+        assert node.metadata.labels[wellknown.PROVISIONER_NAME_LABEL] == "default"
+
+    def test_groups_pods_onto_shared_nodes(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pods = [unschedulable_pod(requests={"cpu": "100m", "memory": "64Mi"})
+                for _ in range(20)]
+        expect_provisioned(kube, selection, provisioning, pods)
+        nodes = {expect_scheduled(kube, p) for p in pods}
+        # 20 tiny pods need far fewer than 20 nodes
+        assert 1 <= len(nodes) < 10
+
+    def test_ignores_daemonset_owned_pods(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pod = daemonset_pod_owned({"cpu": "1"})
+        kube.create(pod)
+        selection.reconcile(pod.metadata.name)
+        expect_not_scheduled(kube, pod)
+        assert provider.created == []
+
+    def test_respects_node_selector_zone(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pods = [unschedulable_pod(node_selector={
+            wellknown.LABEL_TOPOLOGY_ZONE: "test-zone-2"})]
+        expect_provisioned(kube, selection, provisioning, pods)
+        node_name = expect_scheduled(kube, pods[0])
+        node = kube.get("Node", node_name, "")
+        assert node.metadata.labels[wellknown.LABEL_TOPOLOGY_ZONE] == "test-zone-2"
+
+    def test_rejects_unknown_node_selector(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pod = unschedulable_pod(node_selector={"unknown-label": "x"})
+        kube.create(pod)
+        selection.reconcile(pod.metadata.name)
+        expect_not_scheduled(kube, pod)
+
+    def test_taints_block_intolerant_pods(self, env):
+        kube, provider, provisioning, selection = env
+        constraints = Constraints(taints=Taints([Taint(key="dedicated", value="ml",
+                                                       effect="NoSchedule")]))
+        setup_provisioner(kube, provisioning, constraints=constraints)
+        intolerant = unschedulable_pod()
+        tolerant = unschedulable_pod(tolerations=[
+            Toleration(key="dedicated", operator="Equal", value="ml",
+                       effect="NoSchedule")])
+        kube.create(intolerant)
+        selection.reconcile(intolerant.metadata.name)
+        expect_not_scheduled(kube, intolerant)
+        expect_provisioned(kube, selection, provisioning, [tolerant])
+        expect_scheduled(kube, tolerant)
+
+    def test_limits_cap_provisioning(self, env):
+        kube, provider, provisioning, selection = env
+        provisioner = make_provisioner(
+            limits=Limits(resources=parse_resource_list({"cpu": "1"})))
+        # simulate counter controller: usage already at the cap
+        provisioner.status.resources = parse_resource_list({"cpu": "10"})
+        kube.create(provisioner)
+        provisioning.reconcile(provisioner.metadata.name)
+        pods = [unschedulable_pod()]
+        expect_provisioned(kube, selection, provisioning, pods)
+        expect_not_scheduled(kube, pods[0])
+        assert provider.created == []
+
+    def test_daemonset_overhead_accounted(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        kube.create(DaemonSet(
+            metadata=ObjectMeta(name="logging"),
+            spec=DaemonSetSpec(template=PodTemplateSpec(spec=PodSpec(containers=[
+                Container(resources=ResourceRequirements.make(
+                    requests={"cpu": "500m", "memory": "256Mi"}))])))))
+        pods = [unschedulable_pod(requests={"cpu": "1", "memory": "512Mi"})]
+        expect_provisioned(kube, selection, provisioning, pods)
+        expect_scheduled(kube, pods[0])
+
+    def test_deleted_pod_not_provisioned(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pod = unschedulable_pod()
+        # never created in the API: the provisionability re-check drops it
+        kube.create(pod)
+        kube.delete("Pod", pod.metadata.name, pod.metadata.namespace)
+        selection.reconcile(pod.metadata.name)
+        assert provider.created == []
+
+    def test_multiple_provisioners_first_match_wins(self, env):
+        kube, provider, provisioning, selection = env
+        c1 = Constraints(taints=Taints([Taint(key="a", value="1", effect="NoSchedule")]))
+        p1 = make_provisioner(name="tainted", constraints=c1)
+        kube.create(p1)
+        provisioning.reconcile("tainted")
+        setup_provisioner(kube, provisioning, name="open")
+        pods = [unschedulable_pod()]
+        expect_provisioned(kube, selection, provisioning, pods)
+        node = kube.get("Node", expect_scheduled(kube, pods[0]), "")
+        assert node.metadata.labels[wellknown.PROVISIONER_NAME_LABEL] == "open"

@@ -1,0 +1,47 @@
+"""Sharded batch solve on a virtual 8-device CPU mesh."""
+
+import numpy as np
+
+from tests.conftest import cpu_mesh_devices
+from karpenter_tpu.cloudprovider.fake.provider import instance_types
+from karpenter_tpu.ops.encode import encode
+from karpenter_tpu.parallel.mesh import solver_mesh
+from karpenter_tpu.parallel.sharded_pack import pack_batch_sharded, pad_problems
+from karpenter_tpu.solver import host_ffd
+from karpenter_tpu.solver.adapter import build_packables, pod_vector
+from tests.test_pack_parity import allow_all_constraints, make_pod
+
+
+def encode_problem(n_pods, cpu_m, n_types):
+    pods = [make_pod({"cpu": f"{cpu_m}m", "memory": "256Mi"}) for _ in range(n_pods)]
+    catalog = instance_types(n_types)
+    constraints = allow_all_constraints(catalog)
+    packables, _ = build_packables(catalog, constraints, pods, [])
+    vecs = [pod_vector(p) for p in pods]
+    ids = list(range(len(pods)))
+    order = sorted(range(len(ids)), key=lambda i: tuple(-v for v in vecs[i]))
+    enc = encode([vecs[i] for i in order], [ids[i] for i in order], packables)
+    assert enc is not None
+    return enc, vecs, ids, packables
+
+
+def test_batch_sharded_matches_host():
+    mesh = solver_mesh(cpu_mesh_devices(8))
+    problems, hosts = [], []
+    for b in range(8):
+        enc, vecs, ids, packables = encode_problem(
+            n_pods=20 + 13 * b, cpu_m=250 + 250 * (b % 3), n_types=4 + b)
+        problems.append(enc)
+        hosts.append(host_ffd.pack(vecs, ids, packables))
+
+    shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit, B = (
+        pad_problems(problems, mesh.devices.size))
+    out = pack_batch_sharded(
+        shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
+        num_iters=64, mesh=mesh)
+    counts_f, dropped_f, done_f, chosen_seq, q_seq, packed_seq = map(np.asarray, out)
+
+    assert done_f.all()
+    for b in range(B):
+        node_count = int(q_seq[b][q_seq[b] > 0].sum())
+        assert node_count == hosts[b].node_count, f"problem {b}"
