@@ -25,13 +25,9 @@ TARGET_MS = 200.0
 
 
 def build_workload():
-    from karpenter_tpu.api import wellknown
-    from karpenter_tpu.api.constraints import Constraints
-    from karpenter_tpu.api.core import (
-        Container, NodeSelectorRequirement as Req, Pod, PodSpec, ResourceRequirements,
-    )
-    from karpenter_tpu.api.requirements import Requirements
+    from karpenter_tpu.api.core import Container, Pod, PodSpec, ResourceRequirements
     from karpenter_tpu.cloudprovider.fake.provider import make_instance_type
+    from karpenter_tpu.controllers.provisioning import universe_constraints
 
     # 400-type synthetic EC2-like catalog: cpu × memory-ratio grid
     catalog = []
@@ -47,22 +43,7 @@ def build_workload():
             pods=str(min(110, cpu * 15)),
         ))
         i += 1
-
-    zones, names, archs, oss, cts = set(), set(), set(), set(), set()
-    for it in catalog:
-        names.add(it.name)
-        archs.add(it.architecture)
-        oss |= set(it.operating_systems)
-        for o in it.offerings:
-            zones.add(o.zone)
-            cts.add(o.capacity_type)
-    constraints = Constraints(requirements=Requirements().add(
-        Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In", values=sorted(zones)),
-        Req(key=wellknown.LABEL_INSTANCE_TYPE, operator="In", values=sorted(names)),
-        Req(key=wellknown.LABEL_ARCH, operator="In", values=sorted(archs)),
-        Req(key=wellknown.LABEL_OS, operator="In", values=sorted(oss)),
-        Req(key=wellknown.LABEL_CAPACITY_TYPE, operator="In", values=sorted(cts)),
-    ))
+    constraints = universe_constraints(catalog)
 
     # 50k mixed pods across 32 recurring request shapes
     shapes = []

@@ -221,6 +221,7 @@ class NodeSpec:
 class NodeCondition:
     type: str = ""
     status: str = "Unknown"
+    reason: str = ""
     last_heartbeat_time: Optional[float] = None
 
 

@@ -1,0 +1,87 @@
+"""Process options: flags + environment.
+
+Reference: pkg/utils/options/options.go:33-76. Flags fall back to
+KARPENTER_-prefixed environment variables; validation mirrors the
+reference's required-field and port checks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class Options:
+    cluster_name: str = ""
+    cluster_endpoint: str = ""
+    metrics_port: int = 8080
+    health_probe_port: int = 8081
+    webhook_port: int = 8443
+    kube_client_qps: int = 200
+    kube_client_burst: int = 300
+    cloud_provider: str = "fake"
+    # batching (batcher.go:23-28 defaults; max_items raised — see batcher.py)
+    batch_idle_seconds: float = 1.0
+    batch_max_seconds: float = 10.0
+    batch_max_items: int = 50_000
+    # solver
+    solver_use_device: bool = True
+
+    def validate(self) -> List[str]:
+        errs = []
+        if not self.cluster_name:
+            errs.append("cluster-name is required")
+        if not self.cluster_endpoint:
+            errs.append("cluster-endpoint is required")
+        for name, port in (("metrics-port", self.metrics_port),
+                           ("health-probe-port", self.health_probe_port),
+                           ("webhook-port", self.webhook_port)):
+            if not (0 < port < 65536):
+                errs.append(f"{name} out of range: {port}")
+        return errs
+
+
+def _env(name: str, default):
+    v = os.environ.get(f"KARPENTER_{name.upper().replace('-', '_')}")
+    if v is None:
+        return default
+    if isinstance(default, bool):
+        return v.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(v)
+    if isinstance(default, float):
+        return float(v)
+    return v
+
+
+def parse(argv: Optional[List[str]] = None) -> Options:
+    defaults = Options()
+    p = argparse.ArgumentParser("karpenter-tpu")
+    p.add_argument("--cluster-name", default=_env("cluster-name", defaults.cluster_name))
+    p.add_argument("--cluster-endpoint",
+                   default=_env("cluster-endpoint", defaults.cluster_endpoint))
+    p.add_argument("--metrics-port", type=int,
+                   default=_env("metrics-port", defaults.metrics_port))
+    p.add_argument("--health-probe-port", type=int,
+                   default=_env("health-probe-port", defaults.health_probe_port))
+    p.add_argument("--webhook-port", type=int,
+                   default=_env("webhook-port", defaults.webhook_port))
+    p.add_argument("--kube-client-qps", type=int,
+                   default=_env("kube-client-qps", defaults.kube_client_qps))
+    p.add_argument("--kube-client-burst", type=int,
+                   default=_env("kube-client-burst", defaults.kube_client_burst))
+    p.add_argument("--cloud-provider",
+                   default=_env("cloud-provider", defaults.cloud_provider))
+    p.add_argument("--batch-idle-seconds", type=float,
+                   default=_env("batch-idle-seconds", defaults.batch_idle_seconds))
+    p.add_argument("--batch-max-seconds", type=float,
+                   default=_env("batch-max-seconds", defaults.batch_max_seconds))
+    p.add_argument("--batch-max-items", type=int,
+                   default=_env("batch-max-items", defaults.batch_max_items))
+    p.add_argument("--solver-use-device", action="store_true",
+                   default=_env("solver-use-device", defaults.solver_use_device))
+    ns = p.parse_args(argv)
+    return Options(**{k.replace("-", "_"): v for k, v in vars(ns).items()})

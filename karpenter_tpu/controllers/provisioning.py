@@ -247,6 +247,22 @@ class ProvisioningController:
             self._hashes[name] = key
         return float(self.REQUEUE_SECONDS)
 
+    def stop_all(self) -> None:
+        """Stop every worker thread (called by Manager.stop)."""
+        with self._lock:
+            workers = list(self.workers.values())
+            self.workers.clear()
+            self._hashes.clear()
+        for w in workers:
+            w.stop()
+
+
+def universe_constraints(catalog: List[InstanceType]) -> Constraints:
+    """Constraints admitting everything the catalog offers — the same
+    universe injection the controller performs (controller.go:141-162).
+    Shared by tests/bench so fixtures can't drift from the production path."""
+    return Constraints(requirements=global_requirements(catalog))
+
 
 def _spec_hash(p: Provisioner) -> tuple:
     c = p.spec.constraints

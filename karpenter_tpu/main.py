@@ -1,0 +1,118 @@
+"""Controller-plane entrypoint.
+
+Reference: cmd/controller/main.go — builds the cloud provider via the
+registry, wires the eight controllers into the manager, and serves
+/metrics, /healthz and /readyz. Run as ``python -m karpenter_tpu.main``.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from karpenter_tpu.cloudprovider import spi
+from karpenter_tpu.cloudprovider.fake import provider as _fake  # registers "fake"
+from karpenter_tpu.config.options import Options, parse
+from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.metrics_controllers import (
+    NodeMetricsController, PodMetricsController,
+)
+from karpenter_tpu.controllers.node import NodeController
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.pvc import PVCController
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.metrics import registry
+from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.runtime.manager import Manager
+from karpenter_tpu.scheduling.batcher import Batcher
+from karpenter_tpu.solver.solve import SolverConfig
+
+log = logging.getLogger("karpenter")
+
+
+def build_manager(kube: KubeCore, options: Options) -> Manager:
+    """Register the eight controllers (cmd/controller/main.go:89-98)."""
+    cloud_provider = spi.resolve(options.cloud_provider)
+    provisioning = ProvisioningController(
+        kube, cloud_provider,
+        solver_config=SolverConfig(use_device=options.solver_use_device),
+        batcher_factory=lambda: Batcher(
+            idle_seconds=options.batch_idle_seconds,
+            max_seconds=options.batch_max_seconds,
+            max_items=options.batch_max_items))
+    manager = Manager(kube)
+    manager.register(provisioning)
+    manager.register(SelectionController(kube, provisioning), workers=64)
+    manager.register(NodeController(kube), workers=10)
+    manager.register(TerminationController(kube, cloud_provider), workers=10)
+    manager.register(CounterController(kube))
+    manager.register(PVCController(kube))
+    manager.register(NodeMetricsController(kube))
+    manager.register(PodMetricsController(kube))
+    return manager
+
+
+class _Handler(BaseHTTPRequestHandler):
+    manager: Optional[Manager] = None
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            body = registry.DEFAULT.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+        elif self.path in ("/healthz", "/readyz"):
+            ok = self.manager is None or self.manager.healthz()
+            body = b"ok" if ok else b"unhealthy"
+            self.send_response(200 if ok else 500)
+            self.send_header("Content-Type", "text/plain")
+        else:
+            body = b"not found"
+            self.send_response(404)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+def serve_observability(manager: Manager, port: int) -> ThreadingHTTPServer:
+    handler = type("Handler", (_Handler,), {"manager": manager})
+    server = ThreadingHTTPServer(("0.0.0.0", port), handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="observability").start()
+    return server
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    options = parse(argv)
+    errs = options.validate()
+    if errs:
+        for e in errs:
+            log.error("invalid options: %s", e)
+        return 1
+    kube = KubeCore()
+    manager = build_manager(kube, options)
+    server = serve_observability(manager, options.metrics_port)
+    manager.start()
+    log.info("karpenter-tpu started (cluster=%s, metrics=:%d)",
+             options.cluster_name, options.metrics_port)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        manager.stop()
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,27 +14,13 @@ import numpy as np
 
 from karpenter_tpu.ops.encode import EncodedProblem, encode
 from karpenter_tpu.solver.host_ffd import (
-    HostPacking, HostSolveResult, MAX_INSTANCE_TYPES, Packable, R_MEMORY,
-    R_PODS, Vec,
+    HostPacking, HostSolveResult, MAX_INSTANCE_TYPES, Packable, Vec,
+    instance_options,
 )
 
 DEFAULT_CHUNK_ITERS = 64
 MAX_CHUNKS = 4096  # hard safety valve; each iteration provably makes progress
 _INT32_MAX = 2**31 - 1
-
-
-def instance_options(packables: Sequence[Packable], chosen: int,
-                     max_instance_types: int = MAX_INSTANCE_TYPES) -> List[int]:
-    """Viable instance-type options for a node packed on ``chosen``
-    (packer.go:184-191): the next ≤20 ascending types with memory and pods
-    not smaller than the chosen type's."""
-    base = packables[chosen]
-    options = []
-    for j in range(chosen, min(chosen + max_instance_types, len(packables))):
-        if (base.total[R_MEMORY] <= packables[j].total[R_MEMORY]
-                and base.total[R_PODS] <= packables[j].total[R_PODS]):
-            options.append(packables[j].index)
-    return options
 
 
 def solve_ffd_device(

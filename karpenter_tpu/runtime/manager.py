@@ -26,20 +26,33 @@ class Controller(Protocol):
 
     def reconcile(self, name: str, namespace: str = "default") -> Optional[float]: ...
 
+    # Optional: extra watches — [(kind, map_fn(obj) -> [(name, namespace)])]
+    # mirroring controller-runtime's Watches(EnqueueRequestsFromMapFunc)
+    # (e.g. node/controller.go:125-149 maps Pod and Provisioner events onto
+    # node reconciles).
+    # def mappings(self) -> List[Tuple[str, Callable]]: ...
+
 
 class _WorkQueue:
-    """Deduplicating work queue with delayed re-adds (the client-go
-    workqueue analog used throughout the reference)."""
+    """Deduplicating work queue with delayed re-adds and in-processing
+    tracking (client-go workqueue semantics: a key being processed is never
+    handed to a second worker; re-adds during processing mark it dirty and
+    it requeues when done())."""
 
     def __init__(self):
         self._lock = threading.Condition()
         self._pending: List[Tuple[str, str]] = []
         self._in_set: Set[Tuple[str, str]] = set()
+        self._processing: Set[Tuple[str, str]] = set()
+        self._dirty: Set[Tuple[str, str]] = set()
         self._delayed: List[Tuple[float, Tuple[str, str]]] = []
         self._shutdown = False
 
     def add(self, item: Tuple[str, str]) -> None:
         with self._lock:
+            if item in self._processing:
+                self._dirty.add(item)
+                return
             if item not in self._in_set:
                 self._pending.append(item)
                 self._in_set.add(item)
@@ -64,7 +77,18 @@ class _WorkQueue:
                 return None
             item = self._pending.pop(0)
             self._in_set.discard(item)
+            self._processing.add(item)
             return item
+
+    def done(self, item: Tuple[str, str]) -> None:
+        with self._lock:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._dirty.discard(item)
+                if item not in self._in_set:
+                    self._pending.append(item)
+                    self._in_set.add(item)
+                    self._lock.notify()
 
     def _next_delay(self) -> float:
         if not self._delayed:
@@ -115,6 +139,27 @@ class Manager:
                     meta = event.obj.metadata
                     wq.add((meta.name, meta.namespace))
 
+            # secondary watches: map foreign-kind events onto reconcile keys
+            for kind, map_fn in getattr(controller, "mappings", lambda: [])():
+                mapped_q = self.kube.watch(kind)
+
+                def mapped_pump(mapped_q=mapped_q, wq=wq, map_fn=map_fn):
+                    while not self._stop.is_set():
+                        try:
+                            event = mapped_q.get(timeout=0.2)
+                        except queue.Empty:
+                            continue
+                        try:
+                            for item in map_fn(event.obj):
+                                wq.add(item)
+                        except Exception:
+                            log.exception("watch mapping failed")
+
+                t = threading.Thread(target=mapped_pump, daemon=True,
+                                     name=f"map-{kind}-{controller.kind()}")
+                t.start()
+                self._threads.append(t)
+
             def work(controller=controller, wq=wq):
                 while not self._stop.is_set():
                     item = wq.get(timeout=0.2)
@@ -128,6 +173,8 @@ class Manager:
                                       controller.kind(), namespace, name)
                         wq.add_after(item, 1.0)
                         continue
+                    finally:
+                        wq.done(item)
                     if requeue is not None:
                         wq.add_after(item, requeue)
 

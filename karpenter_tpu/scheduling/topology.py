@@ -42,14 +42,19 @@ class TopologyGroup:
     def next_domain(self, requirement: Optional[frozenset]) -> str:
         """Min-count domain satisfying the requirement (topologygroup.go:54-68).
         Go iterates its map in random order with `<=`, so ties go to an
-        arbitrary domain; any tie-break is parity-compatible."""
+        arbitrary domain; any tie-break is parity-compatible. When no domain
+        satisfies the requirement, Go increments a spurious "" entry; we
+        return "" (the pod then fails validation, same outcome) without
+        polluting the spread counts."""
         min_domain, min_count = "", None
         for domain, count in self.spread.items():
             if requirement is not None and domain not in requirement:
                 continue
             if min_count is None or count <= min_count:
                 min_domain, min_count = domain, count
-        self.spread[min_domain] = self.spread.get(min_domain, 0) + 1
+        if min_count is None:
+            return ""
+        self.spread[min_domain] += 1
         return min_domain
 
 

@@ -133,6 +133,21 @@ class HostSolveResult:
 MAX_INSTANCE_TYPES = 20  # packer.go:38-39
 
 
+def instance_options(packables: Sequence[Packable], chosen: int,
+                     max_instance_types: int = MAX_INSTANCE_TYPES) -> List[int]:
+    """Viable instance-type options for a node packed on ``chosen``
+    (packer.go:184-191): the next ≤20 ascending types with memory and pods
+    not smaller than the chosen type's. Shared by the host and device decode
+    paths — the exact-parity contract depends on a single implementation."""
+    base = packables[chosen]
+    options = []
+    for j in range(chosen, min(chosen + max_instance_types, len(packables))):
+        if (base.total[R_MEMORY] <= packables[j].total[R_MEMORY]
+                and base.total[R_PODS] <= packables[j].total[R_PODS]):
+            options.append(packables[j].index)
+    return options
+
+
 def pack(
     pod_vecs: Sequence[Vec],
     pod_ids: Sequence[int],
@@ -183,13 +198,7 @@ def _pack_with_largest_pod(
     for i, packable in enumerate(packables):
         result = pack_one(packable.copy(), vecs, ids)
         if len(result.packed) == max_pods_packed:
-            options = []
-            for j in range(i, min(i + max_instance_types, len(packables))):
-                # exclude larger-index types with smaller memory or pods
-                # (packer.go:184-191)
-                if (packables[i].total[R_MEMORY] <= packables[j].total[R_MEMORY]
-                        and packables[i].total[R_PODS] <= packables[j].total[R_PODS]):
-                    options.append(packables[j].index)
+            options = instance_options(packables, i, max_instance_types)
             packed_set = set(result.packed)
             rem = [(v, pid) for v, pid in zip(vecs, ids) if pid not in packed_set]
             new_vecs = [v for v, _ in rem]

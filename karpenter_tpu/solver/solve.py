@@ -58,8 +58,11 @@ def solve(
     config = config or SolverConfig()
     packables, sorted_types = build_packables(instance_types, constraints, pods, daemons)
     if not packables:
+        # same contract as host_ffd.pack: no viable types → every pod is
+        # reported unschedulable (the reference only logs, packer.go:119-121,
+        # leaving pods pending to retry — callers here see them explicitly)
         log.error("no viable instance type options for %d pods", len(pods))
-        return SolveResult(packings=[], unschedulable=[])
+        return SolveResult(packings=[], unschedulable=list(pods))
 
     pod_vecs = [pod_vector(p) for p in pods]
     pod_ids = list(range(len(pods)))

@@ -1,0 +1,55 @@
+"""Webhook plane: defaulting + validation (v1alpha5/suite_test.go analog)."""
+
+from karpenter_tpu.api.constraints import Constraints, Taints
+from karpenter_tpu.api.core import NodeSelectorRequirement as Req, Taint
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.webhooks.admission import (
+    validate_constraints, validate_provisioner,
+)
+from tests.expectations import make_provisioner
+
+
+class TestValidation:
+    def test_valid_provisioner(self):
+        assert validate_provisioner(make_provisioner()) == []
+
+    def test_negative_ttls(self):
+        p = make_provisioner(ttl_seconds_after_empty=-1, ttl_seconds_until_expired=-5)
+        errs = validate_provisioner(p)
+        assert len(errs) == 2
+
+    def test_restricted_label(self):
+        c = Constraints(labels={"kubernetes.io/hostname": "x"})
+        assert validate_constraints(c)
+
+    def test_restricted_label_domain(self):
+        c = Constraints(labels={"kubernetes.io/foo": "x"})
+        errs = validate_constraints(c)
+        assert any("domain not allowed" in e for e in errs)
+
+    def test_allowed_label_domain(self):
+        c = Constraints(labels={"kops.k8s.io/instance-group": "x"})
+        assert validate_constraints(c) == []
+
+    def test_custom_label_ok(self):
+        c = Constraints(labels={"team": "ml", "example.com/tier": "gpu"})
+        assert validate_constraints(c) == []
+
+    def test_taint_validation(self):
+        c = Constraints(taints=Taints([Taint(key="", value="v", effect="NoSchedule")]))
+        assert validate_constraints(c)
+        c = Constraints(taints=Taints([Taint(key="k", value="v", effect="Bogus")]))
+        assert validate_constraints(c)
+        c = Constraints(taints=Taints([Taint(key="k", value="v", effect="NoExecute")]))
+        assert validate_constraints(c) == []
+
+    def test_requirement_operator_validation(self):
+        c = Constraints(requirements=Requirements(
+            [Req(key="k", operator="Exists", values=[])]))
+        errs = validate_constraints(c)
+        assert any("Exists" in e for e in errs)
+
+    def test_requirement_restricted_key(self):
+        c = Constraints(requirements=Requirements(
+            [Req(key="kubernetes.io/hostname", operator="In", values=["x"])]))
+        assert validate_constraints(c)

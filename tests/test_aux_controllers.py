@@ -1,0 +1,144 @@
+"""Counter, PVC, and metrics controllers."""
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.core import (
+    Node, NodeCondition, NodeStatus, ObjectMeta, OwnerReference,
+    PersistentVolumeClaim, PersistentVolumeClaimSpec,
+    PersistentVolumeClaimVolumeSource, Pod, PodSpec, Volume, Container,
+    ResourceRequirements,
+)
+from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.metrics_controllers import (
+    NodeMetricsController, PodMetricsController,
+)
+from karpenter_tpu.controllers.pvc import SELECTED_NODE_ANNOTATION, PVCController
+from karpenter_tpu.metrics.registry import Registry
+from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.utils.resources import parse_resource_list
+from tests.expectations import make_provisioner
+
+
+def provisioned_node(name="n1", provisioner="default", cpu="4", memory="8Gi"):
+    return Node(
+        metadata=ObjectMeta(name=name, namespace="", labels={
+            wellknown.PROVISIONER_NAME_LABEL: provisioner,
+            wellknown.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            wellknown.LABEL_ARCH: "amd64",
+            wellknown.LABEL_CAPACITY_TYPE: "on-demand",
+            wellknown.LABEL_INSTANCE_TYPE: "fake-it-1",
+        }),
+        status=NodeStatus(
+            capacity=parse_resource_list({"cpu": cpu, "memory": memory}),
+            allocatable=parse_resource_list({"cpu": cpu, "memory": memory}),
+            conditions=[NodeCondition(type="Ready", status="True")],
+        ),
+    )
+
+
+class TestCounter:
+    def test_aggregates_node_capacity(self):
+        kube = KubeCore()
+        kube.create(make_provisioner())
+        kube.create(provisioned_node("n1", cpu="4", memory="8Gi"))
+        kube.create(provisioned_node("n2", cpu="2", memory="4Gi"))
+        kube.create(provisioned_node("other", provisioner="other"))
+        CounterController(kube).reconcile("default")
+        p = kube.get("Provisioner", "default")
+        assert p.status.resources["cpu"].value() == 6
+        assert p.status.resources["memory"].value() == 12 * 1024**3
+
+    def test_empty_provisioner(self):
+        kube = KubeCore()
+        kube.create(make_provisioner())
+        CounterController(kube).reconcile("default")
+        p = kube.get("Provisioner", "default")
+        assert p.status.resources["cpu"].value() == 0
+
+
+class TestPVC:
+    def test_stamps_selected_node(self):
+        kube = KubeCore()
+        kube.create(PersistentVolumeClaim(metadata=ObjectMeta(name="data")))
+        kube.create(Pod(
+            metadata=ObjectMeta(name="p1"),
+            spec=PodSpec(node_name="n1", volumes=[Volume(
+                name="v", persistent_volume_claim=PersistentVolumeClaimVolumeSource(
+                    claim_name="data"))])))
+        PVCController(kube).reconcile("data")
+        pvc = kube.get("PersistentVolumeClaim", "data")
+        assert pvc.metadata.annotations[SELECTED_NODE_ANNOTATION] == "n1"
+
+    def test_ignores_unscheduled_pod(self):
+        kube = KubeCore()
+        kube.create(PersistentVolumeClaim(metadata=ObjectMeta(name="data")))
+        kube.create(Pod(
+            metadata=ObjectMeta(name="p1"),
+            spec=PodSpec(volumes=[Volume(
+                name="v", persistent_volume_claim=PersistentVolumeClaimVolumeSource(
+                    claim_name="data"))])))
+        PVCController(kube).reconcile("data")
+        pvc = kube.get("PersistentVolumeClaim", "data")
+        assert SELECTED_NODE_ANNOTATION not in pvc.metadata.annotations
+
+
+class TestMetrics:
+    def test_node_gauges(self):
+        kube = KubeCore()
+        reg = Registry()
+        kube.create(provisioned_node("n1"))
+        kube.create(Pod(
+            metadata=ObjectMeta(name="p1"),
+            spec=PodSpec(node_name="n1", containers=[Container(
+                resources=ResourceRequirements.make(
+                    requests={"cpu": "500m"}, limits={"cpu": "1"}))])))
+        ds_pod = Pod(
+            metadata=ObjectMeta(
+                name="ds1",
+                owner_references=[OwnerReference(kind="DaemonSet", name="ds")]),
+            spec=PodSpec(node_name="n1", containers=[Container(
+                resources=ResourceRequirements.make(requests={"cpu": "100m"}))]))
+        kube.create(ds_pod)
+        NodeMetricsController(kube, reg).reconcile("n1")
+        alloc = reg.gauge("nodes_allocatable").collect()
+        assert any(v == 4.0 for lv, v in alloc.items()
+                   if ("resource_type", "cpu") in lv)
+        reqs = reg.gauge("nodes_total_pod_requests").collect()
+        assert any(abs(v - 0.6) < 1e-9 for lv, v in reqs.items()
+                   if ("resource_type", "cpu") in lv)
+        daemon = reg.gauge("nodes_total_daemon_requests").collect()
+        assert any(abs(v - 0.1) < 1e-9 for lv, v in daemon.items()
+                   if ("resource_type", "cpu") in lv)
+
+    def test_node_deletion_clears_series(self):
+        kube = KubeCore()
+        reg = Registry()
+        kube.create(provisioned_node("n1"))
+        c = NodeMetricsController(kube, reg)
+        c.reconcile("n1")
+        assert reg.gauge("nodes_allocatable").collect()
+        kube.delete("Node", "n1", "")
+        c.reconcile("n1")
+        assert not reg.gauge("nodes_allocatable").collect()
+
+    def test_pod_state_gauge(self):
+        kube = KubeCore()
+        reg = Registry()
+        kube.create(provisioned_node("n1"))
+        kube.create(Pod(metadata=ObjectMeta(name="p1"),
+                        spec=PodSpec(node_name="n1")))
+        PodMetricsController(kube, reg).reconcile("p1")
+        series = reg.gauge("pods_state").collect()
+        assert len(series) == 1
+        lv = next(iter(series))
+        assert ("provisioner", "default") in lv
+
+    def test_exposition_format(self):
+        reg = Registry()
+        reg.gauge("nodes_allocatable").set(4.0, resource_type="cpu", node_name="n1")
+        with reg.time("binpacking_duration_seconds", provisioner="default"):
+            pass
+        text = reg.expose()
+        assert "karpenter_nodes_allocatable" in text
+        assert "karpenter_binpacking_duration_seconds_bucket" in text

@@ -1,0 +1,178 @@
+"""Node lifecycle: readiness/liveness/expiration/emptiness/finalizer.
+
+Mirrors pkg/controllers/node/suite_test.go using the injectable clock.
+"""
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.core import (
+    Node, NodeCondition, NodeSpec, NodeStatus, ObjectMeta, OwnerReference,
+    Pod, PodSpec, Taint,
+)
+from karpenter_tpu.controllers.node import (
+    LIVENESS_TIMEOUT_SECONDS, NodeController,
+)
+from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
+from karpenter_tpu.utils import clock
+from tests.expectations import make_provisioner
+
+
+@pytest.fixture()
+def env():
+    kube = KubeCore()
+    controller = NodeController(kube)
+    clock.DEFAULT.set(1_000_000.0)
+    return kube, controller
+
+
+def make_node(name="node-1", provisioner="default", ready=True, taints=None,
+              finalizers=None, creation=None):
+    return Node(
+        metadata=ObjectMeta(
+            name=name, namespace="",
+            labels={wellknown.PROVISIONER_NAME_LABEL: provisioner},
+            finalizers=list(finalizers if finalizers is not None
+                            else [wellknown.TERMINATION_FINALIZER]),
+            creation_timestamp=creation,
+        ),
+        spec=NodeSpec(taints=list(taints or [])),
+        status=NodeStatus(conditions=[NodeCondition(
+            type="Ready", status="True" if ready else "False",
+            reason="KubeletReady" if ready else "")]),
+    )
+
+
+def pod_on_node(kube, node_name, name="p1", daemonset=False):
+    pod = Pod(metadata=ObjectMeta(name=name), spec=PodSpec(node_name=node_name))
+    if daemonset:
+        pod.metadata.owner_references.append(OwnerReference(kind="DaemonSet", name="ds"))
+    kube.create(pod)
+    return pod
+
+
+class TestReadiness:
+    def test_removes_not_ready_taint_when_ready(self, env):
+        kube, controller = env
+        kube.create(make_provisioner())
+        node = make_node(ready=True, taints=[
+            Taint(key=wellknown.NOT_READY_TAINT_KEY, effect="NoSchedule"),
+            Taint(key="other", value="v", effect="NoSchedule")])
+        kube.create(node)
+        controller.reconcile("node-1")
+        stored = kube.get("Node", "node-1", "")
+        assert [t.key for t in stored.spec.taints] == ["other"]
+
+    def test_keeps_taint_when_not_ready(self, env):
+        kube, controller = env
+        kube.create(make_provisioner())
+        node = make_node(ready=False, taints=[
+            Taint(key=wellknown.NOT_READY_TAINT_KEY, effect="NoSchedule")])
+        kube.create(node)
+        controller.reconcile("node-1")
+        stored = kube.get("Node", "node-1", "")
+        assert [t.key for t in stored.spec.taints] == [wellknown.NOT_READY_TAINT_KEY]
+
+
+class TestLiveness:
+    def test_deletes_node_that_never_joined(self, env):
+        kube, controller = env
+        kube.create(make_provisioner())
+        node = make_node(ready=False, creation=clock.now())
+        node.status.conditions = []  # kubelet never reported
+        kube.create(node)
+        clock.DEFAULT.advance(LIVENESS_TIMEOUT_SECONDS + 1)
+        controller.reconcile("node-1")
+        stored = kube.get("Node", "node-1", "")
+        assert stored.metadata.deletion_timestamp is not None
+
+    def test_keeps_live_node(self, env):
+        kube, controller = env
+        kube.create(make_provisioner())
+        node = make_node(ready=True, creation=clock.now())
+        node.status.conditions[0].reason = "KubeletReady"
+        kube.create(node)
+        clock.DEFAULT.advance(LIVENESS_TIMEOUT_SECONDS + 1)
+        controller.reconcile("node-1")
+        stored = kube.get("Node", "node-1", "")
+        assert stored.metadata.deletion_timestamp is None
+
+
+class TestExpiration:
+    def test_expires_old_node(self, env):
+        kube, controller = env
+        kube.create(make_provisioner(ttl_seconds_until_expired=30))
+        kube.create(make_node(creation=clock.now()))
+        clock.DEFAULT.advance(31)
+        controller.reconcile("node-1")
+        assert kube.get("Node", "node-1", "").metadata.deletion_timestamp is not None
+
+    def test_keeps_young_node_with_requeue(self, env):
+        kube, controller = env
+        kube.create(make_provisioner(ttl_seconds_until_expired=300))
+        kube.create(make_node(creation=clock.now()))
+        requeue = controller.reconcile("node-1")
+        assert kube.get("Node", "node-1", "").metadata.deletion_timestamp is None
+        assert requeue is not None and requeue <= 300
+
+    def test_no_ttl_never_expires(self, env):
+        kube, controller = env
+        kube.create(make_provisioner())
+        kube.create(make_node(creation=clock.now()))
+        clock.DEFAULT.advance(10**6)
+        controller.reconcile("node-1")
+        assert kube.get("Node", "node-1", "").metadata.deletion_timestamp is None
+
+
+class TestEmptiness:
+    def test_stamps_and_deletes_empty_node(self, env):
+        kube, controller = env
+        kube.create(make_provisioner(ttl_seconds_after_empty=30))
+        kube.create(make_node(ready=True, creation=clock.now()))
+        controller.reconcile("node-1")
+        stored = kube.get("Node", "node-1", "")
+        assert wellknown.EMPTINESS_TIMESTAMP_ANNOTATION in stored.metadata.annotations
+        clock.DEFAULT.advance(31)
+        controller.reconcile("node-1")
+        assert kube.get("Node", "node-1", "").metadata.deletion_timestamp is not None
+
+    def test_daemonset_pods_count_as_empty(self, env):
+        kube, controller = env
+        kube.create(make_provisioner(ttl_seconds_after_empty=30))
+        kube.create(make_node(ready=True, creation=clock.now()))
+        pod_on_node(kube, "node-1", daemonset=True)
+        controller.reconcile("node-1")
+        stored = kube.get("Node", "node-1", "")
+        assert wellknown.EMPTINESS_TIMESTAMP_ANNOTATION in stored.metadata.annotations
+
+    def test_workload_pod_clears_stamp(self, env):
+        kube, controller = env
+        kube.create(make_provisioner(ttl_seconds_after_empty=30))
+        kube.create(make_node(ready=True, creation=clock.now()))
+        controller.reconcile("node-1")
+        assert wellknown.EMPTINESS_TIMESTAMP_ANNOTATION in (
+            kube.get("Node", "node-1", "").metadata.annotations)
+        pod_on_node(kube, "node-1")
+        controller.reconcile("node-1")
+        stored = kube.get("Node", "node-1", "")
+        assert wellknown.EMPTINESS_TIMESTAMP_ANNOTATION not in stored.metadata.annotations
+        assert stored.metadata.deletion_timestamp is None
+
+
+class TestFinalizer:
+    def test_readds_finalizer_to_self_registered_node(self, env):
+        kube, controller = env
+        kube.create(make_provisioner())
+        kube.create(make_node(finalizers=[]))
+        controller.reconcile("node-1")
+        stored = kube.get("Node", "node-1", "")
+        assert wellknown.TERMINATION_FINALIZER in stored.metadata.finalizers
+
+    def test_ignores_unmanaged_nodes(self, env):
+        kube, controller = env
+        node = make_node(finalizers=[])
+        node.metadata.labels = {}  # no provisioner label
+        kube.create(node)
+        controller.reconcile("node-1")
+        stored = kube.get("Node", "node-1", "")
+        assert stored.metadata.finalizers == []

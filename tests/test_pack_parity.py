@@ -20,24 +20,13 @@ from karpenter_tpu.solver import host_ffd
 from karpenter_tpu.solver.adapter import build_packables, pod_vector
 
 
+from karpenter_tpu.controllers.provisioning import universe_constraints
+
+
 def allow_all_constraints(catalog):
-    """Inject the full universe of well-known requirements, as the
-    provisioning controller does (controller.go:141-162)."""
-    zones, names, archs, oss, cts = set(), set(), set(), set(), set()
-    for it in catalog:
-        names.add(it.name)
-        archs.add(it.architecture)
-        oss |= set(it.operating_systems)
-        for o in it.offerings:
-            zones.add(o.zone)
-            cts.add(o.capacity_type)
-    return Constraints(requirements=Requirements().add(
-        Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In", values=sorted(zones)),
-        Req(key=wellknown.LABEL_INSTANCE_TYPE, operator="In", values=sorted(names)),
-        Req(key=wellknown.LABEL_ARCH, operator="In", values=sorted(archs)),
-        Req(key=wellknown.LABEL_OS, operator="In", values=sorted(oss)),
-        Req(key=wellknown.LABEL_CAPACITY_TYPE, operator="In", values=sorted(cts)),
-    ))
+    """Constraints admitting the whole catalog — the production universe
+    injection (controller.go:141-162), via the shared helper."""
+    return universe_constraints(catalog)
 
 
 def make_pod(requests, limits=None):

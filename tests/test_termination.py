@@ -1,0 +1,133 @@
+"""Termination: cordon → drain (eviction queue) → provider delete → finalizer.
+
+Mirrors pkg/controllers/termination/suite_test.go.
+"""
+
+import time
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.core import (
+    Node, NodeSpec, NodeStatus, ObjectMeta, OwnerReference, Pod, PodSpec,
+    Toleration,
+)
+from karpenter_tpu.cloudprovider.fake.provider import FakeCloudProvider
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
+from tests.expectations import eventually
+
+
+@pytest.fixture()
+def env():
+    kube = KubeCore()
+    provider = FakeCloudProvider()
+    controller = TerminationController(kube, provider)
+    yield kube, provider, controller
+    controller.stop_all()
+
+
+def terminating_node(kube, name="node-1"):
+    node = Node(metadata=ObjectMeta(
+        name=name, namespace="",
+        labels={wellknown.PROVISIONER_NAME_LABEL: "default"},
+        finalizers=[wellknown.TERMINATION_FINALIZER]))
+    kube.create(node)
+    kube.delete("Node", name, "")  # finalizer blocks: stamps deletionTimestamp
+    return kube.get("Node", name, "")
+
+
+def pod_on(kube, node_name, name="p1", annotations=None, priority="",
+           tolerations=None, static=False):
+    pod = Pod(
+        metadata=ObjectMeta(name=name, annotations=annotations or {}),
+        spec=PodSpec(node_name=node_name, tolerations=tolerations or [],
+                     priority_class_name=priority))
+    if static:
+        pod.metadata.owner_references.append(OwnerReference(kind="Node", name=node_name))
+    kube.create(pod)
+    return pod
+
+
+class TestTermination:
+    def test_terminates_empty_deleted_node(self, env):
+        kube, provider, controller = env
+        terminating_node(kube)
+        controller.reconcile("node-1")
+        with pytest.raises(NotFound):
+            kube.get("Node", "node-1", "")
+        assert provider.deleted == ["node-1"]
+
+    def test_ignores_node_without_deletion(self, env):
+        kube, provider, controller = env
+        node = Node(metadata=ObjectMeta(
+            name="live", namespace="", finalizers=[wellknown.TERMINATION_FINALIZER]))
+        kube.create(node)
+        controller.reconcile("live")
+        assert kube.get("Node", "live", "") is not None
+        assert provider.deleted == []
+
+    def test_cordons_and_drains_then_terminates(self, env):
+        kube, provider, controller = env
+        terminating_node(kube)
+        pod_on(kube, "node-1", "workload")
+        requeue = controller.reconcile("node-1")
+        assert requeue is not None  # still draining
+        assert kube.get("Node", "node-1", "").spec.unschedulable
+        # eviction queue deletes the pod asynchronously
+        eventually(lambda: _expect_gone(kube, "Pod", "workload", "default"))
+        controller.reconcile("node-1")
+        with pytest.raises(NotFound):
+            kube.get("Node", "node-1", "")
+        assert provider.deleted == ["node-1"]
+
+    def test_do_not_evict_blocks_drain(self, env):
+        kube, provider, controller = env
+        terminating_node(kube)
+        pod_on(kube, "node-1", "protected",
+               annotations={wellknown.DO_NOT_EVICT_ANNOTATION: "true"})
+        requeue = controller.reconcile("node-1")
+        assert requeue is not None
+        assert kube.get("Pod", "protected") is not None
+        assert provider.deleted == []
+
+    def test_static_pods_do_not_block(self, env):
+        kube, provider, controller = env
+        terminating_node(kube)
+        pod_on(kube, "node-1", "mirror", static=True)
+        controller.reconcile("node-1")
+        with pytest.raises(NotFound):
+            kube.get("Node", "node-1", "")
+
+    def test_unschedulable_tolerating_pods_do_not_block(self, env):
+        kube, provider, controller = env
+        terminating_node(kube)
+        pod_on(kube, "node-1", "tolerant", tolerations=[
+            Toleration(key="node.kubernetes.io/unschedulable",
+                       operator="Exists", effect="NoSchedule")])
+        controller.reconcile("node-1")
+        with pytest.raises(NotFound):
+            kube.get("Node", "node-1", "")
+
+    def test_critical_pods_evicted_last(self, env):
+        kube, provider, controller = env
+        terminating_node(kube)
+        pod_on(kube, "node-1", "normal")
+        pod_on(kube, "node-1", "critical", priority="system-node-critical")
+        controller.reconcile("node-1")
+        # normal goes first
+        eventually(lambda: _expect_gone(kube, "Pod", "normal", "default"))
+        assert kube.get("Pod", "critical") is not None
+        controller.reconcile("node-1")
+        eventually(lambda: _expect_gone(kube, "Pod", "critical", "default"))
+        controller.reconcile("node-1")
+        with pytest.raises(NotFound):
+            kube.get("Node", "node-1", "")
+
+
+def _expect_gone(kube, kind, name, namespace):
+    try:
+        kube.get(kind, name, namespace)
+    except NotFound:
+        return True
+    raise AssertionError(f"{kind} {name} still present")

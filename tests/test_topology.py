@@ -1,0 +1,98 @@
+"""Topology spread: zonal balancing, hostname domains, existing-pod counts.
+
+Mirrors the topology sections of scheduling/suite_test.go.
+"""
+
+import collections
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.core import (
+    LabelSelector, Node, NodeStatus, ObjectMeta, Pod, PodSpec,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.fake.provider import FakeCloudProvider, instance_types
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.scheduling.batcher import Batcher
+from tests.expectations import expect_provisioned, make_provisioner, unschedulable_pod
+
+
+@pytest.fixture()
+def env():
+    kube = KubeCore()
+    provider = FakeCloudProvider(catalog=instance_types(10))
+    provisioning = ProvisioningController(
+        kube, provider,
+        batcher_factory=lambda: Batcher(idle_seconds=0.05, max_seconds=2.0))
+    selection = SelectionController(kube, provisioning)
+    provisioner = make_provisioner()
+    kube.create(provisioner)
+    provisioning.reconcile("default")
+    yield kube, provider, provisioning, selection
+    for w in provisioning.workers.values():
+        w.stop()
+
+
+def spread_pod(key, max_skew=1, labels=None):
+    pod = unschedulable_pod(requests={"cpu": "1"})
+    pod.metadata.labels = labels or {"app": "web"}
+    pod.spec.topology_spread_constraints = [TopologySpreadConstraint(
+        max_skew=max_skew, topology_key=key,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels=labels or {"app": "web"}))]
+    return pod
+
+
+class TestZonalTopology:
+    def test_balances_across_zones(self, env):
+        kube, provider, provisioning, selection = env
+        pods = [spread_pod(wellknown.LABEL_TOPOLOGY_ZONE) for _ in range(9)]
+        expect_provisioned(kube, selection, provisioning, pods)
+        zones = collections.Counter()
+        for p in pods:
+            stored = kube.get("Pod", p.metadata.name)
+            assert stored.spec.node_name
+            node = kube.get("Node", stored.spec.node_name, "")
+            zones[node.metadata.labels[wellknown.LABEL_TOPOLOGY_ZONE]] += 1
+        assert len(zones) == 3  # spread over all three fake zones
+        assert max(zones.values()) - min(zones.values()) <= 1
+
+    def test_counts_existing_pods(self, env):
+        kube, provider, provisioning, selection = env
+        # zone-1 already hosts two matching scheduled pods
+        kube.create(Node(metadata=ObjectMeta(
+            name="existing", namespace="",
+            labels={wellknown.LABEL_TOPOLOGY_ZONE: "test-zone-1"})))
+        for i in range(2):
+            p = Pod(metadata=ObjectMeta(name=f"existing-{i}",
+                                        labels={"app": "web"}),
+                    spec=PodSpec(node_name="existing"))
+            kube.create(p)
+        pods = [spread_pod(wellknown.LABEL_TOPOLOGY_ZONE) for _ in range(4)]
+        expect_provisioned(kube, selection, provisioning, pods)
+        zones = collections.Counter()
+        for p in pods:
+            node = kube.get("Node", kube.get("Pod", p.metadata.name).spec.node_name, "")
+            zones[node.metadata.labels[wellknown.LABEL_TOPOLOGY_ZONE]] += 1
+        # new pods avoid the loaded zone first: zones 2/3 get 2 each
+        assert zones["test-zone-1"] == 0
+        assert zones["test-zone-2"] == 2 and zones["test-zone-3"] == 2
+
+
+class TestHostnameTopology:
+    def test_hostname_spread_forces_separate_nodes(self, env):
+        kube, provider, provisioning, selection = env
+        pods = [spread_pod(wellknown.LABEL_HOSTNAME) for _ in range(4)]
+        expect_provisioned(kube, selection, provisioning, pods)
+        nodes = {kube.get("Pod", p.metadata.name).spec.node_name for p in pods}
+        assert len(nodes) == 4  # one pod per generated hostname domain
+
+    def test_max_skew_groups_pods(self, env):
+        kube, provider, provisioning, selection = env
+        pods = [spread_pod(wellknown.LABEL_HOSTNAME, max_skew=2) for _ in range(4)]
+        expect_provisioned(kube, selection, provisioning, pods)
+        nodes = {kube.get("Pod", p.metadata.name).spec.node_name for p in pods}
+        assert len(nodes) == 2  # ceil(4/2) domains
