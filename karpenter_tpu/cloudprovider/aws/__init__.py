@@ -1,0 +1,8 @@
+"""AWS cloud provider plane.
+
+Reference: pkg/cloudprovider/aws/. Importing this package registers the
+"aws" provider in the SPI registry.
+"""
+
+from karpenter_tpu.cloudprovider.aws.provider import AWSCloudProvider  # noqa: F401
+from karpenter_tpu.cloudprovider.aws.vendor import AWSProvider  # noqa: F401

@@ -1,0 +1,134 @@
+"""The AWS CloudProvider: SPI implementation wiring the sub-providers.
+
+Reference: pkg/cloudprovider/aws/cloudprovider.go. Construction takes the
+EC2/SSM seam (sdk.EC2API/sdk.SSMAPI) so tests keep the real provider logic
+and fake only the AWS surface, exactly like the reference suite.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Sequence
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.api.core import Node
+from karpenter_tpu.cloudprovider import spi
+from karpenter_tpu.cloudprovider.aws import sdk
+from karpenter_tpu.cloudprovider.aws.discovery import (
+    AMIProvider,
+    SecurityGroupProvider,
+    SubnetProvider,
+)
+from karpenter_tpu.cloudprovider.aws.instance import InstanceProvider
+from karpenter_tpu.cloudprovider.aws.instancetypes import InstanceTypeProvider
+from karpenter_tpu.cloudprovider.aws.launchtemplate import LaunchTemplateProvider
+from karpenter_tpu.cloudprovider.aws.vendor import AWSProvider, default_constraints
+from karpenter_tpu.cloudprovider.spi import BindCallback, CloudProvider, InstanceType
+
+log = logging.getLogger("karpenter.aws")
+
+# EC2 CreateFleet budget (cloudprovider.go:41-46) — enforced by the caller's
+# workqueue in the reference; recorded here for the control plane's limiter.
+CREATE_FLEET_QPS = 2
+CREATE_FLEET_BURST = 100
+
+# The EBS CSI zone label aliases the standard zone label
+# (cloudprovider.go:58-60); registered at import so Requirements.normalize
+# folds it in.
+wellknown.NORMALIZED_LABELS.setdefault(
+    "topology.ebs.csi.aws.com/zone", wellknown.LABEL_TOPOLOGY_ZONE)
+
+
+class AWSCloudProvider(CloudProvider):
+    def __init__(
+        self,
+        ec2api: sdk.EC2API,
+        ssmapi: sdk.SSMAPI,
+        cluster_name: str,
+        cluster_endpoint: str,
+        kube_version: Callable[[], str] = lambda: "1.21",
+        ca_bundle: Optional[Callable[[], Optional[str]]] = None,
+        eni_limited_pod_density: bool = True,
+        node_name_convention: str = "ip-name",
+        describe_retry_delay: float = 1.0,
+    ):
+        self.subnet_provider = SubnetProvider(ec2api)
+        self.instance_type_provider = InstanceTypeProvider(
+            ec2api, self.subnet_provider,
+            eni_limited_pod_density=eni_limited_pod_density)
+        self.launch_template_provider = LaunchTemplateProvider(
+            ec2api,
+            AMIProvider(ssmapi, kube_version),
+            SecurityGroupProvider(ec2api),
+            cluster_name=cluster_name,
+            cluster_endpoint=cluster_endpoint,
+            ca_bundle=ca_bundle,
+            eni_limited_pod_density=eni_limited_pod_density,
+        )
+        self.instance_provider = InstanceProvider(
+            ec2api,
+            self.instance_type_provider,
+            self.subnet_provider,
+            self.launch_template_provider,
+            cluster_name=cluster_name,
+            node_name_convention=node_name_convention,
+            describe_retry_delay=describe_retry_delay,
+        )
+
+    # -- SPI (cloudprovider.go:113-152) -------------------------------------
+    def create(
+        self,
+        constraints: Constraints,
+        instance_types: Sequence[InstanceType],
+        quantity: int,
+        bind: BindCallback,
+    ) -> List[Optional[str]]:
+        provider = AWSProvider.deserialize(constraints)
+        provisioner_name = constraints.labels.get(
+            wellknown.PROVISIONER_NAME_LABEL, "default")
+        try:
+            nodes = self.instance_provider.create(
+                constraints, provider, instance_types, quantity,
+                provisioner_name=provisioner_name)
+        except Exception as e:  # noqa: BLE001 — surfaced per SPI contract
+            return [f"launching instances, {e}"] * quantity
+        errs = [bind(node) for node in nodes]
+        # partial fulfillment: unlaunched capacity reported as errors
+        errs.extend(["instance not launched"] * (quantity - len(nodes)))
+        return errs
+
+    def delete(self, node: Node) -> Optional[str]:
+        try:
+            self.instance_provider.terminate(node)
+        except Exception as e:  # noqa: BLE001
+            return f"terminating instance {node.metadata.name}, {e}"
+        return None
+
+    def get_instance_types(self, constraints: Constraints) -> List[InstanceType]:
+        """Full viable catalog; Requirements filtering happens in the solver's
+        feasibility mask, not here (cloudprovider.go:133-140)."""
+        provider = AWSProvider.deserialize(constraints)
+        return self.instance_type_provider.get(provider)
+
+    def default(self, constraints: Constraints) -> None:
+        """Webhook defaulting hook (cloudprovider.go:154-161): arch amd64 +
+        capacity-type on-demand, plus an empty provider block if missing so
+        deserialize() holds its invariant."""
+        if constraints.provider is None:
+            constraints.provider = {}
+        default_constraints(constraints)
+
+    def validate(self, constraints: Constraints) -> Optional[str]:
+        try:
+            provider = AWSProvider.deserialize(constraints)
+        except ValueError as e:
+            return str(e)
+        errs = provider.validate()
+        return "; ".join(errs) if errs else None
+
+    def name(self) -> str:
+        return "aws"
+
+
+spi.register("aws", AWSCloudProvider)

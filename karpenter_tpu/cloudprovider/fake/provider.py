@@ -14,7 +14,7 @@ from typing import List, Optional, Sequence
 
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.constraints import Constraints
-from karpenter_tpu.api.core import Node, NodeSpec, NodeStatus, ObjectMeta
+from karpenter_tpu.api.core import Node, NodeCondition, NodeSpec, NodeStatus, ObjectMeta
 from karpenter_tpu.cloudprovider import spi
 from karpenter_tpu.cloudprovider.spi import CloudProvider, InstanceType, Offering
 from karpenter_tpu.utils.resources import Quantity, parse_resource_list
@@ -92,8 +92,10 @@ def default_catalog() -> List[InstanceType]:
 class FakeCloudProvider(CloudProvider):
     """In-memory provider fabricating Node objects (fake/cloudprovider.go:37-79)."""
 
-    def __init__(self, catalog: Optional[Sequence[InstanceType]] = None):
+    def __init__(self, catalog: Optional[Sequence[InstanceType]] = None,
+                 nodes_become_ready: bool = True):
         self.catalog = list(catalog) if catalog is not None else None
+        self.nodes_become_ready = nodes_become_ready
         self.created: List[Node] = []
         self.deleted: List[str] = []
         # fault injection: zero-capacity (name, zone, capacity_type) triples,
@@ -135,6 +137,15 @@ class FakeCloudProvider(CloudProvider):
                     allocatable=parse_resource_list({
                         "pods": str(instance.pods), "cpu": str(instance.cpu),
                         "memory": str(instance.memory)}),
+                    # fake capacity "boots" instantly: the Ready condition the
+                    # kubelet would eventually report is present from birth,
+                    # so the liveness reaper (node/liveness.go) doesn't churn
+                    # nodes in a kubelet-less control plane. Tests that need
+                    # a not-yet-joined node overwrite status explicitly.
+                    conditions=(
+                        [NodeCondition(type="Ready", status="True",
+                                       reason="KubeletReady")]
+                        if self.nodes_become_ready else []),
                 ),
             )
             with self._lock:

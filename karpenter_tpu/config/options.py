@@ -29,6 +29,9 @@ class Options:
     batch_max_items: int = 50_000
     # solver
     solver_use_device: bool = True
+    # AWS provider (options.go:45-49)
+    aws_node_name_convention: str = "ip-name"  # ip-name | resource-name
+    aws_eni_limited_pod_density: bool = True
 
     def validate(self) -> List[str]:
         errs = []
@@ -41,6 +44,9 @@ class Options:
                            ("webhook-port", self.webhook_port)):
             if not (0 < port < 65536):
                 errs.append(f"{name} out of range: {port}")
+        if self.aws_node_name_convention not in ("ip-name", "resource-name"):
+            errs.append(
+                f"aws-node-name-convention invalid: {self.aws_node_name_convention}")
         return errs
 
 
@@ -81,7 +87,15 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                    default=_env("batch-max-seconds", defaults.batch_max_seconds))
     p.add_argument("--batch-max-items", type=int,
                    default=_env("batch-max-items", defaults.batch_max_items))
-    p.add_argument("--solver-use-device", action="store_true",
+    p.add_argument("--solver-use-device", action=argparse.BooleanOptionalAction,
                    default=_env("solver-use-device", defaults.solver_use_device))
+    p.add_argument("--aws-node-name-convention",
+                   choices=["ip-name", "resource-name"],
+                   default=_env("aws-node-name-convention",
+                                defaults.aws_node_name_convention))
+    p.add_argument("--aws-eni-limited-pod-density",
+                   action=argparse.BooleanOptionalAction,
+                   default=_env("aws-eni-limited-pod-density",
+                                defaults.aws_eni_limited_pod_density))
     ns = p.parse_args(argv)
     return Options(**{k.replace("-", "_"): v for k, v in vars(ns).items()})

@@ -11,7 +11,7 @@ from typing import Optional
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.core import LabelSelector
 from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
-from karpenter_tpu.utils.resources import Quantity, merge
+from karpenter_tpu.utils.resources import Quantity
 
 
 class CounterController:

@@ -16,7 +16,7 @@ from karpenter_tpu.metrics import registry
 from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
 from karpenter_tpu.utils import pod as podutil
 from karpenter_tpu.utils.resources import (
-    Quantity, limits_for_pods, merge, requests_for_pods,
+    Quantity, limits_for_pods, requests_for_pods,
 )
 
 _GAUGES = {

@@ -19,7 +19,7 @@ import time
 from typing import List, Optional, Set, Tuple
 
 from karpenter_tpu.api import wellknown
-from karpenter_tpu.api.core import Node, Pod, Taint
+from karpenter_tpu.api.core import Node, Pod
 from karpenter_tpu.cloudprovider.spi import CloudProvider
 from karpenter_tpu.runtime.kubecore import Conflict, KubeCore, NotFound
 from karpenter_tpu.utils import clock
@@ -156,10 +156,8 @@ class Terminator:
 
     def _get_evictable_pods(self, pods: List[Pod]) -> List[Pod]:
         evictable = []
-        unschedulable_taint = Taint(key="node.kubernetes.io/unschedulable",
-                                    effect="NoSchedule")
         for p in pods:
-            if any(t.tolerates_taint(unschedulable_taint) for t in p.spec.tolerations):
+            if podutil.tolerates_unschedulable_taint(p):
                 continue  # will reschedule onto the cordoned node anyway
             if is_stuck_terminating(p):
                 continue

@@ -34,9 +34,26 @@ from karpenter_tpu.solver.solve import SolverConfig
 log = logging.getLogger("karpenter")
 
 
+def build_cloud_provider(options: Options):
+    """Resolve the provider from the registry; the AWS provider needs its
+    SDK clients constructed first (cmd/controller/main.go:76-77)."""
+    if options.cloud_provider == "aws":
+        import karpenter_tpu.cloudprovider.aws  # noqa: F401 — registers "aws"
+        from karpenter_tpu.cloudprovider.aws import sdk as aws_sdk
+
+        ec2api, ssmapi = aws_sdk.boto3_clients()
+        return spi.resolve(
+            "aws", ec2api=ec2api, ssmapi=ssmapi,
+            cluster_name=options.cluster_name,
+            cluster_endpoint=options.cluster_endpoint,
+            eni_limited_pod_density=options.aws_eni_limited_pod_density,
+            node_name_convention=options.aws_node_name_convention)
+    return spi.resolve(options.cloud_provider)
+
+
 def build_manager(kube: KubeCore, options: Options) -> Manager:
     """Register the eight controllers (cmd/controller/main.go:89-98)."""
-    cloud_provider = spi.resolve(options.cloud_provider)
+    cloud_provider = build_cloud_provider(options)
     provisioning = ProvisioningController(
         kube, cloud_provider,
         solver_config=SolverConfig(use_device=options.solver_use_device),

@@ -99,7 +99,9 @@ class _WorkQueue:
         now = time.monotonic()
         while self._delayed and self._delayed[0][0] <= now:
             _, item = heapq.heappop(self._delayed)
-            if item not in self._in_set:
+            if item in self._processing:
+                self._dirty.add(item)
+            elif item not in self._in_set:
                 self._pending.append(item)
                 self._in_set.add(item)
 
