@@ -1,15 +1,23 @@
-"""Benchmark: the north-star config from BASELINE.json.
+"""Benchmark: the five BASELINE.json configs.
 
-Packs 50k mixed pending pods against a 400-type catalog and reports p99
-end-to-end solve latency (host marshal + encode + device pack + decode).
-Target (BASELINE.md): < 200 ms p99 on TPU v5e-4, node count within ±1 of
-the reference Go FFD packer — we assert EXACT node parity against the host
-oracle, which implements the Go packer's semantics verbatim.
+Headline (the one JSON line): p99 end-to-end solve latency for config 4 —
+50k mixed pods × 400 instance types (host marshal + encode + device pack +
+decode). Target (BASELINE.md): < 200 ms p99 on TPU v5e-4, node count within
+±1 of the reference Go FFD packer — we assert EXACT node parity against the
+host oracle, which implements the Go packer's semantics verbatim.
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": p99_ms, "unit": "ms", "vs_baseline": 200/p99_ms}
+  {"metric": ..., "value": p99_ms, "unit": "ms", "vs_baseline": 200/p99_ms,
+   "extra": {... all five configs ...}}
 vs_baseline > 1.0 means beating the engineered 200 ms target (the reference
 publishes no benchmark numbers — BASELINE.md).
+
+Configs (BASELINE.md table):
+  1. 100 pods, cpu/mem only, 10 types, 1 AZ (smoke)
+  2. 5k pods, nodeSelector + taints/tolerations, 400-type catalog
+  3. 20k pods, 3-zone topology spread (3 per-zone schedules, batch-solved)
+  4. 50k mixed pods, spot+OD, cost-minimizing           ← headline
+  5. consolidation: re-pack 2k running nodes → minimal set
 """
 
 from __future__ import annotations
@@ -18,84 +26,281 @@ import json
 import sys
 import time
 
-N_PODS = 50_000
-N_TYPES = 400
-ITERS = 9
 TARGET_MS = 200.0
+ITERS = 9
 
 
-def build_workload():
-    from karpenter_tpu.api.core import Container, Pod, PodSpec, ResourceRequirements
+def _p99(times):
+    times = sorted(times)
+    return times[min(len(times) - 1, int(len(times) * 0.99))] * 1000.0
+
+
+def _median(times):
+    return sorted(times)[len(times) // 2] * 1000.0
+
+
+def make_catalog(n_types, zones=3, price_base=0.05):
     from karpenter_tpu.cloudprovider.fake.provider import make_instance_type
-    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.cloudprovider.spi import Offering
 
-    # 400-type synthetic EC2-like catalog: cpu × memory-ratio grid
     catalog = []
-    i = 0
     cpus = [1, 2, 4, 8, 16, 24, 32, 48, 64, 96]
     ratios = [2, 4, 8]
-    while len(catalog) < N_TYPES:
+    i = 0
+    while len(catalog) < n_types:
         cpu = cpus[i % len(cpus)]
         ratio = ratios[(i // len(cpus)) % len(ratios)]
+        offerings = [
+            Offering(ct, f"bench-zone-{z + 1}")
+            for z in range(zones) for ct in ("on-demand", "spot")
+        ]
         catalog.append(make_instance_type(
             name=f"syn-{cpu}x{ratio}-{i}",
             cpu=str(cpu), memory=f"{cpu * ratio}Gi",
             pods=str(min(110, cpu * 15)),
+            offerings=offerings,
+            price=price_base * cpu * (1 + 0.1 * (ratio // 4)),
         ))
         i += 1
-    constraints = universe_constraints(catalog)
+    return catalog
 
-    # 50k mixed pods across 32 recurring request shapes
-    shapes = []
-    for c in (100, 250, 500, 750, 1000, 1500, 2000, 4000):
-        for m in (128, 512, 1024, 4096):
-            shapes.append((c, m))
-    pods = [
+
+def make_pods(n, shapes):
+    from karpenter_tpu.api.core import Container, Pod, PodSpec, ResourceRequirements
+
+    return [
         Pod(spec=PodSpec(containers=[Container(resources=ResourceRequirements.make(
             requests={"cpu": f"{c}m", "memory": f"{m}Mi"}))]))
-        for i in range(N_PODS)
+        for i in range(n)
         for c, m in (shapes[i % len(shapes)],)
     ]
-    return constraints, pods, catalog
 
 
-def main():
-    from karpenter_tpu.solver.adapter import build_packables, pod_vector
+MIXED_SHAPES = [
+    (c, m)
+    for c in (100, 250, 500, 750, 1000, 1500, 2000, 4000)
+    for m in (128, 512, 1024, 4096)
+]
+
+
+def bench_pack(pods, catalog, iters=ITERS, parity=True):
+    """Time solve_ffd_device end-to-end; assert exact node parity vs the
+    shape-level host oracle (Go packer semantics; itself differentially
+    tested against the per-pod oracle in tests/)."""
+    from karpenter_tpu.controllers.provisioning import universe_constraints
     from karpenter_tpu.models.ffd import solve_ffd_device, solve_ffd_numpy
+    from karpenter_tpu.solver.adapter import build_packables, pod_vector
 
-    constraints, pods, catalog = build_workload()
+    constraints = universe_constraints(catalog)
     packables, _ = build_packables(catalog, constraints, pods, [])
     vecs = [pod_vector(p) for p in pods]
     ids = list(range(len(pods)))
 
-    # warm-up (compile)
-    device = solve_ffd_device(vecs, ids, packables)
+    device = solve_ffd_device(vecs, ids, packables)  # warm-up (compile)
     assert device is not None, "bench workload must be device-encodable"
+    if parity:
+        host = solve_ffd_numpy(vecs, ids, packables)
+        assert device.node_count == host.node_count, (
+            f"node-count mismatch: device={device.node_count} host={host.node_count}")
 
-    # exact-parity check vs the shape-level host oracle (Go packer semantics;
-    # itself differentially tested against the per-pod oracle in tests/)
-    host = solve_ffd_numpy(vecs, ids, packables)
-    assert device.node_count == host.node_count, (
-        f"node-count mismatch: device={device.node_count} host={host.node_count}")
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        solve_ffd_device(vecs, ids, packables)
+        times.append(time.perf_counter() - t0)
+    return times, device.node_count
 
+
+def config_1_smoke():
+    """The production solve() path: 100 pods route to the native C++ kernel
+    (below device_min_pods a device round-trip costs more than it saves)."""
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.solver import host_ffd
+    from karpenter_tpu.solver.adapter import build_packables, pod_vector
+    from karpenter_tpu.solver.solve import solve
+
+    catalog = make_catalog(10, zones=1)
+    pods = make_pods(100, [(500, 512), (1000, 1024)])
+    constraints = universe_constraints(catalog)
+    result = solve(constraints, pods, catalog)  # warm-up
+    packables, _ = build_packables(catalog, constraints, pods, [])
+    oracle = host_ffd.pack([pod_vector(p) for p in pods],
+                           list(range(len(pods))), packables)
+    assert result.node_count == oracle.node_count
     times = []
     for _ in range(ITERS):
         t0 = time.perf_counter()
-        r = solve_ffd_device(vecs, ids, packables)
+        result = solve(constraints, pods, catalog)
         times.append(time.perf_counter() - t0)
-    times.sort()
-    p99 = times[min(len(times) - 1, int(len(times) * 0.99))] * 1000.0
+    return {"pods": 100, "p99_ms": round(_p99(times), 3),
+            "median_ms": round(_median(times), 3),
+            "node_count": result.node_count,
+            "pods_per_sec": round(100 / (sorted(times)[len(times) // 2] or 1e-9)),
+            "node_parity_vs_go_ffd_oracle": "exact"}
+
+
+def config_2_constrained():
+    """5k pods with nodeSelector + tolerations through the public solve()
+    path: constraint tightening + viability filtering + cost-aware option
+    ordering all included."""
+    from karpenter_tpu.api import wellknown
+    from karpenter_tpu.api.constraints import Taints
+    from karpenter_tpu.api.core import Taint, Toleration
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.solver.solve import solve
+
+    catalog = make_catalog(400)
+    constraints = universe_constraints(catalog)
+    constraints.taints = Taints([Taint(key="bench", value="true", effect="NoSchedule")])
+    pods = make_pods(5_000, MIXED_SHAPES)
+    for p in pods:
+        p.spec.node_selector = {wellknown.LABEL_TOPOLOGY_ZONE: "bench-zone-1"}
+        p.spec.tolerations = [Toleration(key="bench", operator="Equal",
+                                         value="true", effect="NoSchedule")]
+    tightened = constraints.tighten(pods[0])
+    tightened.taints = constraints.taints
+    result = solve(tightened, pods, catalog)  # warm-up
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        result = solve(tightened, pods, catalog)
+        times.append(time.perf_counter() - t0)
+    assert not result.unschedulable
+    return {"pods": 5_000, "p99_ms": round(_p99(times), 3),
+            "median_ms": round(_median(times), 3),
+            "node_count": result.node_count,
+            "pods_per_sec": round(5_000 / (sorted(times)[len(times) // 2] or 1e-9))}
+
+
+def config_3_topology():
+    """20k pods spread over 3 zones → 3 per-zone schedules solved as one
+    sharded batch (parallel/sharded_pack.py) — the pods-axis scaling story."""
+    import numpy as np
+
+    import jax
+
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.ops.encode import encode
+    from karpenter_tpu.parallel.mesh import solver_mesh
+    from karpenter_tpu.parallel.sharded_pack import pack_batch_sharded, pad_problems
+    from karpenter_tpu.solver.adapter import build_packables, pod_vector
+
+    catalog = make_catalog(100)
+    constraints = universe_constraints(catalog)
+    pods = make_pods(20_000, MIXED_SHAPES)
+    packables, _ = build_packables(catalog, constraints, pods, [])
+
+    # topology-spread: each zone domain receives len(pods)/3 (topology.go:112-140)
+    problems = []
+    for z in range(3):
+        zone_pods = pods[z::3]
+        vecs = [pod_vector(p) for p in zone_pods]
+        ids = list(range(len(zone_pods)))
+        order = sorted(range(len(ids)), key=lambda i: tuple(-v for v in vecs[i]))
+        enc = encode([vecs[i] for i in order], [ids[i] for i in order], packables)
+        assert enc is not None
+        problems.append(enc)
+
+    mesh = solver_mesh(jax.devices()[:1])
+    batch = pad_problems(problems, mesh.devices.size)
+
+    def run():
+        # iterations bound the per-chunk shape steps; ~32 shapes per zone
+        # problem need well under 128 (each step retires at least one shape
+        # run via the fast-forward)
+        out = pack_batch_sharded(*batch[:-1], num_iters=128, mesh=mesh)
+        for x in out:
+            x.block_until_ready()
+        return out
+
+    out = run()  # warm-up
+    done = np.asarray(out[2])
+    assert done.all(), "batch solve must converge in one chunk for the bench"
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t0)
+    node_count = int(sum(int(q[q > 0].sum()) for q in np.asarray(out[4])))
+    return {"pods": 20_000, "zones": 3, "p99_ms": round(_p99(times), 3),
+            "median_ms": round(_median(times), 3), "node_count": node_count,
+            "pods_per_sec": round(20_000 / (sorted(times)[len(times) // 2] or 1e-9))}
+
+
+def config_4_headline():
+    catalog = make_catalog(400)
+    pods = make_pods(50_000, MIXED_SHAPES)
+    times, nodes = bench_pack(pods, catalog)
+    return times, {"pods": 50_000, "types": 400,
+                   "p99_ms": round(_p99(times), 3),
+                   "median_ms": round(_median(times), 3), "node_count": nodes,
+                   "pods_per_sec": round(50_000 / (sorted(times)[len(times) // 2] or 1e-9)),
+                   "node_parity_vs_go_ffd_oracle": "exact"}
+
+
+def config_5_consolidation():
+    """Re-pack 2k fragmented running nodes into the minimal set
+    (models/consolidate.repack_plan on the device kernel)."""
+    from karpenter_tpu.api import wellknown
+    from karpenter_tpu.api.core import Node, NodeSpec, NodeStatus, ObjectMeta
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.models.consolidate import repack_plan
+    from karpenter_tpu.utils.resources import parse_resource_list
+
+    catalog = make_catalog(100)
+    constraints = universe_constraints(catalog)
+    big = max(catalog, key=lambda it: it.cpu.nano)
+    nodes, pods_by_node = [], {}
+    pods = make_pods(2_000 * 3, [(250, 256), (500, 512), (1000, 1024)])
+    for i in range(2_000):
+        name = f"frag-{i}"
+        nodes.append(Node(
+            metadata=ObjectMeta(name=name, namespace="", labels={
+                wellknown.LABEL_INSTANCE_TYPE: big.name,
+                wellknown.LABEL_CAPACITY_TYPE: "on-demand",
+                wellknown.PROVISIONER_NAME_LABEL: "bench",
+            }),
+            spec=NodeSpec(),
+            status=NodeStatus(allocatable=parse_resource_list({
+                "cpu": str(big.cpu), "memory": str(big.memory),
+                "pods": str(big.pods)})),
+        ))
+        batch = pods[i * 3:(i + 1) * 3]
+        for j, p in enumerate(batch):
+            p.metadata.name = f"pod-{i}-{j}"
+        pods_by_node[name] = batch
+
+    plan = repack_plan(nodes, pods_by_node, constraints, catalog)  # warm-up
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        plan = repack_plan(nodes, pods_by_node, constraints, catalog)
+        times.append(time.perf_counter() - t0)
+    assert plan.saves, "fragmented fleet must consolidate"
+    return {"running_nodes": 2_000, "pods": 6_000,
+            "p99_ms": round(_p99(times), 3),
+            "median_ms": round(_median(times), 3),
+            "planned_nodes": plan.planned_nodes,
+            "cost_before_per_hour": round(plan.current_cost_per_hour, 2),
+            "cost_after_per_hour": round(plan.planned_cost_per_hour, 2)}
+
+
+def main():
+    headline_times, c4 = config_4_headline()
+    extra = {
+        "config_1_smoke_100_pods": config_1_smoke(),
+        "config_2_5k_pods_constrained": config_2_constrained(),
+        "config_3_20k_pods_3zone_topology": config_3_topology(),
+        "config_4_50k_pods_cost_minimizing": c4,
+        "config_5_consolidate_2k_nodes": config_5_consolidation(),
+    }
+    p99 = _p99(headline_times)
     print(json.dumps({
         "metric": "p99_solve_latency_ms_50k_pods_x_400_types",
         "value": round(p99, 3),
         "unit": "ms",
         "vs_baseline": round(TARGET_MS / p99, 3),
-        "extra": {
-            "median_ms": round(times[len(times) // 2] * 1000.0, 3),
-            "pods_per_sec": round(N_PODS / (times[len(times) // 2] or 1e-9)),
-            "node_count": device.node_count,
-            "node_parity_vs_go_ffd_oracle": "exact",
-        },
+        "extra": extra,
     }))
 
 

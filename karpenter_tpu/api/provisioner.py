@@ -23,6 +23,10 @@ class ProvisionerSpec:
     # disables expiry (provisioner.go:43-50).
     ttl_seconds_until_expired: Optional[int] = None
     limits: Limits = field(default_factory=Limits)
+    # Actively drain under-utilized nodes whose pods fit elsewhere (a
+    # capability beyond the reference, which only reaps empty nodes —
+    # models/consolidate.py). Off by default: it evicts running pods.
+    consolidation_enabled: bool = False
 
 
 @dataclass

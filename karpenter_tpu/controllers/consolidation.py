@@ -1,0 +1,71 @@
+"""Consolidation controller: drain under-utilized nodes one safe step at a
+time.
+
+A deprovisioning capability beyond the reference (which only deletes empty
+nodes, node/emptiness.go). Per Provisioner with ``consolidationEnabled``:
+find a ready node whose reschedulable pods provably fit in the surviving
+nodes' free capacity (models/consolidate.py), delete it, and let the
+existing machinery do the rest — the termination finalizer cordons/drains
+(termination/terminate.go flow), evicted pods go pending, selection routes
+them, and they land on the surviving capacity or trigger a cheaper launch.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.core import Node, Pod
+from karpenter_tpu.models.consolidate import removable_nodes
+from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
+from karpenter_tpu.utils import node as nodeutil
+
+log = logging.getLogger("karpenter.consolidation")
+
+
+class ConsolidationController:
+    """Watches Provisioners; one consolidation action per reconcile."""
+
+    REQUEUE_SECONDS = 30.0
+
+    def __init__(self, kube: KubeCore, max_actions_per_pass: int = 1):
+        self.kube = kube
+        self.max_actions_per_pass = max_actions_per_pass
+
+    def kind(self) -> str:
+        return "Provisioner"
+
+    def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
+        try:
+            provisioner = self.kube.get("Provisioner", name, namespace)
+        except NotFound:
+            return None
+        if not provisioner.spec.consolidation_enabled:
+            return None
+        if provisioner.metadata.deletion_timestamp is not None:
+            return None
+
+        candidates: List[Node] = []
+        pods_by_node: Dict[str, List[Pod]] = {}
+        for node in self.kube.list("Node"):
+            if node.metadata.labels.get(wellknown.PROVISIONER_NAME_LABEL) != name:
+                continue
+            # only consolidate settled capacity: ready, not being deleted
+            if node.metadata.deletion_timestamp is not None:
+                continue
+            if not nodeutil.is_ready(node):
+                continue
+            candidates.append(node)
+            pods_by_node[node.metadata.name] = self.kube.pods_on_node(
+                node.metadata.name)
+
+        for node in removable_nodes(
+                candidates, pods_by_node, max_actions=self.max_actions_per_pass):
+            log.info("consolidating node %s (%d pods fit on surviving capacity)",
+                     node.metadata.name, len(pods_by_node[node.metadata.name]))
+            try:
+                self.kube.delete("Node", node.metadata.name, node.metadata.namespace)
+            except NotFound:
+                pass
+        return self.REQUEUE_SECONDS

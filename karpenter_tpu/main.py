@@ -16,6 +16,7 @@ from typing import Optional
 from karpenter_tpu.cloudprovider import spi
 from karpenter_tpu.cloudprovider.fake import provider as _fake  # registers "fake"
 from karpenter_tpu.config.options import Options, parse
+from karpenter_tpu.controllers.consolidation import ConsolidationController
 from karpenter_tpu.controllers.counter import CounterController
 from karpenter_tpu.controllers.metrics_controllers import (
     NodeMetricsController, PodMetricsController,
@@ -52,7 +53,8 @@ def build_cloud_provider(options: Options):
 
 
 def build_manager(kube: KubeCore, options: Options) -> Manager:
-    """Register the eight controllers (cmd/controller/main.go:89-98)."""
+    """Register the controllers: the reference's eight
+    (cmd/controller/main.go:89-98) plus consolidation."""
     cloud_provider = build_cloud_provider(options)
     provisioning = ProvisioningController(
         kube, cloud_provider,
@@ -67,6 +69,7 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
     manager.register(NodeController(kube), workers=10)
     manager.register(TerminationController(kube, cloud_provider), workers=10)
     manager.register(CounterController(kube))
+    manager.register(ConsolidationController(kube))
     manager.register(PVCController(kube))
     manager.register(NodeMetricsController(kube))
     manager.register(PodMetricsController(kube))

@@ -1,0 +1,80 @@
+"""Native (C++) solver components: build-on-demand ctypes bridge.
+
+The C++ kernel (ffd.cc) is one of three interchangeable executors over the
+encoded problem — see the header comment there. It is compiled lazily with
+the system toolchain into this package directory and loaded via ctypes (no
+build step at install time, no binding framework); environments without a
+C++ compiler transparently fall back to the Python/numpy executors.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger("karpenter.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "ffd.cc")
+_LIB = os.path.join(_DIR, "_libktffd.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _compile() -> bool:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _LIB, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native build unavailable: %s", e)
+        return False
+    if proc.returncode != 0:
+        log.warning("native build failed:\n%s", proc.stderr)
+        return False
+    return True
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.kt_ffd_pack.restype = ctypes.c_int64
+    lib.kt_ffd_pack.argtypes = [
+        i64p, i64p, i64p, i64p,                      # shapes, counts, totals, reserved0
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # S, T, R
+        ctypes.c_int64, ctypes.c_int64,              # pods_unit, r_pods
+        i64p, i64p, i64p, i64p,                      # out chosen/qty/packed/dropped
+        ctypes.c_int64,                              # max_records
+    ]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The compiled kernel, building it on first use; None when no toolchain
+    is available (callers fall back to the Python executors)."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_LIB) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            if not _compile():
+                _build_failed = True
+                return None
+        try:
+            _lib = _bind(ctypes.CDLL(_LIB))
+        except OSError as e:
+            log.warning("native library load failed: %s", e)
+            _build_failed = True
+            return None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

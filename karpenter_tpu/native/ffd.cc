@@ -1,0 +1,173 @@
+// Native host-side FFD pack kernel.
+//
+// The framework's solver boundary has three interchangeable executors over
+// the same encoded problem (karpenter_tpu/ops/encode.py):
+//   1. the TPU kernel (ops/pack.py)          — the production hot path
+//   2. this C++ kernel                        — fast host fallback
+//   3. the per-pod Python oracle (host_ffd)   — Go-parity reference
+// All three are differentially tested to the node count. The algorithm is
+// the shape-level greedy with fast-forward: semantics of the reference Go
+// packer's packWithLargestPod loop (packer.go:114-141,167-198) lifted from
+// per-pod to per-shape, identical to ops/pack.py / models/ffd.solve_ffd_numpy.
+//
+// Inputs arrive pre-scaled (encode()'s GCD scaling keeps every value within
+// int32), so int64 arithmetic here cannot overflow: k*shape <= 2^31 * 2^31.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+constexpr int64_t kInf = INT64_C(2147483647);  // matches _INT32_MAX fast-forward
+}
+
+extern "C" {
+
+// Packs counts[s] pods of shapes[s] onto instances of types totals[t].
+// Returns the number of (chosen, qty, packed[s]) records written, or -1 if
+// max_records was too small. All matrices are row-major.
+//
+//   shapes    (S, R)  per-shape reserve vector (pods dim includes the +1)
+//   counts    (S,)    pods per shape; CONSUMED (copied internally)
+//   totals    (T, R)  instance capacity, ascending packable order
+//   reserved0 (T, R)  overhead + daemons already reserved
+//   pods_unit         one pod in device units on the pods dimension
+//   r_pods            index of the pods dimension
+//
+// Outputs:
+//   out_chosen  (max_records,)     instance-type index per record
+//   out_qty     (max_records,)     identical nodes for this record
+//   out_packed  (max_records, S)   pods-per-shape on each such node
+//   out_dropped (S,)               unpackable pods per shape
+int64_t kt_ffd_pack(
+    const int64_t* shapes, const int64_t* counts_in,
+    const int64_t* totals, const int64_t* reserved0,
+    int64_t S, int64_t T, int64_t R, int64_t pods_unit, int64_t r_pods,
+    int64_t* out_chosen, int64_t* out_qty, int64_t* out_packed,
+    int64_t* out_dropped, int64_t max_records) {
+  std::vector<int64_t> counts(counts_in, counts_in + S);
+  std::vector<int64_t> dropped(S, 0);
+
+  // maxfit[s]: most pods of shape s any EMPTY instance fits — the
+  // fast-forward divisor (models/ffd.py maxfit).
+  std::vector<int64_t> maxfit(S, 0);
+  for (int64_t s = 0; s < S; ++s) {
+    int64_t best = 0;
+    for (int64_t t = 0; t < T; ++t) {
+      int64_t k = kInf;
+      for (int64_t r = 0; r < R; ++r) {
+        const int64_t need = shapes[s * R + r];
+        if (need > 0) {
+          const int64_t avail = totals[t * R + r] - reserved0[t * R + r];
+          const int64_t kr = avail >= 0 ? avail / need : 0;
+          if (kr < k) k = kr;
+        }
+      }
+      if (k > best) best = k;
+    }
+    maxfit[s] = best;
+  }
+
+  std::vector<int64_t> reserved(T * R);
+  std::vector<char> stopped(T);
+  std::vector<int64_t> npacked(T);
+  std::vector<int64_t> k_all(S * T);
+  std::vector<int64_t> smallest_fits(R);
+
+  int64_t n_records = 0;
+  for (;;) {
+    int64_t largest = -1, smallest = -1;
+    for (int64_t s = 0; s < S; ++s) {
+      if (counts[s] > 0) {
+        if (largest < 0) largest = s;
+        smallest = s;
+      }
+    }
+    if (largest < 0) break;
+
+    for (int64_t r = 0; r < R; ++r) {
+      int64_t v = shapes[smallest * R + r];
+      if (r == r_pods) v -= pods_unit;
+      smallest_fits[r] = v > 0 ? v : 0;
+    }
+
+    std::memcpy(reserved.data(), reserved0, sizeof(int64_t) * T * R);
+    std::fill(stopped.begin(), stopped.end(), 0);
+    std::fill(npacked.begin(), npacked.end(), 0);
+    std::fill(k_all.begin(), k_all.end(), 0);
+
+    // One pass largest→smallest shape; per type, pack as many as fit. A type
+    // "stops" at its first failure once it is full-for-the-smallest-shape or
+    // still empty — the early-exit upper bound of packer.go:167-198.
+    for (int64_t s = 0; s < S; ++s) {
+      if (counts[s] == 0) continue;
+      for (int64_t t = 0; t < T; ++t) {
+        if (stopped[t]) continue;
+        int64_t k = kInf;
+        for (int64_t r = 0; r < R; ++r) {
+          const int64_t need = shapes[s * R + r];
+          if (need > 0) {
+            const int64_t avail = totals[t * R + r] - reserved[t * R + r];
+            const int64_t kr = avail >= 0 ? avail / need : 0;
+            if (kr < k) k = kr;
+          }
+        }
+        if (k > counts[s]) k = counts[s];
+        if (k < 0) k = 0;
+        const bool failure = k < counts[s];
+        for (int64_t r = 0; r < R; ++r) reserved[t * R + r] += k * shapes[s * R + r];
+        bool full = false;
+        for (int64_t r = 0; r < R; ++r) {
+          if (totals[t * R + r] > 0 &&
+              reserved[t * R + r] + smallest_fits[r] >= totals[t * R + r]) {
+            full = true;
+            break;
+          }
+        }
+        npacked[t] += k;
+        if (failure && (full || npacked[t] == 0)) stopped[t] = 1;
+        k_all[s * T + t] = k;
+      }
+    }
+
+    const int64_t max_pods = npacked[T - 1];
+    if (max_pods == 0) {
+      dropped[largest] += counts[largest];
+      counts[largest] = 0;
+      continue;
+    }
+    int64_t chosen = 0;
+    while (npacked[chosen] != max_pods) ++chosen;
+
+    // fast-forward: emit q identical nodes at once. q is chosen so no shape
+    // drops below its maxfit watermark before the next re-plan (the point
+    // where a different instance type could start winning).
+    int64_t min_terms = kInf;
+    for (int64_t s = 0; s < S; ++s) {
+      const int64_t kv = k_all[s * T + chosen];
+      if (kv > 0) {
+        const int64_t diff = counts[s] - maxfit[s];
+        // floor division to match numpy (sign differences wash out under
+        // the max(0, .) below, but keep it exact anyway)
+        int64_t q = diff / kv;
+        if (diff % kv != 0 && ((diff < 0) != (kv < 0))) --q;
+        if (q < min_terms) min_terms = q;
+      }
+    }
+    const int64_t q = 1 + (min_terms > 0 ? min_terms : 0);
+    if (n_records >= max_records) return -1;
+    out_chosen[n_records] = chosen;
+    out_qty[n_records] = q;
+    for (int64_t s = 0; s < S; ++s) {
+      const int64_t kv = k_all[s * T + chosen];
+      out_packed[n_records * S + s] = kv;
+      counts[s] -= q * kv;
+    }
+    ++n_records;
+  }
+
+  std::memcpy(out_dropped, dropped.data(), sizeof(int64_t) * S);
+  return n_records;
+}
+
+}  // extern "C"

@@ -82,41 +82,52 @@ def _pods_require(pods: Sequence[Pod], resource_name: str) -> bool:
     return False
 
 
-def _validate(it: InstanceType, constraints: Constraints, pods: Sequence[Pod]) -> Optional[str]:
+_SPECIAL_RESOURCES = (res.AWS_POD_ENI, res.NVIDIA_GPU, res.AMD_GPU, res.AWS_NEURON)
+
+
+def _required_resources(pods: Sequence[Pod]) -> frozenset:
+    """Which exotic resources the pod set requires — computed ONCE per solve;
+    the Go code re-scans all pods inside every per-type validator
+    (packable.go:221-233), which is O(types × pods) and dominates large
+    solves. Same answer, hoisted."""
+    return frozenset(
+        name for name in _SPECIAL_RESOURCES if _pods_require(pods, name))
+
+
+def _validate(it: InstanceType, allowed: tuple,
+              required: frozenset) -> Optional[str]:
     """Viability validators (packable.go:52-59,175-247). Returns reason or None.
+    ``allowed`` is the requirement sets evaluated once per solve (set
+    evaluation walks the whole requirement list, requirements.go:176-195 —
+    hoisted out of the per-type loop).
 
     Note: Go's sets.Has on a nil set is false, so an *unconstrained*
     requirement rejects here — the provisioning controller always injects
     the full universe of zones/types/arch/OS/capacity-types before solving
     (provisioning/controller.go:141-162), and we preserve that contract.
     """
-    reqs = constraints.requirements
+    cts, zones, its, archs, oss = allowed
     # offerings: some offering's (capacity type, zone) allowed
-    cts, zones = reqs.capacity_types(), reqs.zones()
     if not any(
         (cts is not None and o.capacity_type in cts) and (zones is not None and o.zone in zones)
         for o in it.offerings
     ):
         return "no viable offering"
-    its = reqs.instance_types()
     if its is None or it.name not in its:
         return "instance type not allowed"
-    archs = reqs.architectures()
     if archs is None or it.architecture not in archs:
         return "architecture not allowed"
-    oss = reqs.operating_systems()
     if oss is None or not (set(it.operating_systems) & oss):
         return "operating system not allowed"
     # AWS pod ENI (packable.go:235-247): first requesting pod decides
-    if _pods_require(pods, res.AWS_POD_ENI) and it.aws_pod_eni.is_zero():
+    if res.AWS_POD_ENI in required and it.aws_pod_eni.is_zero():
         return "aws pod eni required"
     # GPUs (packable.go:205-219): GPU classes are exclusive both ways
     for name, qty in ((res.NVIDIA_GPU, it.nvidia_gpus), (res.AMD_GPU, it.amd_gpus),
                       (res.AWS_NEURON, it.aws_neurons)):
-        required = _pods_require(pods, name)
-        if required and qty.is_zero():
+        if name in required and qty.is_zero():
             return f"{name} is required"
-        if not required and not qty.is_zero():
+        if name not in required and not qty.is_zero():
             return f"{name} is not required"
     return None
 
@@ -153,9 +164,13 @@ def build_packables(
     """PackablesFor (packable.go:44-91): validate → reserve overhead → pack
     daemons → sort ascending."""
     daemon_vecs = [pod_vector(d) for d in daemons]
+    required = _required_resources(pods)
+    reqs = constraints.requirements
+    allowed = (reqs.capacity_types(), reqs.zones(), reqs.instance_types(),
+               reqs.architectures(), reqs.operating_systems())
     viable: List[Tuple[Vec, InstanceType, Packable]] = []
     for it in instance_types:
-        if _validate(it, constraints, pods) is not None:
+        if _validate(it, allowed, required) is not None:
             continue
         totals = instance_totals(it)
         p = Packable(index=-1, total=list(totals), reserved=[0] * NUM_RESOURCES)

@@ -1,0 +1,72 @@
+"""Host FFD solve on the native C++ kernel.
+
+Same contract as models/ffd.solve_ffd_numpy: encode → pack → decode, exact
+node parity with the per-pod Go-semantics oracle (host_ffd.pack). Used as
+the fast host fallback when the device path is unavailable or the problem
+is too small to amortize a device round-trip (solver/solve.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu import native
+from karpenter_tpu.models.ffd import _decode
+from karpenter_tpu.ops.encode import encode
+from karpenter_tpu.solver.host_ffd import (
+    HostSolveResult, MAX_INSTANCE_TYPES, Packable, R_PODS, Vec,
+)
+
+# generous ceiling: every record packs ≥1 pod of some shape, and the
+# fast-forward collapses runs, so records ≤ shapes × types in practice
+_MAX_RECORDS_FACTOR = 4
+
+
+def solve_ffd_native(
+    pod_vecs: Sequence[Vec],
+    pod_ids: Sequence[int],
+    packables: Sequence[Packable],
+    max_instance_types: int = MAX_INSTANCE_TYPES,
+) -> Optional[HostSolveResult]:
+    """None when the native library or an exact encoding is unavailable."""
+    lib = native.load()
+    if lib is None:
+        return None
+    if not packables:
+        return HostSolveResult(packings=[], unschedulable=list(pod_ids))
+    enc = encode(pod_vecs, pod_ids, packables)
+    if enc is None:
+        return None
+
+    S, T = enc.num_shapes, enc.num_types
+    shapes = np.ascontiguousarray(enc.shapes[:S], np.int64)
+    counts = np.ascontiguousarray(enc.counts[:S], np.int64)
+    totals = np.ascontiguousarray(enc.totals[:T], np.int64)
+    reserved0 = np.ascontiguousarray(enc.reserved0[:T], np.int64)
+
+    max_records = _MAX_RECORDS_FACTOR * S * max(T, 1) + 16
+    out_chosen = np.zeros(max_records, np.int64)
+    out_qty = np.zeros(max_records, np.int64)
+    out_packed = np.zeros((max_records, S), np.int64)
+    out_dropped = np.zeros(S, np.int64)
+
+    import ctypes
+
+    def ptr(a):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+    n = lib.kt_ffd_pack(
+        ptr(shapes), ptr(counts), ptr(totals), ptr(reserved0),
+        S, T, shapes.shape[1], int(enc.pods_unit), R_PODS,
+        ptr(out_chosen), ptr(out_qty), ptr(out_packed), ptr(out_dropped),
+        max_records)
+    if n < 0:
+        return None  # record buffer overflow — fall back
+
+    records = [
+        (int(out_chosen[i]), int(out_qty[i]), out_packed[i])
+        for i in range(n)
+    ]
+    return _decode(enc, records, out_dropped, packables, max_instance_types)

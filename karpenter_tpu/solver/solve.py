@@ -15,6 +15,7 @@ from typing import List, Optional, Sequence
 from karpenter_tpu.api.constraints import Constraints
 from karpenter_tpu.api.core import Pod
 from karpenter_tpu.cloudprovider.spi import InstanceType
+from karpenter_tpu.models.cost import CostConfig, order_options_by_price
 from karpenter_tpu.models.ffd import solve_ffd_device
 from karpenter_tpu.solver import host_ffd
 from karpenter_tpu.solver.adapter import build_packables, pod_vector
@@ -27,6 +28,16 @@ class SolverConfig:
     use_device: bool = True
     max_instance_types: int = host_ffd.MAX_INSTANCE_TYPES
     chunk_iters: int = 64
+    # below this many pods a device round-trip costs more than it saves
+    # (tens of ms over the transport vs sub-ms native solve); the native/
+    # host executors answer instead — same result, differential-tested
+    device_min_pods: int = 512
+    # prefer the C++ kernel over the per-pod Python oracle for host solves
+    use_native: bool = True
+    # order each node's instance-type options cheapest-first when the
+    # catalog carries prices (models/cost.py); capacity order otherwise
+    cost_aware: bool = True
+    cost_config: CostConfig = field(default_factory=CostConfig)
 
 
 @dataclass
@@ -68,7 +79,7 @@ def solve(
     pod_ids = list(range(len(pods)))
 
     result = None
-    if config.use_device:
+    if config.use_device and len(pods) >= config.device_min_pods:
         try:
             result = solve_ffd_device(
                 pod_vecs, pod_ids, packables,
@@ -76,6 +87,16 @@ def solve(
                 chunk_iters=config.chunk_iters)
         except Exception:  # device failure ring: never drop a provisioning loop
             log.exception("device solve failed; falling back to host FFD")
+            result = None
+    if result is None and config.use_native:
+        from karpenter_tpu.solver.native_ffd import solve_ffd_native
+
+        try:
+            result = solve_ffd_native(
+                pod_vecs, pod_ids, packables,
+                max_instance_types=config.max_instance_types)
+        except Exception:  # same failure posture as the device ring
+            log.exception("native solve failed; falling back to host FFD")
             result = None
     if result is None:
         result = host_ffd.pack(pod_vecs, pod_ids, packables,
@@ -89,6 +110,10 @@ def solve(
         )
         for hp in result.packings
     ]
+    if config.cost_aware and any(it.price for it in sorted_types):
+        for p in packings:
+            p.instance_type_options = order_options_by_price(
+                p.instance_type_options, constraints.requirements, config.cost_config)
     return SolveResult(
         packings=packings,
         unschedulable=[pods[i] for i in result.unschedulable],

@@ -1,0 +1,92 @@
+"""Native C++ FFD kernel: build + differential parity.
+
+The three executors over one encoded problem — device kernel, C++ kernel,
+per-pod Python oracle — must agree on node counts for any workload
+(solver/native_ffd.py header). Randomized differential tests mirror
+tests/test_pack_parity.py's device-vs-oracle structure.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu import native
+from karpenter_tpu.cloudprovider.fake.provider import instance_types, make_instance_type
+from karpenter_tpu.controllers.provisioning import universe_constraints
+from karpenter_tpu.models.ffd import solve_ffd_numpy
+from karpenter_tpu.solver import host_ffd
+from karpenter_tpu.solver.adapter import build_packables, pod_vector
+from karpenter_tpu.solver.native_ffd import solve_ffd_native
+
+from tests.expectations import unschedulable_pod
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain for the native kernel")
+
+
+def _problem(pods, catalog):
+    constraints = universe_constraints(catalog)
+    packables, _ = build_packables(catalog, constraints, pods, [])
+    vecs = [pod_vector(p) for p in pods]
+    return vecs, list(range(len(pods))), packables
+
+
+def _assert_parity(pods, catalog):
+    vecs, ids, packables = _problem(pods, catalog)
+    oracle = host_ffd.pack(vecs, ids, packables)
+    nat = solve_ffd_native(vecs, ids, packables)
+    assert nat is not None
+    assert nat.node_count == oracle.node_count
+    assert sorted(nat.unschedulable) == sorted(oracle.unschedulable)
+    # every pod lands exactly once
+    placed = sorted(pid for p in nat.packings for node in p.pod_ids for pid in node)
+    expected = sorted(set(ids) - set(nat.unschedulable))
+    assert placed == expected
+    return nat
+
+
+class TestNativeParity:
+    def test_simple_workload(self):
+        pods = [unschedulable_pod(requests={"cpu": "500m", "memory": "256Mi"})
+                for _ in range(50)]
+        _assert_parity(pods, instance_types(10))
+
+    def test_mixed_shapes(self):
+        shapes = [("100m", "64Mi"), ("1", "1Gi"), ("2", "512Mi"), ("250m", "4Gi")]
+        pods = [unschedulable_pod(requests={"cpu": c, "memory": m})
+                for i in range(200) for c, m in (shapes[i % 4],)]
+        _assert_parity(pods, instance_types(20))
+
+    def test_unschedulable_overflow(self):
+        catalog = [make_instance_type("tiny", cpu="1", memory="1Gi", pods="10")]
+        pods = [unschedulable_pod(requests={"cpu": "2", "memory": "512Mi"})]
+        result = _assert_parity(pods, catalog)
+        assert result.unschedulable == [0]
+
+    def test_randomized_differential(self):
+        rng = random.Random(20260729)
+        for trial in range(10):
+            n_types = rng.randint(1, 25)
+            catalog = instance_types(n_types)
+            pods = [
+                unschedulable_pod(requests={
+                    "cpu": f"{rng.choice([100, 250, 500, 1000, 2000, 4000])}m",
+                    "memory": f"{rng.choice([64, 128, 512, 1024, 4096])}Mi",
+                })
+                for _ in range(rng.randint(1, 300))
+            ]
+            _assert_parity(pods, catalog)
+
+    def test_matches_numpy_mirror_records(self):
+        pods = [unschedulable_pod(requests={"cpu": "750m", "memory": "300Mi"})
+                for _ in range(500)]
+        vecs, ids, packables = _problem(pods, instance_types(15))
+        nat = solve_ffd_native(vecs, ids, packables)
+        npy = solve_ffd_numpy(vecs, ids, packables)
+        assert nat.node_count == npy.node_count
+        assert sorted(nat.unschedulable) == sorted(npy.unschedulable)
+
+    def test_empty_packables(self):
+        result = solve_ffd_native([(10**9, 0, 0, 0, 0, 0, 0, 0)], [0], [])
+        assert result.node_count == 0
+        assert result.unschedulable == [0]
