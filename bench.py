@@ -182,7 +182,9 @@ def config_3_topology():
     from karpenter_tpu.controllers.provisioning import universe_constraints
     from karpenter_tpu.ops.encode import encode
     from karpenter_tpu.parallel.mesh import solver_mesh
-    from karpenter_tpu.parallel.sharded_pack import pack_batch_sharded, pad_problems
+    from karpenter_tpu.parallel.sharded_pack import (
+        pack_batch_sharded_flat, pad_problems, unpack_batch_flat,
+    )
     from karpenter_tpu.solver.adapter import build_packables, pod_vector
 
     catalog = make_catalog(100)
@@ -203,25 +205,23 @@ def config_3_topology():
 
     mesh = solver_mesh(jax.devices()[:1])
     batch = pad_problems(problems, mesh.devices.size)
+    S, L = batch[0].shape[1], 64  # ~32 shapes/zone converge well under 64
 
     def run():
-        # iterations bound the per-chunk shape steps; ~32 shapes per zone
-        # problem need well under 128 (each step retires at least one shape
-        # run via the fast-forward)
-        out = pack_batch_sharded(*batch[:-1], num_iters=128, mesh=mesh)
-        for x in out:
-            x.block_until_ready()
-        return out
+        # ONE flattened output buffer + ONE fetch: the tunnel RTT (~tens of
+        # ms) dominates the kernel, so extra awaited outputs are pure waste
+        buf = pack_batch_sharded_flat(*batch[:-1], num_iters=L, mesh=mesh)
+        return np.asarray(buf)
 
     out = run()  # warm-up
-    done = np.asarray(out[2])
+    _, _, done, _, q, _ = unpack_batch_flat(out, S, L)
     assert done.all(), "batch solve must converge in one chunk for the bench"
     times = []
     for _ in range(ITERS):
         t0 = time.perf_counter()
         run()
         times.append(time.perf_counter() - t0)
-    node_count = int(sum(int(q[q > 0].sum()) for q in np.asarray(out[4])))
+    node_count = int(q[q > 0].sum())
     return {"pods": 20_000, "zones": 3, "p99_ms": round(_p99(times), 3),
             "median_ms": round(_median(times), 3), "node_count": node_count,
             "pods_per_sec": round(20_000 / (sorted(times)[len(times) // 2] or 1e-9))}

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from karpenter_tpu.ops.pack import pack_chunk
+from karpenter_tpu.ops.pack import pack_chunk, pack_chunk_flat, unpack_flat
 
 
 def _pack_one_problem(shapes, counts, dropped, totals, reserved0, valid,
@@ -56,6 +56,42 @@ def pack_batch_sharded(
         in_specs=(spec,) * 8,
         out_specs=(spec,) * 6,
     )(shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit)
+
+
+@functools.partial(jax.jit, static_argnames=("num_iters", "mesh"))
+def pack_batch_sharded_flat(
+    shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
+    *,
+    num_iters: int,
+    mesh: Mesh,
+):
+    """pack_batch_sharded with the six per-problem outputs flattened into ONE
+    (B, 2S+1+2L+L·S) int32 buffer. The TPU sits behind a tunnel whose
+    round-trip latency (~tens of ms) dwarfs the kernel compute (~ms), so a
+    batch solve must cost exactly one device→host fetch — six separately
+    awaited outputs would each pay a full RTT. Each row is exactly one
+    ops.pack.pack_chunk_flat buffer (the layout lives only there)."""
+    vmapped = jax.vmap(
+        functools.partial(pack_chunk_flat, num_iters=num_iters),
+        in_axes=(0, 0, 0, 0, 0, 0, 0, 0))
+    spec = P("batch")
+    return shard_map(
+        vmapped, mesh=mesh,
+        in_specs=(spec,) * 8,
+        out_specs=spec,
+    )(shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit)
+
+
+def unpack_batch_flat(buf, S: int, L: int):
+    """Split a pack_batch_sharded_flat buffer (host numpy, shape (B, ·)) into
+    batched per-problem components via ops.pack.unpack_flat (single source of
+    truth for the row layout)."""
+    import numpy as np
+
+    rows = [unpack_flat(row, S, L) for row in buf]
+    counts_f, dropped_f, done, chosen, q, packed = (
+        np.stack([r[i] for r in rows]) for i in range(6))
+    return counts_f, dropped_f, done.astype(bool), chosen, q, packed
 
 
 def pad_problems(problems, mesh_size: int):

@@ -6,7 +6,9 @@ from tests.conftest import cpu_mesh_devices
 from karpenter_tpu.cloudprovider.fake.provider import instance_types
 from karpenter_tpu.ops.encode import encode
 from karpenter_tpu.parallel.mesh import solver_mesh
-from karpenter_tpu.parallel.sharded_pack import pack_batch_sharded, pad_problems
+from karpenter_tpu.parallel.sharded_pack import (
+    pack_batch_sharded, pack_batch_sharded_flat, pad_problems, unpack_batch_flat,
+)
 from karpenter_tpu.solver import host_ffd
 from karpenter_tpu.solver.adapter import build_packables, pod_vector
 from tests.test_pack_parity import allow_all_constraints, make_pod
@@ -45,3 +47,16 @@ def test_batch_sharded_matches_host():
     for b in range(B):
         node_count = int(q_seq[b][q_seq[b] > 0].sum())
         assert node_count == hosts[b].node_count, f"problem {b}"
+
+    # the single-fetch flat variant must agree component-for-component
+    buf = np.asarray(pack_batch_sharded_flat(
+        shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
+        num_iters=64, mesh=mesh))
+    fc, fd, fdone, fchosen, fq, fpacked = unpack_batch_flat(
+        buf, shapes.shape[1], 64)
+    np.testing.assert_array_equal(fc, counts_f)
+    np.testing.assert_array_equal(fd, dropped_f)
+    np.testing.assert_array_equal(fdone, done_f)
+    np.testing.assert_array_equal(fchosen, np.asarray(chosen_seq))
+    np.testing.assert_array_equal(fq, np.asarray(q_seq))
+    np.testing.assert_array_equal(fpacked, np.asarray(packed_seq))
