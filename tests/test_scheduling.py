@@ -1,0 +1,360 @@
+"""Scheduling suite: combined constraints, well-known labels, preferential
+fallback, taints — mirrors pkg/controllers/provisioning/scheduling/
+suite_test.go (sections at lines 81 Combined Constraints / 314 Preferential
+Fallback / 641 Taints; the Topology section lives in tests/test_topology.py).
+"""
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.constraints import Constraints, Taints
+from karpenter_tpu.api.core import (
+    Affinity, NodeAffinity, NodeSelectorRequirement as Req, NodeSelectorTerm,
+    PreferredSchedulingTerm, Taint, Toleration,
+)
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.cloudprovider.fake.provider import FakeCloudProvider, instance_types
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.scheduling.batcher import Batcher
+
+from tests.expectations import (
+    expect_not_scheduled, expect_provisioned, expect_scheduled,
+    make_provisioner, unschedulable_pod,
+)
+
+ZONE = wellknown.LABEL_TOPOLOGY_ZONE
+
+
+@pytest.fixture()
+def env():
+    kube = KubeCore()
+    provider = FakeCloudProvider(catalog=instance_types(10))
+    provisioning = ProvisioningController(
+        kube, provider,
+        batcher_factory=lambda: Batcher(idle_seconds=0.05, max_seconds=2.0))
+    selection = SelectionController(kube, provisioning)
+    yield kube, provider, provisioning, selection
+    for w in provisioning.workers.values():
+        w.stop()
+
+
+def setup_provisioner(kube, provisioning, **spec_kwargs):
+    provisioner = make_provisioner(**spec_kwargs)
+    kube.create(provisioner)
+    provisioning.reconcile(provisioner.metadata.name)
+    return provisioner
+
+
+def required_affinity(*terms):
+    return Affinity(node_affinity=NodeAffinity(
+        required=[NodeSelectorTerm(match_expressions=list(t)) for t in terms]))
+
+
+def preferred_affinity(*weighted_terms):
+    return Affinity(node_affinity=NodeAffinity(preferred=[
+        PreferredSchedulingTerm(
+            weight=w, preference=NodeSelectorTerm(match_expressions=list(t)))
+        for w, t in weighted_terms
+    ]))
+
+
+def node_of(kube, pod):
+    return kube.get("Node", expect_scheduled(kube, pod), "")
+
+
+class TestCombinedConstraintsCustomLabels:
+    """suite_test.go:82-133."""
+
+    def test_unconstrained_pod_schedules_despite_provisioner_labels(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning, constraints=Constraints(
+            labels={"test-key": "test-value"}))
+        pods = [unschedulable_pod()]
+        expect_provisioned(kube, selection, provisioning, pods)
+        node = node_of(kube, pods[0])
+        assert node.metadata.labels["test-key"] == "test-value"
+
+    def test_conflicting_node_selector_not_scheduled(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning, constraints=Constraints(
+            labels={"test-key": "test-value"}))
+        # labels are NOT requirements (constraints.go:46-56): an unknown
+        # selector key has an empty requirement set and is rejected
+        pod = unschedulable_pod(node_selector={"test-key": "different-value"})
+        kube.create(pod)
+        selection.reconcile(pod.metadata.name)
+        expect_not_scheduled(kube, pod)
+
+    def test_matching_custom_requirement_schedules(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning, constraints=Constraints(
+            requirements=Requirements([Req(key="test-key", operator="In",
+                                           values=["test-value"])])))
+        pods = [unschedulable_pod(node_selector={"test-key": "test-value"})]
+        expect_provisioned(kube, selection, provisioning, pods)
+        expect_scheduled(kube, pods[0])
+
+    def test_conflicting_custom_requirement_not_scheduled(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning, constraints=Constraints(
+            requirements=Requirements([Req(key="test-key", operator="In",
+                                           values=["test-value"])])))
+        pod = unschedulable_pod(node_selector={"test-key": "different-value"})
+        kube.create(pod)
+        selection.reconcile(pod.metadata.name)
+        expect_not_scheduled(kube, pod)
+
+    def test_matching_preference_schedules(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning, constraints=Constraints(
+            requirements=Requirements([Req(key="test-key", operator="In",
+                                           values=["test-value"])])))
+        pods = [unschedulable_pod(affinity=preferred_affinity(
+            (1, [Req(key="test-key", operator="In", values=["test-value"])])))]
+        expect_provisioned(kube, selection, provisioning, pods)
+        expect_scheduled(kube, pods[0])
+
+    def test_conflicting_preference_not_scheduled_first_pass(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning, constraints=Constraints(
+            requirements=Requirements([Req(key="test-key", operator="In",
+                                           values=["test-value"])])))
+        pod = unschedulable_pod(affinity=preferred_affinity(
+            (1, [Req(key="test-key", operator="NotIn", values=["test-value"])])))
+        kube.create(pod)
+        selection.reconcile(pod.metadata.name)
+        expect_not_scheduled(kube, pod)
+
+
+class TestWellKnownLabels:
+    """suite_test.go:135-312."""
+
+    def test_provisioner_zone_constraint_flows_to_node(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning, constraints=Constraints(
+            requirements=Requirements([Req(key=ZONE, operator="In",
+                                           values=["test-zone-2"])])))
+        pods = [unschedulable_pod()]
+        expect_provisioned(kube, selection, provisioning, pods)
+        assert node_of(kube, pods[0]).metadata.labels[ZONE] == "test-zone-2"
+
+    def test_node_selector_outside_provisioner_constraint_rejected(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning, constraints=Constraints(
+            requirements=Requirements([Req(key=ZONE, operator="In",
+                                           values=["test-zone-1"])])))
+        pod = unschedulable_pod(node_selector={ZONE: "test-zone-2"})
+        kube.create(pod)
+        selection.reconcile(pod.metadata.name)
+        expect_not_scheduled(kube, pod)
+
+    def test_unknown_node_selector_value_rejected(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pod = unschedulable_pod(node_selector={ZONE: "no-such-zone"})
+        kube.create(pod)
+        selection.reconcile(pod.metadata.name)
+        expect_not_scheduled(kube, pod)
+
+    def test_compatible_required_affinity_in(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pods = [unschedulable_pod(affinity=required_affinity(
+            [Req(key=ZONE, operator="In", values=["test-zone-3"])]))]
+        expect_provisioned(kube, selection, provisioning, pods)
+        assert node_of(kube, pods[0]).metadata.labels[ZONE] == "test-zone-3"
+
+    def test_compatible_required_affinity_notin(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pods = [unschedulable_pod(affinity=required_affinity(
+            [Req(key=ZONE, operator="NotIn",
+                 values=["test-zone-1", "test-zone-2"])]))]
+        expect_provisioned(kube, selection, provisioning, pods)
+        assert node_of(kube, pods[0]).metadata.labels[ZONE] == "test-zone-3"
+
+    def test_incompatible_required_affinity_in(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning, constraints=Constraints(
+            requirements=Requirements([Req(key=ZONE, operator="In",
+                                           values=["test-zone-1"])])))
+        pod = unschedulable_pod(affinity=required_affinity(
+            [Req(key=ZONE, operator="In", values=["test-zone-2"])]))
+        kube.create(pod)
+        selection.reconcile(pod.metadata.name)
+        expect_not_scheduled(kube, pod)
+
+    def test_incompatible_notin_strips_all_zones(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning, constraints=Constraints(
+            requirements=Requirements([Req(key=ZONE, operator="In",
+                                           values=["test-zone-1"])])))
+        pod = unschedulable_pod(affinity=required_affinity(
+            [Req(key=ZONE, operator="NotIn", values=["test-zone-1"])]))
+        kube.create(pod)
+        selection.reconcile(pod.metadata.name)
+        expect_not_scheduled(kube, pod)
+
+    def test_multidimensional_selector_preference_requirement(self, env):
+        """suite_test.go:271-291: selectors + preferences + requirements all
+        intersect; the surviving cell wins."""
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning, constraints=Constraints(
+            requirements=Requirements([
+                Req(key=ZONE, operator="In",
+                    values=["test-zone-1", "test-zone-2", "test-zone-3"]),
+            ])))
+        affinity = preferred_affinity(
+            (1, [Req(key=ZONE, operator="NotIn", values=["test-zone-1"])]))
+        affinity.node_affinity.required = [NodeSelectorTerm(match_expressions=[
+            Req(key=ZONE, operator="In", values=["test-zone-2", "test-zone-3"]),
+        ])]
+        pods = [unschedulable_pod(
+            node_selector={ZONE: "test-zone-3"}, affinity=affinity)]
+        expect_provisioned(kube, selection, provisioning, pods)
+        assert node_of(kube, pods[0]).metadata.labels[ZONE] == "test-zone-3"
+
+    def test_beta_zone_label_alias_normalized(self, env):
+        """NormalizedLabels (requirements.go:65-70): the beta alias maps to
+        the GA topology key."""
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pods = [unschedulable_pod(
+            node_selector={"failure-domain.beta.kubernetes.io/zone": "test-zone-2"})]
+        expect_provisioned(kube, selection, provisioning, pods)
+        assert node_of(kube, pods[0]).metadata.labels[ZONE] == "test-zone-2"
+
+
+class TestPreferentialFallback:
+    """suite_test.go:314-417: relaxation across retries (preferences.go)."""
+
+    def reconcile_until_scheduled(self, kube, selection, pod, attempts=5):
+        for _ in range(attempts):
+            selection.reconcile(pod.metadata.name)
+            stored = kube.get("Pod", pod.metadata.name)
+            if stored.spec.node_name:
+                return stored
+        return kube.get("Pod", pod.metadata.name)
+
+    def test_never_relaxes_the_final_required_term(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pod = unschedulable_pod(affinity=required_affinity(
+            [Req(key=ZONE, operator="In", values=["invalid-zone"])]))
+        kube.create(pod)
+        stored = self.reconcile_until_scheduled(kube, selection, pod, attempts=4)
+        assert not stored.spec.node_name
+
+    def test_relaxes_required_or_terms_until_valid(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pod = unschedulable_pod(affinity=required_affinity(
+            [Req(key=ZONE, operator="In", values=["invalid-a"])],
+            [Req(key=ZONE, operator="In", values=["invalid-b"])],
+            [Req(key=ZONE, operator="In", values=["test-zone-1"])],
+        ))
+        kube.create(pod)
+        stored = self.reconcile_until_scheduled(kube, selection, pod)
+        assert stored.spec.node_name
+        node = kube.get("Node", stored.spec.node_name, "")
+        assert node.metadata.labels[ZONE] == "test-zone-1"
+
+    def test_relaxes_preferred_terms_heaviest_first(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pod = unschedulable_pod(affinity=preferred_affinity(
+            (1, [Req(key=ZONE, operator="In", values=["test-zone-1"])]),
+            (100, [Req(key=ZONE, operator="In", values=["invalid-zone"])]),
+        ))
+        kube.create(pod)
+        stored = self.reconcile_until_scheduled(kube, selection, pod)
+        assert stored.spec.node_name
+        node = kube.get("Node", stored.spec.node_name, "")
+        # the invalid weight-100 term was stripped; weight-1 then applied
+        assert node.metadata.labels[ZONE] == "test-zone-1"
+
+    def test_relaxes_all_preferred_terms_to_unconstrained(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pod = unschedulable_pod(affinity=preferred_affinity(
+            (2, [Req(key=ZONE, operator="In", values=["invalid-a"])]),
+            (1, [Req(key=ZONE, operator="In", values=["invalid-b"])]),
+        ))
+        kube.create(pod)
+        stored = self.reconcile_until_scheduled(kube, selection, pod)
+        assert stored.spec.node_name
+
+
+class TestTaints:
+    """suite_test.go:641-686."""
+
+    def test_nodes_carry_provisioner_taints(self, env):
+        kube, provider, provisioning, selection = env
+        taint = Taint(key="test", value="bar", effect="NoSchedule")
+        setup_provisioner(kube, provisioning,
+                          constraints=Constraints(taints=Taints([taint])))
+        pods = [unschedulable_pod(tolerations=[
+            Toleration(operator="Exists", effect="NoSchedule")])]
+        expect_provisioned(kube, selection, provisioning, pods)
+        node = node_of(kube, pods[0])
+        assert any(t.key == "test" and t.value == "bar" and
+                   t.effect == "NoSchedule" for t in node.spec.taints)
+
+    def test_toleration_matrix(self, env):
+        kube, provider, provisioning, selection = env
+        taint = Taint(key="test-key", value="test-value", effect="NoSchedule")
+        setup_provisioner(kube, provisioning,
+                          constraints=Constraints(taints=Taints([taint])))
+        schedulable = [
+            unschedulable_pod(tolerations=[Toleration(
+                key="test-key", operator="Exists", effect="NoSchedule")]),
+            unschedulable_pod(tolerations=[Toleration(
+                key="test-key", operator="Equal", value="test-value",
+                effect="NoSchedule")]),
+        ]
+        expect_provisioned(kube, selection, provisioning, schedulable)
+        for p in schedulable:
+            expect_scheduled(kube, p)
+        unschedulable = [
+            unschedulable_pod(),  # missing toleration
+            unschedulable_pod(tolerations=[Toleration(
+                key="invalid", operator="Exists")]),  # key mismatch
+            unschedulable_pod(tolerations=[Toleration(
+                key="test-key", operator="Equal", effect="NoSchedule")]),  # value mismatch
+        ]
+        for p in unschedulable:
+            kube.create(p)
+            selection.reconcile(p.metadata.name)
+            expect_not_scheduled(kube, p)
+
+    def test_opexists_toleration_generates_no_taints(self, env):
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pods = [unschedulable_pod(tolerations=[Toleration(
+            key="test-key", operator="Exists", effect="NoExecute")])]
+        expect_provisioned(kube, selection, provisioning, pods)
+        node = node_of(kube, pods[0])
+        # only the not-ready startup taint — nothing generated from OpExists
+        assert [t.key for t in node.spec.taints] == [wellknown.NOT_READY_TAINT_KEY]
+
+    def test_with_pod_generates_taints_for_equal_tolerations(self):
+        """Taints.with_pod semantics (taints.go:27-53) — behavior the
+        reference skips wiring into scheduling but keeps in the API."""
+        base = Taints([Taint(key="existing", value="v", effect="NoSchedule")])
+        pod = unschedulable_pod(tolerations=[
+            Toleration(key="a", operator="Equal", value="1", effect="NoSchedule"),
+            Toleration(key="b", operator="Equal", value="2"),  # all effects
+            Toleration(key="c", operator="Exists"),            # ignored
+            Toleration(key="existing", operator="Equal", value="v",
+                       effect="NoSchedule"),                   # deduped
+        ])
+        out = base.with_pod(pod)
+        got = {(t.key, t.value, t.effect) for t in out}
+        assert got == {
+            ("existing", "v", "NoSchedule"),
+            ("a", "1", "NoSchedule"),
+            ("b", "2", "NoSchedule"),
+            ("b", "2", "NoExecute"),
+        }
