@@ -1,0 +1,117 @@
+"""Provisioner manifest codec: CRD JSON/YAML dicts ⟷ API dataclasses.
+
+Reference: the v1alpha5 CRD schema (charts/karpenter/crds/
+karpenter.sh_provisioners.yaml; mirrored at deploy/crds/) and the Go type
+JSON tags in pkg/apis/provisioning/v1alpha5/{provisioner.go,constraints.go}.
+Used by the admission webhook server (webhooks/server.py) and by anything
+loading `kubectl`-shaped manifests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from karpenter_tpu.api.constraints import Constraints, KubeletConfiguration, Limits, Taints
+from karpenter_tpu.api.core import NodeSelectorRequirement, ObjectMeta, Taint
+from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.utils.resources import parse_resource_list
+
+API_VERSION = "karpenter.sh/v1alpha5"
+KIND = "Provisioner"
+
+
+def provisioner_from_manifest(manifest: Dict[str, Any]) -> Provisioner:
+    """Decode a CRD-shaped dict (what the API server posts to the webhook)."""
+    meta = manifest.get("metadata") or {}
+    spec = manifest.get("spec") or {}
+    constraints = Constraints(
+        labels=dict(spec.get("labels") or {}),
+        taints=Taints([
+            Taint(key=t.get("key", ""), value=t.get("value", ""),
+                  effect=t.get("effect", "NoSchedule"))
+            for t in (spec.get("taints") or [])
+        ]),
+        requirements=Requirements([
+            NodeSelectorRequirement(
+                key=r.get("key", ""), operator=r.get("operator", "In"),
+                values=list(r.get("values") or []))
+            for r in (spec.get("requirements") or [])
+        ]),
+        kubelet_configuration=KubeletConfiguration(
+            cluster_dns=list((spec.get("kubeletConfiguration") or {})
+                             .get("clusterDNS") or [])),
+        provider=spec.get("provider"),
+    )
+    limits_res = (spec.get("limits") or {}).get("resources")
+    return Provisioner(
+        metadata=ObjectMeta(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            labels=dict(meta.get("labels") or {}),
+            annotations=dict(meta.get("annotations") or {}),
+            uid=meta.get("uid", ""),
+        ),
+        spec=ProvisionerSpec(
+            constraints=constraints,
+            ttl_seconds_after_empty=spec.get("ttlSecondsAfterEmpty"),
+            ttl_seconds_until_expired=spec.get("ttlSecondsUntilExpired"),
+            limits=Limits(resources=parse_resource_list(
+                {k: str(v) for k, v in limits_res.items()}) if limits_res else None),
+            consolidation_enabled=bool(spec.get("consolidation", {}).get("enabled"))
+            if isinstance(spec.get("consolidation"), dict) else False,
+        ),
+    )
+
+
+def provisioner_to_manifest(p: Provisioner) -> Dict[str, Any]:
+    """Encode back to the CRD shape. Inverse of provisioner_from_manifest for
+    every field the CRD declares (round-trip tested)."""
+    c = p.spec.constraints
+    spec: Dict[str, Any] = {}
+    if c.labels:
+        spec["labels"] = dict(c.labels)
+    if c.taints:
+        spec["taints"] = [
+            {"key": t.key, **({"value": t.value} if t.value else {}),
+             "effect": t.effect}
+            for t in c.taints
+        ]
+    if len(c.requirements):
+        # preserve value order: the defaulting webhook diffs original vs
+        # round-tripped manifests, and normalizing here would patch every
+        # user manifest even when no defaults applied
+        spec["requirements"] = [
+            {"key": r.key, "operator": r.operator, "values": list(r.values)}
+            for r in c.requirements.items
+        ]
+    if c.kubelet_configuration.cluster_dns:
+        spec["kubeletConfiguration"] = {
+            "clusterDNS": list(c.kubelet_configuration.cluster_dns)}
+    if c.provider is not None:
+        spec["provider"] = c.provider
+    if p.spec.ttl_seconds_after_empty is not None:
+        spec["ttlSecondsAfterEmpty"] = p.spec.ttl_seconds_after_empty
+    if p.spec.ttl_seconds_until_expired is not None:
+        spec["ttlSecondsUntilExpired"] = p.spec.ttl_seconds_until_expired
+    if p.spec.limits.resources:
+        spec["limits"] = {"resources": {
+            k: str(q) for k, q in p.spec.limits.resources.items()}}
+    if p.spec.consolidation_enabled:
+        spec["consolidation"] = {"enabled": True}
+    manifest: Dict[str, Any] = {
+        "apiVersion": API_VERSION,
+        "kind": KIND,
+        "metadata": {"name": p.metadata.name},
+        "spec": spec,
+    }
+    meta = manifest["metadata"]
+    if p.metadata.namespace and p.metadata.namespace != "default":
+        meta["namespace"] = p.metadata.namespace
+    if p.metadata.labels:
+        meta["labels"] = dict(p.metadata.labels)
+    if p.metadata.annotations:
+        meta["annotations"] = dict(p.metadata.annotations)
+    if p.metadata.uid:
+        meta["uid"] = p.metadata.uid
+    return manifest

@@ -259,6 +259,13 @@ class DaemonSet:
 
 
 @dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+    kind: str = "ConfigMap"
+
+
+@dataclass
 class PersistentVolumeClaimSpec:
     storage_class_name: Optional[str] = None
     volume_name: str = ""

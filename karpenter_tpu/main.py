@@ -18,6 +18,7 @@ from karpenter_tpu.cloudprovider.fake import provider as _fake  # registers "fak
 from karpenter_tpu.config.options import Options, parse
 from karpenter_tpu.controllers.consolidation import ConsolidationController
 from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.logging_config import LoggingConfigController
 from karpenter_tpu.controllers.metrics_controllers import (
     NodeMetricsController, PodMetricsController,
 )
@@ -73,6 +74,8 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
     manager.register(PVCController(kube))
     manager.register(NodeMetricsController(kube))
     manager.register(PodMetricsController(kube))
+    # live log-level reload from config-logging (cmd/controller/main.go:105-117)
+    manager.register(LoggingConfigController(kube))
     return manager
 
 

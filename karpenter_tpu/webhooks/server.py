@@ -1,0 +1,157 @@
+"""Admission webhook server: the reference's second binary.
+
+Reference: cmd/webhook/main.go — knative sharedmain serving
+``/default-resource`` (mutating/defaulting) and ``/validate-resource``
+(validating) admission webhooks for the Provisioner CRD, plus a health
+endpoint. Here: a stdlib ThreadingHTTPServer speaking the Kubernetes
+``admission.k8s.io/v1`` AdmissionReview protocol — defaulting responds with
+a base64 JSONPatch, validation with allowed/denied + message. Cloud
+providers hook in via spi.CloudProvider.default/validate exactly as the
+registry wires DefaultHook/ValidateHook (v1alpha5/register.go:27-29).
+
+Run: ``python -m karpenter_tpu.webhooks.server [--port 8443]`` (plain HTTP;
+terminate TLS in front — the reference's cert controller is deploy-time
+concern, see deploy/admission.yaml).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import logging
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from karpenter_tpu.api.codec import provisioner_from_manifest, provisioner_to_manifest
+from karpenter_tpu.cloudprovider.spi import CloudProvider
+from karpenter_tpu.webhooks.admission import default_provisioner, validate_provisioner
+
+log = logging.getLogger("karpenter.webhook")
+
+
+def _json_patch(before: Dict[str, Any], after: Dict[str, Any],
+                path: str = "") -> List[Dict[str, Any]]:
+    """Minimal RFC-6902 diff (add/replace/remove) over nested dicts — enough
+    for defaulting patches, which only fill in missing spec fields."""
+    ops: List[Dict[str, Any]] = []
+    for key in before:
+        if key not in after:
+            escaped = key.replace("~", "~0").replace("/", "~1")
+            ops.append({"op": "remove", "path": f"{path}/{escaped}"})
+    for key, value in after.items():
+        here = f"{path}/{key.replace('~', '~0').replace('/', '~1')}"
+        if key not in before:
+            ops.append({"op": "add", "path": here, "value": value})
+        elif isinstance(value, dict) and isinstance(before[key], dict):
+            ops.extend(_json_patch(before[key], value, here))
+        elif before[key] != value:
+            ops.append({"op": "replace", "path": here, "value": value})
+    return ops
+
+
+def default_review(review: Dict[str, Any],
+                   cloud_provider: Optional[CloudProvider] = None) -> Dict[str, Any]:
+    """Handle a /default-resource AdmissionReview: decode, apply defaults,
+    respond with a JSONPatch from the original to the defaulted object."""
+    request = review.get("request") or {}
+    obj = request.get("object") or {}
+    provisioner = provisioner_from_manifest(obj)
+    default_provisioner(provisioner, cloud_provider)
+    defaulted = provisioner_to_manifest(provisioner)
+    # defaulting only ever FILLS fields: keep add/replace under /spec and
+    # drop every remove — the codec round-trip is lossy for fields it does
+    # not model (status, unknown vendor keys), and those must survive
+    patch = [op for op in _json_patch(obj, defaulted)
+             if op["path"].startswith("/spec") and op["op"] != "remove"]
+    response: Dict[str, Any] = {"uid": request.get("uid", ""), "allowed": True}
+    if patch:
+        response["patchType"] = "JSONPatch"
+        response["patch"] = base64.b64encode(
+            json.dumps(patch).encode()).decode()
+    return _review_reply(response)
+
+
+def validate_review(review: Dict[str, Any],
+                    cloud_provider: Optional[CloudProvider] = None) -> Dict[str, Any]:
+    """Handle a /validate-resource AdmissionReview."""
+    request = review.get("request") or {}
+    provisioner = provisioner_from_manifest(request.get("object") or {})
+    errs = validate_provisioner(provisioner, cloud_provider)
+    response: Dict[str, Any] = {"uid": request.get("uid", ""),
+                                "allowed": not errs}
+    if errs:
+        response["status"] = {"code": 400, "message": "; ".join(errs)}
+    return _review_reply(response)
+
+
+def _review_reply(response: Dict[str, Any]) -> Dict[str, Any]:
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "response": response}
+
+
+class AdmissionHandler(BaseHTTPRequestHandler):
+    cloud_provider: Optional[CloudProvider] = None
+
+    def log_message(self, fmt, *args):  # route through our logger
+        log.debug(fmt, *args)
+
+    def do_GET(self):
+        if self.path in ("/healthz", "/readyz"):
+            self._reply(200, b"ok", "text/plain")
+        else:
+            self._reply(404, b"not found", "text/plain")
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        uid = ""
+        try:
+            review = json.loads(self.rfile.read(length) or b"{}")
+            uid = (review.get("request") or {}).get("uid", "")
+            if self.path == "/default-resource":
+                reply = default_review(review, self.cloud_provider)
+            elif self.path == "/validate-resource":
+                reply = validate_review(review, self.cloud_provider)
+            else:
+                self._reply(404, b"not found", "text/plain")
+                return
+        except Exception as e:  # malformed review must not kill the server
+            log.exception("admission request failed")
+            # echo the request uid — the API server discards uid-mismatched
+            # responses, which would swallow the error message
+            reply = _review_reply({
+                "uid": uid, "allowed": False,
+                "status": {"code": 400, "message": f"bad request: {e}"}})
+        self._reply(200, json.dumps(reply).encode(), "application/json")
+
+    def _reply(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def serve(port: int = 8443,
+          cloud_provider: Optional[CloudProvider] = None) -> ThreadingHTTPServer:
+    handler = type("BoundAdmissionHandler", (AdmissionHandler,),
+                   {"cloud_provider": cloud_provider})
+    server = ThreadingHTTPServer(("0.0.0.0", port), handler)
+    log.info("admission webhook listening on :%d", port)
+    return server
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(description="karpenter-tpu admission webhook")
+    parser.add_argument("--port", type=int, default=8443)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = serve(args.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

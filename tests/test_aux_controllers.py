@@ -142,3 +142,74 @@ class TestMetrics:
         text = reg.expose()
         assert "karpenter_nodes_allocatable" in text
         assert "karpenter_binpacking_duration_seconds_bucket" in text
+
+
+class TestLoggingConfig:
+    """Live log-level reload from config-logging (controllers/logging_config)."""
+
+    def _reconcile(self, data, root="karpenter-test"):
+        import uuid
+
+        from karpenter_tpu.api.core import ConfigMap
+        from karpenter_tpu.controllers.logging_config import LoggingConfigController
+
+        kube = KubeCore()
+        root = f"{root}-{uuid.uuid4().hex[:6]}"
+        kube.create(ConfigMap(metadata=ObjectMeta(name="config-logging"), data=data))
+        LoggingConfigController(kube, root_logger=root).reconcile("config-logging")
+        return root
+
+    def test_sets_root_level_from_zap_config(self):
+        import logging
+
+        root = self._reconcile({"zap-logger-config": '{"level": "debug"}'})
+        assert logging.getLogger(root).level == logging.DEBUG
+
+    def test_component_override(self):
+        import logging
+
+        root = self._reconcile({"loglevel.solver": "error"})
+        assert logging.getLogger(f"{root}.solver").level == logging.ERROR
+
+    def test_invalid_config_ignored(self):
+        import logging
+
+        root = self._reconcile({"zap-logger-config": "not json"})
+        assert logging.getLogger(root).level == logging.NOTSET
+
+    def test_unknown_level_rejected_by_validation(self):
+        from karpenter_tpu.controllers.logging_config import validate_config
+
+        assert validate_config({"loglevel.x": "loud"}) is not None
+        assert validate_config({"zap-logger-config": '{"level": "nope"}'}) is not None
+        assert validate_config({"zap-logger-config": '{"level": "warn"}'}) is None
+
+    def test_other_configmaps_ignored(self):
+        from karpenter_tpu.api.core import ConfigMap
+        from karpenter_tpu.controllers.logging_config import LoggingConfigController
+
+        kube = KubeCore()
+        kube.create(ConfigMap(metadata=ObjectMeta(name="other"), data={}))
+        assert LoggingConfigController(kube).reconcile("other") is None
+
+    def test_non_object_zap_config_ignored_not_crash(self):
+        import logging
+
+        root = self._reconcile({"zap-logger-config": '"debug"'})
+        assert logging.getLogger(root).level == logging.NOTSET
+
+    def test_foreign_namespace_config_ignored(self):
+        import logging
+        import uuid
+
+        from karpenter_tpu.api.core import ConfigMap
+        from karpenter_tpu.controllers.logging_config import LoggingConfigController
+
+        kube = KubeCore()
+        root = f"karpenter-ns-{uuid.uuid4().hex[:6]}"
+        kube.create(ConfigMap(
+            metadata=ObjectMeta(name="config-logging", namespace="tenant"),
+            data={"zap-logger-config": '{"level": "debug"}'}))
+        LoggingConfigController(kube, root_logger=root).reconcile(
+            "config-logging", "tenant")
+        assert logging.getLogger(root).level == logging.NOTSET

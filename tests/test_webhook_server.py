@@ -1,0 +1,184 @@
+"""Admission webhook server + manifest codec.
+
+Reference: cmd/webhook/main.go (defaulting `/default-resource`, validation
+`/validate-resource`) and the v1alpha5 CRD schema. Requests are genuine
+admission.k8s.io/v1 AdmissionReviews over HTTP against a live server.
+"""
+
+import base64
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api.codec import provisioner_from_manifest, provisioner_to_manifest
+from karpenter_tpu.api.core import NodeSelectorRequirement as Req
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.webhooks.server import serve
+
+MANIFEST = {
+    "apiVersion": "karpenter.sh/v1alpha5",
+    "kind": "Provisioner",
+    "metadata": {"name": "default"},
+    "spec": {
+        "labels": {"team": "ml"},
+        "taints": [{"key": "dedicated", "value": "ml", "effect": "NoSchedule"}],
+        "requirements": [
+            {"key": "topology.kubernetes.io/zone", "operator": "In",
+             "values": ["us-west-2a", "us-west-2b"]},
+        ],
+        "kubeletConfiguration": {"clusterDNS": ["10.0.0.10"]},
+        "provider": {"instanceProfile": "karpenter-node"},
+        "ttlSecondsAfterEmpty": 30,
+        "ttlSecondsUntilExpired": 2592000,
+        "limits": {"resources": {"cpu": "1000", "memory": "1000Gi"}},
+    },
+}
+
+
+class StubProvider:
+    """Minimal SPI surface for the webhook hooks."""
+
+    def default(self, constraints):
+        if constraints.requirements.capacity_types() is None:
+            constraints.requirements = constraints.requirements.add(
+                Req(key="karpenter.sh/capacity-type", operator="In",
+                    values=["on-demand"]))
+
+    def validate(self, constraints):
+        if constraints.provider is not None and \
+                not constraints.provider.get("instanceProfile"):
+            return "provider.instanceProfile: required"
+        return None
+
+
+class TestCodec:
+    def test_round_trip(self):
+        p = provisioner_from_manifest(MANIFEST)
+        assert p.metadata.name == "default"
+        assert p.spec.constraints.labels == {"team": "ml"}
+        assert p.spec.constraints.taints[0].key == "dedicated"
+        assert p.spec.constraints.requirements.zones() == {
+            "us-west-2a", "us-west-2b"}
+        assert p.spec.constraints.provider == {"instanceProfile": "karpenter-node"}
+        assert p.spec.ttl_seconds_after_empty == 30
+        assert str(p.spec.limits.resources["cpu"]) == "1000"
+        assert provisioner_to_manifest(p) == MANIFEST
+
+    def test_empty_spec(self):
+        p = provisioner_from_manifest({"metadata": {"name": "bare"}})
+        assert p.spec.constraints.provider is None
+        assert p.spec.limits.resources is None
+        out = provisioner_to_manifest(p)
+        assert out["spec"] == {}
+
+
+@pytest.fixture()
+def webhook():
+    server = serve(port=0, cloud_provider=StubProvider())
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def post_review(base, path, obj, uid="test-uid"):
+    review = {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+              "request": {"uid": uid, "object": obj}}
+    req = urllib.request.Request(
+        base + path, data=json.dumps(review).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+class TestWebhookServer:
+    def test_healthz(self, webhook):
+        with urllib.request.urlopen(webhook + "/healthz") as resp:
+            assert resp.read() == b"ok"
+
+    def test_defaulting_returns_jsonpatch(self, webhook):
+        reply = post_review(webhook, "/default-resource", MANIFEST)
+        response = reply["response"]
+        assert response["uid"] == "test-uid"
+        assert response["allowed"] is True
+        patch = json.loads(base64.b64decode(response["patch"]))
+        # the stub provider injected the capacity-type requirement
+        added = [op for op in patch if "capacity-type" in json.dumps(op)]
+        assert added and all(op["path"].startswith("/spec") for op in patch)
+
+    def test_defaulting_noop_when_already_defaulted(self, webhook):
+        p = provisioner_from_manifest(MANIFEST)
+        StubProvider().default(p.spec.constraints)
+        reply = post_review(webhook, "/default-resource",
+                            provisioner_to_manifest(p))
+        assert "patch" not in reply["response"]
+
+    def test_validation_allows_good_manifest(self, webhook):
+        reply = post_review(webhook, "/validate-resource", MANIFEST)
+        assert reply["response"]["allowed"] is True
+
+    def test_validation_denies_bad_operator(self, webhook):
+        bad = json.loads(json.dumps(MANIFEST))
+        bad["spec"]["requirements"][0]["operator"] = "Exists"
+        reply = post_review(webhook, "/validate-resource", bad)
+        assert reply["response"]["allowed"] is False
+        assert "operator" in reply["response"]["status"]["message"]
+
+    def test_validation_denies_restricted_label(self, webhook):
+        bad = json.loads(json.dumps(MANIFEST))
+        bad["spec"]["labels"] = {"karpenter.sh/provisioner-name": "x"}
+        reply = post_review(webhook, "/validate-resource", bad)
+        assert reply["response"]["allowed"] is False
+
+    def test_validation_runs_provider_hook(self, webhook):
+        bad = json.loads(json.dumps(MANIFEST))
+        bad["spec"]["provider"] = {}
+        reply = post_review(webhook, "/validate-resource", bad)
+        assert reply["response"]["allowed"] is False
+        assert "instanceProfile" in reply["response"]["status"]["message"]
+
+    def test_defaulting_preserves_unmodeled_fields(self, webhook):
+        """Fields the codec does not model (spec.weight, unknown kubelet
+        keys) must never be removed by the defaulting patch."""
+        extended = json.loads(json.dumps(MANIFEST))
+        extended["spec"]["weight"] = 10
+        extended["spec"]["kubeletConfiguration"]["containerRuntime"] = "containerd"
+        reply = post_review(webhook, "/default-resource", extended)
+        patch = json.loads(base64.b64decode(reply["response"]["patch"]))
+        assert all(op["op"] != "remove" for op in patch)
+        assert all("weight" not in op["path"] and
+                   "containerRuntime" not in op["path"] for op in patch)
+
+    def test_defaulting_does_not_reorder_requirement_values(self, webhook):
+        unordered = json.loads(json.dumps(MANIFEST))
+        unordered["spec"]["requirements"][0]["values"] = ["us-west-2b", "us-west-2a"]
+        p = provisioner_from_manifest(unordered)
+        StubProvider().default(p.spec.constraints)
+        reply = post_review(webhook, "/default-resource",
+                            provisioner_to_manifest(p))
+        assert "patch" not in reply["response"]
+
+    def test_handler_exception_echoes_request_uid(self, webhook):
+        bad = json.loads(json.dumps(MANIFEST))
+        bad["spec"]["limits"] = {"resources": {"cpu": "not-a-quantity"}}
+        reply = post_review(webhook, "/default-resource", bad, uid="uid-42")
+        assert reply["response"]["allowed"] is False
+        assert reply["response"]["uid"] == "uid-42"
+
+    def test_malformed_body_is_denied_not_crash(self, webhook):
+        req = urllib.request.Request(
+            webhook + "/default-resource", data=b"not json",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            reply = json.loads(resp.read())
+        assert reply["response"]["allowed"] is False
+
+    def test_unknown_path_404(self, webhook):
+        req = urllib.request.Request(webhook + "/nope", data=b"{}")
+        try:
+            urllib.request.urlopen(req)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
