@@ -23,12 +23,23 @@ MAX_CHUNKS = 4096  # hard safety valve; each iteration provably makes progress
 _INT32_MAX = 2**31 - 1
 
 
+def default_kernel() -> str:
+    """Pallas on real TPU (fused VMEM state + early exit, ~4× less device
+    time than the XLA scan); the XLA kernel elsewhere — pallas interpret
+    mode on CPU is debug-speed only. Both are record-for-record parity
+    tested (tests/test_pack_pallas.py)."""
+    import jax
+
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
 def solve_ffd_device(
     pod_vecs: Sequence[Vec],
     pod_ids: Sequence[int],
     packables: Sequence[Packable],
     max_instance_types: int = MAX_INSTANCE_TYPES,
     chunk_iters: int = DEFAULT_CHUNK_ITERS,
+    kernel: Optional[str] = None,   # "xla" | "pallas" | None = auto
 ) -> Optional[HostSolveResult]:
     """Solve on device; None when the problem is not device-encodable
     (caller falls back to the host oracle). Pods may arrive unsorted; the
@@ -44,6 +55,23 @@ def solve_ffd_device(
     if enc is None:
         return None
 
+    if kernel is None:
+        kernel = default_kernel()
+    if kernel not in ("xla", "pallas"):
+        raise ValueError(f"unknown device kernel {kernel!r}: "
+                         "expected None, 'xla' or 'pallas'")
+    if kernel == "pallas":
+        import functools
+
+        from karpenter_tpu.ops.pack_pallas import pack_chunk_pallas_flat
+
+        # off-TPU (tests, dev laptops) Mosaic can't compile — interpret
+        _chunk = functools.partial(
+            pack_chunk_pallas_flat,
+            interpret=jax.default_backend() != "tpu")
+    else:
+        _chunk = pack_chunk_flat
+
     S, L = enc.shapes.shape[0], chunk_iters
     # one host→device transfer for the whole problem (tunnel-latency bound)
     dev = jax.device_put((
@@ -56,7 +84,7 @@ def solve_ffd_device(
     records = []  # (chosen, qty, packed-vector)
     dropped_h = None
     for _ in range(MAX_CHUNKS):
-        buf = pack_chunk_flat(
+        buf = _chunk(
             shapes, counts, dropped, totals, reserved0, valid, last_valid,
             pods_unit, num_iters=chunk_iters)
         # one device→host fetch per chunk; typical solves need one chunk

@@ -130,9 +130,16 @@ def pack_chunk_flat(
     with tens-of-ms round-trip latency; the 200 ms p99 budget is spent on
     RTTs, not FLOPs. Layout: [counts S | dropped S | done 1 | chosen L |
     q L | packed L*S]."""
-    counts_f, dropped_f, done_f, chosen_seq, q_seq, packed_seq = pack_chunk(
+    return flatten_chunk_outputs(*pack_chunk(
         shapes, counts, dropped, totals, reserved0, valid, last_valid,
-        pods_unit, num_iters=num_iters)
+        pods_unit, num_iters=num_iters))
+
+
+def flatten_chunk_outputs(counts_f, dropped_f, done_f, chosen_seq, q_seq,
+                          packed_seq):
+    """THE flat buffer layout (single source of truth, decoded by
+    unpack_flat): [counts S | dropped S | done 1 | chosen L | q L |
+    packed L·S]. Shared by the XLA and Pallas flat kernels."""
     return jnp.concatenate([
         counts_f, dropped_f, done_f.astype(jnp.int32)[None],
         chosen_seq.astype(jnp.int32), q_seq, packed_seq.reshape(-1),

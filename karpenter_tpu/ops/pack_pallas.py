@@ -1,0 +1,300 @@
+"""Pallas TPU kernel: the whole FFD chunk solve fused into ONE kernel.
+
+The XLA formulation (ops/pack.py) lowers the outer node loop and the inner
+shape scan to ~num_iters × S separate fused HLO ops; every intermediate
+(reserved, stopped, npacked) round-trips through HBM between scan steps.
+This kernel keeps ALL solver state — the (R,T) reservation matrix, per-type
+stop flags, per-shape counts — resident in VMEM for the entire solve and
+exits the node loop the moment the problem is done (a `while_loop`, not a
+fixed-length scan), so converged problems don't pay for dead iterations.
+
+Layout is TPU-native: capacity tensors are stored transposed (R, T) /
+(R, S) so the resource axis (R = 8) sits on sublanes and the wide
+type/shape axes on lanes; the per-shape fit `min_r floor(avail/shape)` is a
+sublane reduction of an (R, T) VPU op.
+
+Semantics are bit-identical to ops.pack.pack_chunk for every committed
+node record (chosen, q, packed) and for counts/dropped/done — enforced by
+tests/test_pack_pallas.py against both the XLA kernel and the host oracle.
+Reference hot loop being replaced: packer.go:114-141 + packable.go:111-173.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from karpenter_tpu.solver.host_ffd import R_PODS
+
+INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _pack_kernel(
+    # inputs
+    shapes_t,     # (R, S) int32, reserve semantics, descending shapes
+    counts_in,    # (1, S) int32
+    dropped_in,   # (1, S) int32
+    totals_t,     # (R, T) int32
+    reserved0_t,  # (R, T) int32
+    valid,        # (1, T) int32 (0/1)
+    lastv,        # (1, 1) int32 SMEM — index of largest viable type
+    pods_unit,    # (1, 1) int32 SMEM — one pod in device units
+    # outputs
+    counts_out,   # (1, S)
+    dropped_out,  # (1, S)
+    done_out,     # (1, 1) SMEM
+    chosen_out,   # (1, L)
+    q_out,        # (1, L)
+    packed_out,   # (L, S)
+    # scratch
+    resv,         # (R, T) VMEM
+    stopped,      # (1, T) VMEM int32
+    npacked,      # (1, T) VMEM int32
+    maxfit,       # (1, S) VMEM int32
+    packedv_s,    # (1, S) VMEM int32
+):
+    R, S = shapes_t.shape
+    T = totals_t.shape[1]
+    L = q_out.shape[1]
+
+    # Mosaic has no dynamic slices/loads on the lane (last) axis; columns
+    # and scalars at runtime-computed lane indices are extracted by masked
+    # reduction instead (a full-width VPU op — cheap at these sizes).
+    def lane_col(mat, iota, idx):
+        """mat (R, N)[:, idx] → (R, 1) without a dynamic lane slice."""
+        return jnp.sum(jnp.where(iota == idx, mat, 0), axis=1, keepdims=True)
+
+    def lane_scalar(row, iota, idx):
+        """row (1, N)[0, idx] → scalar without a dynamic lane load."""
+        return jnp.sum(jnp.where(iota == idx, row, 0))
+
+    counts_out[:] = counts_in[:]
+    dropped_out[:] = dropped_in[:]
+    chosen_out[:] = jnp.full((1, L), -1, jnp.int32)
+    q_out[:] = jnp.zeros((1, L), jnp.int32)
+    packed_out[:] = jnp.zeros((L, S), jnp.int32)
+
+    iota_s = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+    iota_t = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+    valid_b = valid[:] != 0
+    avail0 = totals_t[:] - reserved0_t[:]          # (R, T)
+
+    # maxfit_s = max over valid types of the capacity-bound fit from the
+    # initial reservation (the fast-forward validity bound, ops/pack.py)
+    def maxfit_body(s, _):
+        shape_col = lane_col(shapes_t[:], iota_s, s)   # (R, 1)
+        kr = jnp.where(shape_col > 0,
+                       avail0 // jnp.maximum(shape_col, 1), INT32_MAX)
+        kfit = jnp.min(kr, axis=0, keepdims=True)  # (1, T)
+        best = jnp.max(jnp.where(valid_b, kfit, -1))
+        # masked row store — Mosaic has no scalar VMEM stores
+        maxfit[:] = jnp.where(iota_s == s, best, maxfit[:])
+        return 0
+
+    jax.lax.fori_loop(0, S, maxfit_body, 0)
+
+    pods_one = jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0) == R_PODS,
+        pods_unit[0, 0], 0)                        # (R, 1)
+
+    def node_iter(carry):
+        it, _ = carry
+        counts = counts_out[:]                     # (1, S)
+        has = counts > 0
+        largest_idx = jnp.min(jnp.where(has, iota_s, INT32_MAX))
+        smallest_idx = jnp.max(jnp.where(has, iota_s, -1))
+        # fits() uses raw requests (no implicit pods:1) — packable.go:118,146
+        smallest_fits = jnp.maximum(
+            lane_col(shapes_t[:], iota_s, smallest_idx) - pods_one, 0)  # (R, 1)
+
+        # pass 1: greedy-fill every candidate type at once (VPU over T)
+        resv[:] = reserved0_t[:]
+        stopped[:] = jnp.where(valid_b, 0, 1).astype(jnp.int32)
+        npacked[:] = jnp.zeros((1, T), jnp.int32)
+
+        def shape_step(s, _):
+            count = lane_scalar(counts_out[:], iota_s, s)
+
+            @pl.when(count > 0)
+            def _():
+                shape_col = lane_col(shapes_t[:], iota_s, s)  # (R, 1)
+                active = stopped[:] == 0                      # (1, T)
+                avail = totals_t[:] - resv[:]
+                kr = jnp.where(shape_col > 0,
+                               avail // jnp.maximum(shape_col, 1), INT32_MAX)
+                kfit = jnp.min(kr, axis=0, keepdims=True)     # (1, T)
+                k = jnp.where(active, jnp.clip(kfit, 0, count), 0)
+                failure = active & (k < count)
+                new_resv = resv[:] + k * shape_col            # bcast (R, T)
+                resv[:] = new_resv
+                full = jnp.any(
+                    (totals_t[:] > 0) &
+                    (new_resv + smallest_fits >= totals_t[:]),
+                    axis=0, keepdims=True)                    # (1, T)
+                new_np = npacked[:] + k
+                npacked[:] = new_np
+                stopped[:] = jnp.where(
+                    failure & (full | (new_np == 0)), 1, stopped[:])
+            return 0
+
+        jax.lax.fori_loop(0, S, shape_step, 0)
+
+        max_pods = lane_scalar(npacked[:], iota_t, lastv[0, 0])
+        chosen = jnp.min(jnp.where(
+            valid_b & (npacked[:] == max_pods), iota_t, INT32_MAX))
+        nothing = max_pods == 0
+
+        # pass 2: replay the chosen type's column alone to recover its
+        # per-shape pack vector (each type's fill is independent, so the
+        # replay is exact) — avoids materializing the (S, T) k matrix
+        totals_col = lane_col(totals_t[:], iota_t, chosen)    # (R, 1)
+        resv0_col = lane_col(reserved0_t[:], iota_t, chosen)
+
+        def replay_step(s, carry2):
+            resv_col, stopped_c, npacked_c = carry2
+            count = lane_scalar(counts_out[:], iota_s, s)
+            shape_col = lane_col(shapes_t[:], iota_s, s)
+            active = (count > 0) & (stopped_c == 0)
+            avail = totals_col - resv_col
+            kr = jnp.where(shape_col > 0,
+                           avail // jnp.maximum(shape_col, 1), INT32_MAX)
+            kfit = jnp.min(kr)
+            k = jnp.where(active, jnp.clip(kfit, 0, count), 0)
+            failure = active & (k < count)
+            resv_col = resv_col + k * shape_col
+            full = jnp.any((totals_col > 0) &
+                           (resv_col + smallest_fits >= totals_col))
+            npacked_c = npacked_c + k
+            stopped_c = jnp.where(failure & (full | (npacked_c == 0)),
+                                  1, stopped_c)
+            packedv_s[:] = jnp.where(iota_s == s, k, packedv_s[:])
+            return resv_col, stopped_c, npacked_c
+
+        jax.lax.fori_loop(
+            0, S, replay_step,
+            (resv0_col, jnp.int32(0), jnp.int32(0)))
+
+        packed = packedv_s[:]                                 # (1, S)
+        # exact fast-forward (ops/pack.py): q identical nodes at once
+        terms = jnp.where(packed > 0,
+                          (counts - maxfit[:]) // jnp.maximum(packed, 1),
+                          INT32_MAX)
+        q = 1 + jnp.maximum(0, jnp.min(terms))
+        q = jnp.where(nothing, 0, q)
+
+        # drop path: the largest remaining shape fits nowhere
+        drop_vec = jnp.where(nothing & (iota_s == largest_idx), counts, 0)
+
+        new_counts = counts - q * packed - drop_vec
+        counts_out[:] = new_counts
+        dropped_out[:] = dropped_out[:] + drop_vec
+
+        @pl.when(q > 0)
+        def _():
+            iota_l = jax.lax.broadcasted_iota(jnp.int32, (1, L), 1)
+            chosen_out[:] = jnp.where(iota_l == it, chosen, chosen_out[:])
+            q_out[:] = jnp.where(iota_l == it, q, q_out[:])
+            packed_out[pl.ds(it, 1), :] = packed
+
+        done = jnp.logical_not(jnp.any(new_counts > 0))
+        return it + 1, done
+
+    init_done = jnp.logical_not(jnp.any(counts_in[:] > 0))
+    it_f, done_f = jax.lax.while_loop(
+        lambda c: jnp.logical_not(c[1]) & (c[0] < L),
+        node_iter, (jnp.int32(0), init_done))
+    done_out[0, 0] = done_f.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("num_iters", "interpret"))
+def pack_chunk_pallas(
+    shapes,     # (S, R) int32 — same layout as ops.pack.pack_chunk
+    counts,     # (S,)
+    dropped,    # (S,)
+    totals,     # (T, R)
+    reserved0,  # (T, R)
+    valid,      # (T,) bool
+    last_valid,  # () int32
+    pods_unit,  # () int32
+    num_iters: int,
+    interpret: bool = False,
+):
+    """Same contract as ops.pack.pack_chunk (up to the junk-row caveat:
+    iterations past `done` or with q == 0 report chosen=-1/q=0/packed=0
+    here, while the scan version reports stale values — callers only
+    consume q > 0 rows). Transposes at the boundary; the kernel runs in
+    the (R, lanes) layout."""
+    S, R = shapes.shape
+    T = totals.shape[0]
+    L = num_iters
+
+    outs = pl.pallas_call(
+        _pack_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((1, S), jnp.int32),   # counts
+            jax.ShapeDtypeStruct((1, S), jnp.int32),   # dropped
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),   # done
+            jax.ShapeDtypeStruct((1, L), jnp.int32),   # chosen
+            jax.ShapeDtypeStruct((1, L), jnp.int32),   # q
+            jax.ShapeDtypeStruct((L, S), jnp.int32),   # packed
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # shapes_t
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # counts
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # dropped
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # totals_t
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # reserved0_t
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # valid
+            pl.BlockSpec(memory_space=pltpu.SMEM),     # last_valid
+            pl.BlockSpec(memory_space=pltpu.SMEM),     # pods_unit
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((R, T), jnp.int32),   # resv
+            pltpu.VMEM((1, T), jnp.int32),   # stopped
+            pltpu.VMEM((1, T), jnp.int32),   # npacked
+            pltpu.VMEM((1, S), jnp.int32),   # maxfit
+            pltpu.VMEM((1, S), jnp.int32),   # packedv
+        ],
+        interpret=interpret,
+    )(
+        shapes.T.astype(jnp.int32),
+        counts.reshape(1, S).astype(jnp.int32),
+        dropped.reshape(1, S).astype(jnp.int32),
+        totals.T.astype(jnp.int32),
+        reserved0.T.astype(jnp.int32),
+        valid.reshape(1, T).astype(jnp.int32),
+        jnp.asarray(last_valid, jnp.int32).reshape(1, 1),
+        jnp.asarray(pods_unit, jnp.int32).reshape(1, 1),
+    )
+    counts_f, dropped_f, done_f, chosen_seq, q_seq, packed_seq = outs
+    return (counts_f[0], dropped_f[0], done_f[0, 0] != 0,
+            chosen_seq[0], q_seq[0], packed_seq)
+
+
+@functools.partial(jax.jit, static_argnames=("num_iters", "interpret"))
+def pack_chunk_pallas_flat(
+    shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
+    num_iters: int,
+    interpret: bool = False,
+):
+    """Flattened single-buffer variant in ops.pack's shared layout
+    (flatten_chunk_outputs / unpack_flat) so a solve costs exactly one
+    device→host fetch (see pack_chunk_flat's rationale — the tunnel RTT
+    dwarfs the kernel)."""
+    from karpenter_tpu.ops.pack import flatten_chunk_outputs
+
+    return flatten_chunk_outputs(*pack_chunk_pallas(
+        shapes, counts, dropped, totals, reserved0, valid,
+        last_valid, pods_unit, num_iters=num_iters, interpret=interpret))

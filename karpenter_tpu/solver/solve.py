@@ -28,6 +28,8 @@ class SolverConfig:
     use_device: bool = True
     max_instance_types: int = host_ffd.MAX_INSTANCE_TYPES
     chunk_iters: int = 64
+    # device kernel: "xla" | "pallas" | None = auto (pallas on real TPU)
+    device_kernel: Optional[str] = None
     # below this many pods a device round-trip costs more than it saves
     # (tens of ms over the transport vs sub-ms native solve); the native/
     # host executors answer instead — same result, differential-tested
@@ -84,7 +86,8 @@ def solve(
             result = solve_ffd_device(
                 pod_vecs, pod_ids, packables,
                 max_instance_types=config.max_instance_types,
-                chunk_iters=config.chunk_iters)
+                chunk_iters=config.chunk_iters,
+                kernel=config.device_kernel)
         except Exception:  # device failure ring: never drop a provisioning loop
             log.exception("device solve failed; falling back to host FFD")
             result = None

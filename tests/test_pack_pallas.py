@@ -1,0 +1,182 @@
+"""Pallas pack kernel ≡ XLA pack kernel ≡ host oracle.
+
+The Pallas kernel (ops/pack_pallas.py) must produce the same committed node
+records (chosen, q, packed), final counts/dropped, and done flag as the XLA
+scan kernel (ops/pack.py) — junk rows (q == 0) excluded, since the scan
+version reports stale values there by design. Runs in interpreter mode on
+the CPU test mesh.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.cloudprovider.fake.provider import instance_types, make_instance_type
+from karpenter_tpu.ops.encode import encode
+from karpenter_tpu.ops.pack import pack_chunk
+from karpenter_tpu.ops.pack_pallas import pack_chunk_pallas
+from karpenter_tpu.solver import host_ffd
+from karpenter_tpu.solver.adapter import build_packables, pod_vector
+from tests.test_pack_parity import allow_all_constraints, make_pod
+
+
+def encode_pods(pods, catalog):
+    constraints = allow_all_constraints(catalog)
+    packables, _ = build_packables(catalog, constraints, pods, [])
+    vecs = [pod_vector(p) for p in pods]
+    ids = list(range(len(pods)))
+    enc = encode(vecs, ids, packables)
+    assert enc is not None
+    host = host_ffd.pack(vecs, ids, packables)
+    return enc, host
+
+
+def run_both(enc, num_iters=64):
+    import jax.numpy as jnp
+
+    args = (
+        jnp.asarray(enc.shapes), jnp.asarray(enc.counts),
+        jnp.zeros_like(jnp.asarray(enc.counts)), jnp.asarray(enc.totals),
+        jnp.asarray(enc.reserved0), jnp.asarray(enc.valid),
+        jnp.asarray(enc.last_valid, jnp.int32),
+        jnp.asarray(enc.pods_unit, jnp.int32),
+    )
+    xla = pack_chunk(*args, num_iters=num_iters)
+    pls = pack_chunk_pallas(*args, num_iters=num_iters, interpret=True)
+    return [np.asarray(x) for x in xla], [np.asarray(x) for x in pls]
+
+
+def committed(counts, dropped, done, chosen, q, packed):
+    recs = [(int(chosen[i]), int(q[i]), tuple(int(v) for v in packed[i]))
+            for i in range(len(q)) if q[i] > 0]
+    return recs, counts.tolist(), dropped.tolist(), bool(done)
+
+
+def assert_kernel_parity(enc, num_iters=64):
+    xla, pls = run_both(enc, num_iters)
+    assert committed(*pls) == committed(*xla)
+    return pls
+
+
+class TestPallasParity:
+    def test_homogeneous(self):
+        catalog = instance_types(6)
+        pods = [make_pod({"cpu": "500m", "memory": "256Mi"}) for _ in range(40)]
+        enc, host = encode_pods(pods, catalog)
+        pls = assert_kernel_parity(enc)
+        node_count = int(pls[4][pls[4] > 0].sum())
+        assert node_count == host.node_count
+
+    def test_mixed_with_drop(self):
+        catalog = instance_types(3)
+        pods = (
+            [make_pod({"cpu": "250m", "memory": "128Mi"}) for _ in range(20)]
+            + [make_pod({"cpu": "1", "memory": "9Gi"}) for _ in range(3)]
+            + [make_pod({"cpu": "64", "memory": "1Gi"}) for _ in range(2)]  # drops
+        )
+        enc, host = encode_pods(pods, catalog)
+        pls = assert_kernel_parity(enc)
+        assert int(pls[1].sum()) == len(host.unschedulable)
+        assert bool(pls[2])
+
+    def test_gpu_exclusive_types(self):
+        catalog = instance_types(4)
+        catalog.append(make_instance_type(
+            "gpu-big", cpu="16", memory="32Gi", pods="40", nvidia_gpus="8"))
+        pods = [make_pod({"cpu": "1", "memory": "1Gi", "nvidia.com/gpu": "1"})
+                for _ in range(6)]
+        pods += [make_pod({"cpu": "500m", "memory": "512Mi"}) for _ in range(10)]
+        enc, host = encode_pods(pods, catalog)
+        pls = assert_kernel_parity(enc)
+        node_count = int(pls[4][pls[4] > 0].sum())
+        assert node_count == host.node_count
+
+    def test_empty_counts_done_immediately(self):
+        catalog = instance_types(2)
+        pods = [make_pod({"cpu": "100m", "memory": "64Mi"})]
+        enc, _ = encode_pods(pods, catalog)
+        enc.counts[:] = 0
+        xla, pls = run_both(enc, num_iters=8)
+        assert bool(pls[2]) and committed(*pls) == committed(*xla)
+        assert not pls[4].any()
+
+    def test_chunking_resume(self):
+        """A tiny num_iters forces done=False; resuming from the returned
+        counts must agree with the XLA kernel's resume."""
+        catalog = instance_types(8)
+        pods = [make_pod({"cpu": f"{c}m", "memory": f"{m}Mi"})
+                for c in (250, 500, 1000, 2000) for m in (128, 512, 1024)
+                for _ in range(9)]
+        enc, host = encode_pods(pods, catalog)
+        import jax.numpy as jnp
+
+        args = lambda counts, dropped: (
+            jnp.asarray(enc.shapes), jnp.asarray(counts),
+            jnp.asarray(dropped), jnp.asarray(enc.totals),
+            jnp.asarray(enc.reserved0), jnp.asarray(enc.valid),
+            jnp.asarray(enc.last_valid, jnp.int32),
+            jnp.asarray(enc.pods_unit, jnp.int32),
+        )
+        total_nodes, counts, dropped = 0, enc.counts, np.zeros_like(enc.counts)
+        for _ in range(64):
+            out = pack_chunk_pallas(*args(counts, dropped), num_iters=2,
+                                    interpret=True)
+            counts, dropped, done, chosen, q, packed = map(np.asarray, out)
+            total_nodes += int(q[q > 0].sum())
+            if done:
+                break
+        assert done
+        assert total_nodes == host.node_count
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzz_vs_xla_kernel(self, seed):
+        rng = random.Random(1000 + seed)
+        catalog = instance_types(rng.randint(1, 20))
+        shapes = [{
+            "cpu": f"{rng.choice([100, 250, 500, 1000, 2000, 64000])}m",
+            "memory": f"{rng.choice([64, 256, 1024, 4096])}Mi",
+        } for _ in range(rng.randint(1, 6))]
+        pods = [make_pod(dict(rng.choice(shapes)))
+                for _ in range(rng.randint(1, 300))]
+        enc, host = encode_pods(pods, catalog)
+        pls = assert_kernel_parity(enc)
+        node_count = int(pls[4][pls[4] > 0].sum())
+        assert node_count == host.node_count
+        assert int(pls[1].sum()) == len(host.unschedulable)
+
+
+class TestPallasSolvePath:
+    def test_solve_ffd_device_pallas_kernel_matches_host(self):
+        """Full solve_ffd_device flow on the pallas kernel (interpret mode
+        off-TPU): same packings as the host oracle and the XLA kernel."""
+        from karpenter_tpu.models.ffd import solve_ffd_device
+        from karpenter_tpu.solver.adapter import build_packables, pod_vector
+
+        catalog = instance_types(8)
+        pods = [make_pod({"cpu": f"{c}m", "memory": f"{m}Mi"})
+                for c in (250, 500, 2000) for m in (128, 1024) for _ in range(7)]
+        constraints = allow_all_constraints(catalog)
+        packables, _ = build_packables(catalog, constraints, pods, [])
+        vecs = [pod_vector(p) for p in pods]
+        ids = list(range(len(pods)))
+        host = host_ffd.pack(vecs, ids, packables)
+        pallas_result = solve_ffd_device(vecs, ids, packables, kernel="pallas",
+                                         chunk_iters=8)  # force chunk resume
+        xla_result = solve_ffd_device(vecs, ids, packables, kernel="xla")
+        assert pallas_result.node_count == host.node_count == xla_result.node_count
+        key = lambda r: sorted((tuple(p.instance_type_indices), p.node_quantity)
+                               for p in r.packings)
+        assert key(pallas_result) == key(host) == key(xla_result)
+
+    def test_unknown_kernel_rejected(self):
+        from karpenter_tpu.models.ffd import solve_ffd_device
+        from karpenter_tpu.solver.adapter import build_packables, pod_vector
+
+        catalog = instance_types(2)
+        pods = [make_pod({"cpu": "100m", "memory": "64Mi"})]
+        packables, _ = build_packables(
+            catalog, allow_all_constraints(catalog), pods, [])
+        with pytest.raises(ValueError, match="unknown device kernel"):
+            solve_ffd_device([pod_vector(p) for p in pods], [0], packables,
+                             kernel="palas")
