@@ -124,6 +124,14 @@ def main(argv=None) -> int:
     kube = KubeCore()
     manager = build_manager(kube, options)
     server = serve_observability(manager, options.metrics_port)
+    # opt-in XLA device tracing (KARPENTER_PROFILE_PORT, SURVEY.md §5.1);
+    # a debug knob must never crash-loop the controller
+    from karpenter_tpu.utils.profiling import start_server as start_profiler
+
+    try:
+        start_profiler()
+    except Exception as e:  # noqa: BLE001
+        log.warning("profiler server not started: %s", e)
     manager.start()
     log.info("karpenter-tpu started (cluster=%s, metrics=:%d)",
              options.cluster_name, options.metrics_port)

@@ -70,10 +70,34 @@ class KubeCore:
         self._rv = itertools.count(1)
         self._uid = itertools.count(1)
         self._watchers: List[Tuple[Optional[str], "queue.Queue[Event]"]] = []
+        # the spec.nodeName field index (manager.go:39-43): node name → pod
+        # keys, maintained on every pod mutation so pods_on_node is O(pods
+        # on that node), not O(all pods) — emptiness/termination/metrics
+        # reconcile per node and would otherwise scan the world each time.
+        # Inner dicts are ordered sets: iteration keeps insertion order so
+        # drain/eviction order stays deterministic across runs.
+        self._pods_by_node: Dict[str, Dict[Key, None]] = {}
 
     # -- helpers ------------------------------------------------------------
     def _next_rv(self) -> int:
         return next(self._rv)
+
+    def _reindex(self, key: Key, old, new) -> None:
+        """Maintain the nodeName index across any pod mutation."""
+        if key[0] != "Pod":
+            return
+        old_node = getattr(old.spec, "node_name", None) if old is not None else None
+        new_node = getattr(new.spec, "node_name", None) if new is not None else None
+        if old_node == new_node:
+            return
+        if old_node:
+            bucket = self._pods_by_node.get(old_node)
+            if bucket is not None:
+                bucket.pop(key, None)
+                if not bucket:
+                    del self._pods_by_node[old_node]
+        if new_node:
+            self._pods_by_node.setdefault(new_node, {})[key] = None
 
     def _notify(self, event_type: str, obj) -> None:
         for kind, q in self._watchers:
@@ -108,6 +132,7 @@ class KubeCore:
             if obj.metadata.creation_timestamp is None:
                 obj.metadata.creation_timestamp = clock.now()
             self._objects[k] = obj
+            self._reindex(k, None, obj)
             self._notify("ADDED", obj)
             return copy.deepcopy(obj)
 
@@ -127,21 +152,27 @@ class KubeCore:
     ) -> List:
         """List objects. ``field`` supports the spec.nodeName pod index."""
         with self._lock:
+            if field is not None:
+                fname, fval = field
+                if fname != "spec.nodeName":
+                    raise ApiError(f"unsupported field selector {fname}")
+                if kind == "Pod":
+                    # indexed path: only this node's pods are touched
+                    candidates = [self._objects[key] for key in
+                                  self._pods_by_node.get(fval, ())]
+                else:
+                    candidates = [o for (k, _, _), o in self._objects.items()
+                                  if k == kind and
+                                  getattr(o.spec, "node_name", None) == fval]
+            else:
+                candidates = [o for (k, _, _), o in self._objects.items()
+                              if k == kind]
             out = []
-            for (k, ns, _), obj in self._objects.items():
-                if k != kind:
-                    continue
-                if namespace is not None and ns != namespace:
+            for obj in candidates:
+                if namespace is not None and obj.metadata.namespace != namespace:
                     continue
                 if label_selector is not None and not label_selector.matches(obj.metadata.labels):
                     continue
-                if field is not None:
-                    fname, fval = field
-                    if fname == "spec.nodeName":
-                        if getattr(obj.spec, "node_name", None) != fval:
-                            continue
-                    else:
-                        raise ApiError(f"unsupported field selector {fname}")
                 out.append(copy.deepcopy(obj))
             return out
 
@@ -163,9 +194,11 @@ class KubeCore:
             obj.metadata.resource_version = self._next_rv()
             if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
                 del self._objects[k]
+                self._reindex(k, stored, None)
                 self._notify("DELETED", obj)
                 return copy.deepcopy(obj)
             self._objects[k] = obj
+            self._reindex(k, stored, obj)
             self._notify("MODIFIED", obj)
             return copy.deepcopy(obj)
 
@@ -182,9 +215,11 @@ class KubeCore:
             obj.metadata.resource_version = self._next_rv()
             if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
                 del self._objects[(kind, namespace, name)]
+                self._reindex((kind, namespace, name), stored, None)
                 self._notify("DELETED", obj)
                 return copy.deepcopy(obj)
             self._objects[(kind, namespace, name)] = obj
+            self._reindex((kind, namespace, name), stored, obj)
             self._notify("MODIFIED", obj)
             return copy.deepcopy(obj)
 
@@ -202,6 +237,7 @@ class KubeCore:
                     self._notify("MODIFIED", stored)
                 return copy.deepcopy(stored)
             del self._objects[k]
+            self._reindex(k, stored, None)
             self._notify("DELETED", stored)
             return copy.deepcopy(stored)
 
@@ -217,6 +253,7 @@ class KubeCore:
                 raise Conflict(f"pod {pod.metadata.name} already bound to {stored.spec.node_name}")
             stored.spec.node_name = node_name
             stored.metadata.resource_version = self._next_rv()
+            self._reindex(k, None, stored)  # was unbound: nothing to remove
             self._notify("MODIFIED", stored)
 
     def evict_pod(self, name: str, namespace: str = "default") -> None:

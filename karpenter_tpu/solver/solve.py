@@ -19,6 +19,7 @@ from karpenter_tpu.models.cost import CostConfig, order_options_by_price
 from karpenter_tpu.models.ffd import solve_ffd_device
 from karpenter_tpu.solver import host_ffd
 from karpenter_tpu.solver.adapter import build_packables, pod_vector
+from karpenter_tpu.utils.profiling import trace
 
 log = logging.getLogger("karpenter.solver")
 
@@ -83,11 +84,12 @@ def solve(
     result = None
     if config.use_device and len(pods) >= config.device_min_pods:
         try:
-            result = solve_ffd_device(
-                pod_vecs, pod_ids, packables,
-                max_instance_types=config.max_instance_types,
-                chunk_iters=config.chunk_iters,
-                kernel=config.device_kernel)
+            with trace("karpenter.solve.device"):
+                result = solve_ffd_device(
+                    pod_vecs, pod_ids, packables,
+                    max_instance_types=config.max_instance_types,
+                    chunk_iters=config.chunk_iters,
+                    kernel=config.device_kernel)
         except Exception:  # device failure ring: never drop a provisioning loop
             log.exception("device solve failed; falling back to host FFD")
             result = None

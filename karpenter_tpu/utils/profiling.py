@@ -1,0 +1,43 @@
+"""Solver profiling: jax.profiler integration (SURVEY.md §5.1).
+
+The reference's only latency visibility is its Prometheus histograms; the
+TPU build keeps that trio (metrics/registry.py) and adds XLA-level traces:
+
+- ``trace(name)``: a TraceAnnotation context that labels solver stages in
+  TensorBoard/Perfetto traces. Near-zero cost when no trace is active.
+- ``start_server(port)``: the on-demand jax.profiler server — connect with
+  TensorBoard's capture button to pull device traces from a live
+  controller (enabled via ``KARPENTER_PROFILE_PORT``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+
+log = logging.getLogger("karpenter.profiling")
+
+
+def start_server(port: int | None = None):
+    """Start the jax.profiler HTTP server if requested; returns it (or
+    None). Reads KARPENTER_PROFILE_PORT when port is not given."""
+    if port is None:
+        raw = os.environ.get("KARPENTER_PROFILE_PORT")
+        if not raw:
+            return None
+        port = int(raw)
+    import jax
+
+    server = jax.profiler.start_server(port)
+    log.info("jax profiler server on :%d", port)
+    return server
+
+
+@contextlib.contextmanager
+def trace(name: str, **kwargs):
+    """Label a solver stage in device traces (TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name, **kwargs):
+        yield

@@ -213,3 +213,52 @@ class TestLoggingConfig:
         LoggingConfigController(kube, root_logger=root).reconcile(
             "config-logging", "tenant")
         assert logging.getLogger(root).level == logging.NOTSET
+
+
+class TestNodeNameIndex:
+    """The spec.nodeName field index (manager.go:39-43) must track every
+    pod mutation path: create, bind, update, patch, delete."""
+
+    def test_index_tracks_mutations(self):
+        kube = KubeCore()
+        p = Pod(metadata=ObjectMeta(name="p1"), spec=PodSpec(node_name="n1"))
+        kube.create(p)
+        assert [x.metadata.name for x in kube.pods_on_node("n1")] == ["p1"]
+
+        p2 = Pod(metadata=ObjectMeta(name="p2"), spec=PodSpec())
+        kube.create(p2)
+        kube.bind_pod(p2, "n1")
+        assert {x.metadata.name for x in kube.pods_on_node("n1")} == {"p1", "p2"}
+
+        # update moving a pod between nodes reindexes both buckets
+        stored = kube.get("Pod", "p1")
+        stored.spec.node_name = "n2"
+        kube.update(stored)
+        assert [x.metadata.name for x in kube.pods_on_node("n2")] == ["p1"]
+        assert [x.metadata.name for x in kube.pods_on_node("n1")] == ["p2"]
+
+        def clear(obj):
+            obj.spec.node_name = None
+        kube.patch("Pod", "p1", "default", clear)
+        assert kube.pods_on_node("n2") == []
+
+        kube.delete("Pod", "p2")
+        assert kube.pods_on_node("n1") == []
+
+    def test_index_respects_namespace_and_labels(self):
+        from karpenter_tpu.api.core import LabelSelector
+
+        kube = KubeCore()
+        kube.create(Pod(metadata=ObjectMeta(name="a", namespace="ns1",
+                                            labels={"app": "x"}),
+                        spec=PodSpec(node_name="n")))
+        kube.create(Pod(metadata=ObjectMeta(name="b", namespace="ns2",
+                                            labels={"app": "y"}),
+                        spec=PodSpec(node_name="n")))
+        assert len(kube.pods_on_node("n")) == 2
+        only_ns1 = kube.list("Pod", namespace="ns1", field=("spec.nodeName", "n"))
+        assert [p.metadata.name for p in only_ns1] == ["a"]
+        only_x = kube.list("Pod", namespace=None,
+                           label_selector=LabelSelector(match_labels={"app": "x"}),
+                           field=("spec.nodeName", "n"))
+        assert [p.metadata.name for p in only_x] == ["a"]

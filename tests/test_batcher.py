@@ -1,0 +1,90 @@
+"""Batcher window semantics (batcher.go:23-103): idle window, max window,
+item cap, gate lifecycle. Windows are shrunk so the suite stays fast —
+the same determinism hook the reference uses (batcher windows are vars,
+SURVEY.md §4)."""
+
+import threading
+import time
+
+from karpenter_tpu.scheduling.batcher import Batcher
+
+
+def collect_async(batcher, out):
+    def run():
+        out.append(batcher.wait())
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+class TestBatcherWindows:
+    def test_idle_window_closes_batch(self):
+        b = Batcher(idle_seconds=0.05, max_seconds=5.0)
+        out = []
+        t = collect_async(b, out)
+        b.add("a")
+        b.add("b")
+        t.join(timeout=2.0)
+        items, window = out[0]
+        assert items == ["a", "b"]
+        assert window < 1.0  # closed by idle, not max
+
+    def test_idle_window_extends_on_arrivals(self):
+        b = Batcher(idle_seconds=0.15, max_seconds=5.0)
+        out = []
+        t = collect_async(b, out)
+        for i in range(5):
+            b.add(i)
+            time.sleep(0.05)  # under the idle window: batch stays open
+        t.join(timeout=2.0)
+        assert out[0][0] == [0, 1, 2, 3, 4]
+
+    def test_max_window_caps_stream(self):
+        b = Batcher(idle_seconds=0.2, max_seconds=0.3)
+        out = []
+        t = collect_async(b, out)
+        stop = time.monotonic() + 0.6
+        sent = 0
+        while time.monotonic() < stop:  # keep producing well past the window
+            b.add(sent)
+            sent += 1
+            time.sleep(0.02)
+        t.join(timeout=2.0)
+        items, window = out[0]
+        # a continuous stream is cut off by the max window, not drained dry
+        assert 0.2 <= window < 0.5
+        assert len(items) < sent
+
+    def test_item_cap_closes_batch(self):
+        b = Batcher(idle_seconds=0.05, max_seconds=10.0, max_items=3)
+        for i in range(5):
+            b.add(i)
+        items, _ = b.wait()
+        assert items == [0, 1, 2]
+        items2, _ = b.wait()  # remainder lands in the next window
+        assert items2 == [3, 4]
+
+    def test_gate_blocks_until_flush(self):
+        b = Batcher(idle_seconds=0.05)
+        gate = b.add("x")
+        assert not gate.wait(timeout=0.05)
+        b.flush()
+        assert gate.wait(timeout=1.0)
+
+    def test_flush_opens_new_gate(self):
+        b = Batcher(idle_seconds=0.05)
+        g1 = b.add("x")
+        b.flush()
+        g2 = b.add("y")
+        assert g1 is not g2
+        assert g1.is_set() and not g2.is_set()
+
+    def test_stop_unblocks_wait(self):
+        b = Batcher(idle_seconds=5.0, max_seconds=10.0)
+        out = []
+        t = collect_async(b, out)
+        time.sleep(0.05)
+        b.stop()
+        t.join(timeout=2.0)
+        assert not t.is_alive()
+        assert out[0][0] == []
