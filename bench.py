@@ -227,6 +227,57 @@ def config_3_topology():
             "pods_per_sec": round(20_000 / (sorted(times)[len(times) // 2] or 1e-9))}
 
 
+def _kernel_breakdown(pods, catalog):
+    """Isolate kernel cost from transport: run each device kernel with ALL
+    outputs reduced to one scalar on device, so a solve costs exactly one
+    tiny fetch. The spread over the measured raw RTT is the kernel's own
+    device time (the tunnel RTT dominates everything end-to-end)."""
+    import functools
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.models.ffd import device_args
+    from karpenter_tpu.ops.encode import encode
+    from karpenter_tpu.ops.pack import pack_chunk
+    from karpenter_tpu.ops.pack_pallas import pack_chunk_pallas
+    from karpenter_tpu.solver.adapter import build_packables, pod_vector
+
+    constraints = universe_constraints(catalog)
+    packables, _ = build_packables(catalog, constraints, pods, [])
+    enc = encode([pod_vector(p) for p in pods], list(range(len(pods))), packables)
+    args = tuple(jax.device_put(device_args(enc)))
+
+    @functools.partial(jax.jit, static_argnames=("which",))
+    def csum(*a, which):
+        fn = pack_chunk if which == "xla" else pack_chunk_pallas
+        return sum(jnp.sum(o.astype(jnp.int32)) for o in fn(*a, num_iters=64))
+
+    f = jax.jit(lambda x: x + 1)
+    tiny = jax.device_put(np.zeros(4, np.int32))
+    np.asarray(f(tiny))
+    # Mosaic only compiles on real TPU; interpret-mode timings would be
+    # meaningless, so the pallas row is TPU-only
+    kernels = (None, "xla", "pallas") if jax.default_backend() == "tpu" else (
+        None, "xla")
+    out = {}
+    for which in kernels:
+        run = (lambda: np.asarray(f(tiny))) if which is None else (
+            lambda: np.asarray(csum(*args, which=which)))
+        run()
+        ts = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            run()
+            ts.append(time.perf_counter() - t0)
+        out["raw_rtt_ms" if which is None else f"{which}_single_fetch_ms"] = (
+            round(sorted(ts)[len(ts) // 2] * 1000.0, 2))
+    return out
+
+
 def config_4_headline():
     catalog = make_catalog(400)
     pods = make_pods(50_000, MIXED_SHAPES)
@@ -235,7 +286,8 @@ def config_4_headline():
                    "p99_ms": round(_p99(times), 3),
                    "median_ms": round(_median(times), 3), "node_count": nodes,
                    "pods_per_sec": round(50_000 / (sorted(times)[len(times) // 2] or 1e-9)),
-                   "node_parity_vs_go_ffd_oracle": "exact"}
+                   "node_parity_vs_go_ffd_oracle": "exact",
+                   "kernel_breakdown": _kernel_breakdown(pods, catalog)}
 
 
 def config_5_consolidation():

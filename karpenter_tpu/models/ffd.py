@@ -23,6 +23,18 @@ MAX_CHUNKS = 4096  # hard safety valve; each iteration provably makes progress
 _INT32_MAX = 2**31 - 1
 
 
+def device_args(enc: EncodedProblem):
+    """THE kernel argument tuple (shapes, counts, dropped, totals,
+    reserved0, valid, last_valid, pods_unit) — single source of truth for
+    the pack_chunk/pack_chunk_pallas ABI, shared with bench.py."""
+    return (
+        enc.shapes, enc.counts, np.zeros_like(enc.counts), enc.totals,
+        enc.reserved0, enc.valid,
+        np.asarray(enc.last_valid, np.int32),
+        np.asarray(enc.pods_unit, np.int32),
+    )
+
+
 def default_kernel() -> str:
     """Pallas on real TPU (fused VMEM state + early exit, ~4× less device
     time than the XLA scan); the XLA kernel elsewhere — pallas interpret
@@ -74,11 +86,7 @@ def solve_ffd_device(
 
     S, L = enc.shapes.shape[0], chunk_iters
     # one host→device transfer for the whole problem (tunnel-latency bound)
-    dev = jax.device_put((
-        enc.shapes, enc.counts, np.zeros_like(enc.counts), enc.totals,
-        enc.reserved0, enc.valid,
-        np.asarray(enc.last_valid, np.int32), np.asarray(enc.pods_unit, np.int32),
-    ))
+    dev = jax.device_put(device_args(enc))
     shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit = dev
 
     records = []  # (chosen, qty, packed-vector)

@@ -1,0 +1,13 @@
+"""bench.py helpers must work off-TPU (CPU dev machines, CI)."""
+
+
+def test_kernel_breakdown_skips_pallas_off_tpu():
+    import jax
+
+    import bench as B
+
+    assert jax.default_backend() == "cpu"  # conftest forces the CPU mesh
+    kb = B._kernel_breakdown(B.make_pods(500, B.MIXED_SHAPES),
+                             B.make_catalog(20))
+    assert "xla_single_fetch_ms" in kb and "raw_rtt_ms" in kb
+    assert "pallas_single_fetch_ms" not in kb

@@ -1,0 +1,94 @@
+"""Ragged-shape limits (SURVEY.md §7 hard parts): many distinct pod shapes,
+bucket overflow → graceful host fallback, and exactness refusal.
+
+The encoding collapses pods to unique resource shapes and pads to static
+buckets (ops/encode.py SHAPE_BUCKETS ≤ 4096). These tests pin the behavior
+at and beyond the edge: a large distinct-shape universe still solves with
+exact parity, and an over-bucket or inexact problem never silently degrades
+— it returns None and the public solve() answers via the host executors.
+"""
+
+import numpy as np
+
+from karpenter_tpu.controllers.provisioning import universe_constraints
+from karpenter_tpu.cloudprovider.fake.provider import instance_types
+from karpenter_tpu.models.ffd import solve_ffd_device, solve_ffd_numpy
+from karpenter_tpu.ops.encode import SHAPE_BUCKETS, encode
+from karpenter_tpu.solver import host_ffd
+from karpenter_tpu.solver.adapter import build_packables, pod_vector
+from karpenter_tpu.solver.solve import SolverConfig, solve
+from tests.test_pack_parity import make_pod
+
+
+def distinct_shape_pods(n):
+    """n pods, every one a distinct (cpu, memory) shape."""
+    return [make_pod({"cpu": f"{100 + i}m", "memory": f"{64 + (i % 512)}Mi"})
+            for i in range(n)]
+
+
+def encode_inputs(pods, catalog):
+    constraints = universe_constraints(catalog)
+    packables, _ = build_packables(catalog, constraints, pods, [])
+    vecs = [pod_vector(p) for p in pods]
+    return vecs, list(range(len(pods))), packables
+
+
+class TestManyDistinctShapes:
+    def test_1500_distinct_shapes_exact(self):
+        """S=1500 → 2048 bucket; the shape-level kernel mirror must match
+        the per-pod oracle exactly."""
+        catalog = instance_types(12)
+        pods = distinct_shape_pods(1500)
+        vecs, ids, packables = encode_inputs(pods, catalog)
+        enc = encode(vecs, ids, packables)
+        assert enc is not None and enc.shapes.shape[0] == 2048
+        host = host_ffd.pack(vecs, ids, packables)
+        mirror = solve_ffd_numpy(vecs, ids, packables)
+        assert mirror.node_count == host.node_count
+        assert sorted(mirror.unschedulable) == sorted(host.unschedulable)
+
+    def test_300_distinct_shapes_device_exact(self):
+        catalog = instance_types(8)
+        pods = distinct_shape_pods(300)
+        vecs, ids, packables = encode_inputs(pods, catalog)
+        host = host_ffd.pack(vecs, ids, packables)
+        device = solve_ffd_device(vecs, ids, packables)
+        assert device is not None
+        assert device.node_count == host.node_count
+
+
+class TestBucketOverflow:
+    def test_over_4096_shapes_encode_refuses(self):
+        catalog = instance_types(4)
+        pods = distinct_shape_pods(SHAPE_BUCKETS[-1] + 5)
+        vecs, ids, packables = encode_inputs(pods, catalog)
+        assert encode(vecs, ids, packables) is None
+        assert solve_ffd_device(vecs, ids, packables) is None
+
+    def test_public_solve_falls_back_and_stays_exact(self):
+        """solve() with an un-encodable problem answers via the host
+        executors — same node count as the oracle, nothing dropped."""
+        catalog = instance_types(4)
+        pods = distinct_shape_pods(SHAPE_BUCKETS[-1] + 5)
+        constraints = universe_constraints(catalog)
+        result = solve(constraints, pods, catalog,
+                       config=SolverConfig(device_min_pods=0))
+        vecs, ids, packables = encode_inputs(pods, catalog)
+        oracle = host_ffd.pack(vecs, ids, packables)
+        assert result.node_count == oracle.node_count
+        covered = sum(len(node) for p in result.packings for node in p.pods)
+        assert covered + len(result.unschedulable) == len(pods)
+
+    def test_inexact_quantities_refuse_encoding(self):
+        """A value that cannot be represented exactly in scaled int32
+        (huge prime nano quantity) must refuse, not round."""
+        catalog = instance_types(2)
+        pods = [make_pod({"cpu": "1", "memory": "64Mi"})]
+        vecs, ids, packables = encode_inputs(pods, catalog)
+        # poison one pod with a quantity that exceeds int32 after GCD=1
+        big_prime = (2**31 + 11)  # prime > int32 range
+        vecs = [tuple(v) for v in vecs]
+        poisoned = list(vecs[0])
+        poisoned[0] = big_prime
+        vecs[0] = tuple(poisoned)
+        assert encode(vecs, ids, packables) is None

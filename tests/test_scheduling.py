@@ -358,3 +358,36 @@ class TestTaints:
             ("b", "2", "NoSchedule"),
             ("b", "2", "NoExecute"),
         }
+
+
+class TestRelaxationTTL:
+    """preferences.go:40-48: the original affinity is cached for 5 minutes;
+    after expiry a retry starts again from the ORIGINAL (un-relaxed) terms."""
+
+    def test_cache_expiry_restores_original_preferences(self, env):
+        from karpenter_tpu.controllers.selection import (
+            RELAXATION_TTL_SECONDS, Preferences,
+        )
+        from karpenter_tpu.utils import clock
+
+        clock.DEFAULT.set(2_000_000.0)
+        try:
+            prefs = Preferences()
+            pod = unschedulable_pod(affinity=preferred_affinity(
+                (5, [Req(key=ZONE, operator="In", values=["invalid"])]),
+                (1, [Req(key=ZONE, operator="In", values=["test-zone-1"])]),
+            ))
+            prefs.relax(pod)   # caches original
+            prefs.relax(pod)   # strips the heaviest (invalid) term
+            assert len(pod.spec.affinity.node_affinity.preferred) == 1
+
+            clock.DEFAULT.advance(RELAXATION_TTL_SECONDS + 1)
+            fresh = unschedulable_pod(affinity=preferred_affinity(
+                (5, [Req(key=ZONE, operator="In", values=["invalid"])]),
+                (1, [Req(key=ZONE, operator="In", values=["test-zone-1"])]),
+            ))
+            fresh.metadata.uid = pod.metadata.uid
+            prefs.relax(fresh)  # expired: treated as first-seen again
+            assert len(fresh.spec.affinity.node_affinity.preferred) == 2
+        finally:
+            clock.DEFAULT.reset()
