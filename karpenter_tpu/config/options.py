@@ -23,6 +23,9 @@ class Options:
     kube_client_qps: int = 200
     kube_client_burst: int = 300
     cloud_provider: str = "fake"
+    # API backend: "in-cluster" (real API server via the service account,
+    # runtime/kubeclient.py) or "memory" (runtime/kubecore.py — dev/tests)
+    kube_backend: str = "memory"
     # batching (batcher.go:23-28 defaults; max_items raised — see batcher.py)
     batch_idle_seconds: float = 1.0
     batch_max_seconds: float = 10.0
@@ -44,6 +47,8 @@ class Options:
                            ("webhook-port", self.webhook_port)):
             if not (0 < port < 65536):
                 errs.append(f"{name} out of range: {port}")
+        if self.kube_backend not in ("memory", "in-cluster"):
+            errs.append(f"kube-backend invalid: {self.kube_backend}")
         if self.aws_node_name_convention not in ("ip-name", "resource-name"):
             errs.append(
                 f"aws-node-name-convention invalid: {self.aws_node_name_convention}")
@@ -81,6 +86,8 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                    default=_env("kube-client-burst", defaults.kube_client_burst))
     p.add_argument("--cloud-provider",
                    default=_env("cloud-provider", defaults.cloud_provider))
+    p.add_argument("--kube-backend", choices=["memory", "in-cluster"],
+                   default=_env("kube-backend", defaults.kube_backend))
     p.add_argument("--batch-idle-seconds", type=float,
                    default=_env("batch-idle-seconds", defaults.batch_idle_seconds))
     p.add_argument("--batch-max-seconds", type=float,

@@ -121,7 +121,12 @@ def main(argv=None) -> int:
         for e in errs:
             log.error("invalid options: %s", e)
         return 1
-    kube = KubeCore()
+    if options.kube_backend == "in-cluster":
+        from karpenter_tpu.runtime.kubeclient import KubeApiClient
+
+        kube = KubeApiClient.in_cluster()
+    else:
+        kube = KubeCore()
     manager = build_manager(kube, options)
     server = serve_observability(manager, options.metrics_port)
     # opt-in XLA device tracing (KARPENTER_PROFILE_PORT, SURVEY.md §5.1);
