@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional
 
 from karpenter_tpu.api.core import (
     Affinity, ConfigMap, Container, DaemonSet, DaemonSetSpec, LabelSelector,
+    Lease, LeaseSpec,
     Node, NodeAffinity, NodeCondition, NodeSelectorRequirement,
     NodeSelectorTerm, NodeSpec, NodeStatus, ObjectMeta, OwnerReference,
     PersistentVolume, PersistentVolumeClaim, PersistentVolumeClaimSpec,
@@ -411,6 +412,33 @@ def configmap_to(cm: ConfigMap) -> Dict[str, Any]:
             "metadata": meta_to(cm.metadata), "data": dict(cm.data)}
 
 
+def lease_from(obj: Dict[str, Any]) -> Lease:
+    spec = obj.get("spec") or {}
+    return Lease(
+        metadata=meta_from(obj.get("metadata") or {}),
+        spec=LeaseSpec(
+            holder_identity=spec.get("holderIdentity", "") or "",
+            lease_duration_seconds=int(spec.get("leaseDurationSeconds") or 15),
+            acquire_time=ts_from(spec.get("acquireTime")),
+            renew_time=ts_from(spec.get("renewTime"))),
+    )
+
+
+def lease_to(lease: Lease) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "holderIdentity": lease.spec.holder_identity,
+        "leaseDurationSeconds": lease.spec.lease_duration_seconds,
+    }
+    if lease.spec.acquire_time is not None:
+        spec["acquireTime"] = ts_to(lease.spec.acquire_time)
+    if lease.spec.renew_time is not None:
+        spec["renewTime"] = ts_to(lease.spec.renew_time)
+    else:
+        spec["renewTime"] = None  # owned: an explicit release must round-trip
+    return {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": meta_to(lease.metadata), "spec": spec}
+
+
 def pvc_from(obj: Dict[str, Any]) -> PersistentVolumeClaim:
     spec = obj.get("spec") or {}
     return PersistentVolumeClaim(
@@ -487,6 +515,7 @@ def storageclass_from(obj: Dict[str, Any]) -> StorageClass:
 # -- dispatch ---------------------------------------------------------------
 
 DECODERS = {
+    "Lease": lease_from,
     "Pod": pod_from,
     "Node": node_from,
     "DaemonSet": daemonset_from,
@@ -497,6 +526,7 @@ DECODERS = {
 }
 
 ENCODERS = {
+    "Lease": lease_to,
     "Pod": pod_to,
     "Node": node_to,
     "ConfigMap": configmap_to,

@@ -45,7 +45,10 @@ class InstanceProvider:
         cluster_name: str,
         node_name_convention: str = NODE_NAME_CONVENTION_IP_NAME,
         describe_retry_delay: float = 1.0,
+        fleet_limiter=None,
     ):
+        from karpenter_tpu.utils.ratelimit import TokenBucket
+
         self.ec2api = ec2api
         self.instance_type_provider = instance_type_provider
         self.subnet_provider = subnet_provider
@@ -53,6 +56,8 @@ class InstanceProvider:
         self.cluster_name = cluster_name
         self.node_name_convention = node_name_convention
         self.describe_retry_delay = describe_retry_delay
+        # CreateFleet budget 2 QPS / 100 burst (cloudprovider.go:41-46)
+        self.fleet_limiter = fleet_limiter or TokenBucket(2, 100)
 
     # -- create (instance.go:51-90) -----------------------------------------
     def create(
@@ -118,6 +123,7 @@ class InstanceProvider:
                 provisioner_name, provider.tags,
                 {f"kubernetes.io/cluster/{self.cluster_name}": "owned"}),
         )
+        self.fleet_limiter.acquire()
         response = self.ec2api.create_fleet(request)
         self._update_unavailable_offerings(response.errors, capacity_type)
         if not response.instance_ids:

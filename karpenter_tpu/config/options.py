@@ -26,6 +26,8 @@ class Options:
     # API backend: "in-cluster" (real API server via the service account,
     # runtime/kubeclient.py) or "memory" (runtime/kubecore.py — dev/tests)
     kube_backend: str = "memory"
+    # single-writer guard across replicas (cmd/controller/main.go:80-81)
+    leader_elect: bool = False
     # batching (batcher.go:23-28 defaults; max_items raised — see batcher.py)
     batch_idle_seconds: float = 1.0
     batch_max_seconds: float = 10.0
@@ -88,6 +90,8 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                    default=_env("cloud-provider", defaults.cloud_provider))
     p.add_argument("--kube-backend", choices=["memory", "in-cluster"],
                    default=_env("kube-backend", defaults.kube_backend))
+    p.add_argument("--leader-elect", action=argparse.BooleanOptionalAction,
+                   default=_env("leader-elect", defaults.leader_elect))
     p.add_argument("--batch-idle-seconds", type=float,
                    default=_env("batch-idle-seconds", defaults.batch_idle_seconds))
     p.add_argument("--batch-max-seconds", type=float,

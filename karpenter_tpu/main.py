@@ -124,7 +124,8 @@ def main(argv=None) -> int:
     if options.kube_backend == "in-cluster":
         from karpenter_tpu.runtime.kubeclient import KubeApiClient
 
-        kube = KubeApiClient.in_cluster()
+        kube = KubeApiClient.in_cluster(qps=options.kube_client_qps,
+                                        burst=options.kube_client_burst)
     else:
         kube = KubeCore()
     manager = build_manager(kube, options)
@@ -137,17 +138,39 @@ def main(argv=None) -> int:
         start_profiler()
     except Exception as e:  # noqa: BLE001
         log.warning("profiler server not started: %s", e)
+
+    elector = None
+    stopping = threading.Event()
+    if options.leader_elect:
+        # single-writer guard (cmd/controller/main.go:80-81): campaign
+        # before starting controllers; losing the lease means exit — the
+        # orchestrator restarts the replica, which re-campaigns
+        import socket
+        import uuid
+
+        from karpenter_tpu.runtime.leaderelection import LeaderElector
+
+        elector = LeaderElector(
+            kube, identity=f"{socket.gethostname()}-{uuid.uuid4().hex[:6]}",
+            on_stopped_leading=stopping.set)
+        elector.start()
+        log.info("campaigning for leadership")
+        elector.wait_for_leadership()
     manager.start()
     log.info("karpenter-tpu started (cluster=%s, metrics=:%d)",
              options.cluster_name, options.metrics_port)
     try:
-        threading.Event().wait()
+        stopping.wait()
     except KeyboardInterrupt:
         pass
     finally:
         manager.stop()
+        if elector is not None:
+            elector.stop()
         server.shutdown()
-    return 0
+    # stopping only fires on lost leadership → nonzero so the orchestrator
+    # restarts this replica and it re-campaigns
+    return 1 if stopping.is_set() else 0
 
 
 if __name__ == "__main__":

@@ -49,6 +49,7 @@ ROUTES: Dict[str, Tuple[str, str, bool]] = {
     "PersistentVolumeClaim": ("/api/v1", "persistentvolumeclaims", False),
     "PersistentVolume": ("/api/v1", "persistentvolumes", True),
     "DaemonSet": ("/apis/apps/v1", "daemonsets", False),
+    "Lease": ("/apis/coordination.k8s.io/v1", "leases", False),
     "StorageClass": ("/apis/storage.k8s.io/v1", "storageclasses", True),
     "Provisioner": ("/apis/karpenter.sh/v1alpha5", "provisioners", False),
 }
@@ -105,10 +106,16 @@ class KubeApiClient:
         ca_file: Optional[str] = None,
         insecure: bool = False,
         timeout: float = 30.0,
+        qps: float = 200.0,
+        burst: int = 300,
     ):
+        from karpenter_tpu.utils.ratelimit import TokenBucket
+
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
+        # the reference's kube API budget (options.go:39-40)
+        self._limiter = TokenBucket(qps, burst)
         split = urlsplit(self.base_url)
         self._host = split.hostname or "localhost"
         self._port = split.port or (443 if split.scheme == "https" else 80)
@@ -125,7 +132,7 @@ class KubeApiClient:
         self._watch_queues: List["queue.Queue[Event]"] = []
 
     @classmethod
-    def in_cluster(cls) -> "KubeApiClient":
+    def in_cluster(cls, qps: float = 200.0, burst: int = 300) -> "KubeApiClient":
         """Build from the pod service account (the in-cluster default)."""
         import os
 
@@ -134,7 +141,8 @@ class KubeApiClient:
         with open(f"{SERVICE_ACCOUNT_DIR}/token") as f:
             token = f.read().strip()
         return cls(f"https://{host}:{port}", token=token,
-                   ca_file=f"{SERVICE_ACCOUNT_DIR}/ca.crt")
+                   ca_file=f"{SERVICE_ACCOUNT_DIR}/ca.crt",
+                   qps=qps, burst=burst)
 
     # -- transport -----------------------------------------------------------
     def _conn(self, timeout: Optional[float] = None) -> http.client.HTTPConnection:
@@ -155,6 +163,7 @@ class KubeApiClient:
 
     def _request(self, method: str, path: str, body: Optional[Dict] = None,
                  content_type: str = "application/json") -> Dict:
+        self._limiter.acquire()
         conn = self._conn()
         try:
             conn.request(method, path,

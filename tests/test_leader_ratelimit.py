@@ -1,0 +1,174 @@
+"""Leader election (Lease protocol) and the token-bucket rate limiter."""
+
+import threading
+
+from karpenter_tpu.api.core import Lease
+from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.runtime.leaderelection import LEASE_NAME, LeaderElector
+from karpenter_tpu.utils import clock
+from karpenter_tpu.utils.ratelimit import TokenBucket
+
+
+class FakeTime:
+    def __init__(self):
+        self.t = 0.0
+        self.slept = []
+
+    def now(self):
+        return self.t
+
+    def sleep(self, s):
+        self.slept.append(s)
+        self.t += s
+
+
+class TestTokenBucket:
+    def test_burst_then_qps(self):
+        ft = FakeTime()
+        b = TokenBucket(qps=2, burst=3, timefunc=ft.now, sleepfunc=ft.sleep)
+        for _ in range(3):
+            assert b.acquire() == 0.0  # burst is free
+        waited = b.acquire()           # 4th must wait 1/qps
+        assert abs(waited - 0.5) < 1e-9
+
+    def test_refill_caps_at_burst(self):
+        ft = FakeTime()
+        b = TokenBucket(qps=10, burst=2, timefunc=ft.now, sleepfunc=ft.sleep)
+        assert b.try_acquire() and b.try_acquire()
+        assert not b.try_acquire()
+        ft.t += 100.0  # long idle: refill caps at burst, not qps*dt
+        assert b.try_acquire() and b.try_acquire()
+        assert not b.try_acquire()
+
+
+class TestLeaderElection:
+    def setup_method(self):
+        clock.DEFAULT.set(3_000_000.0)
+
+    def teardown_method(self):
+        clock.DEFAULT.reset()
+
+    def test_first_candidate_wins_second_waits(self):
+        kube = KubeCore()
+        a = LeaderElector(kube, identity="a")
+        b = LeaderElector(kube, identity="b")
+        assert a.try_acquire_or_renew() is True
+        assert b.try_acquire_or_renew() is False
+        # the holder renews; the candidate still loses
+        clock.DEFAULT.advance(5)
+        assert a.try_acquire_or_renew() is True
+        assert b.try_acquire_or_renew() is False
+
+    def test_expired_lease_is_taken_over(self):
+        kube = KubeCore()
+        a = LeaderElector(kube, identity="a", lease_duration=15)
+        b = LeaderElector(kube, identity="b", lease_duration=15)
+        assert a.try_acquire_or_renew()
+        clock.DEFAULT.advance(16)  # a stopped renewing
+        assert b.try_acquire_or_renew() is True
+        lease = kube.get("Lease", LEASE_NAME)
+        assert lease.spec.holder_identity == "b"
+        # a cannot renew anymore
+        assert a.try_acquire_or_renew() is False
+
+    def test_release_on_stop_frees_lease(self):
+        kube = KubeCore()
+        a = LeaderElector(kube, identity="a")
+        assert a.try_acquire_or_renew()
+        a._leading = True
+        a.stop()
+        lease = kube.get("Lease", LEASE_NAME)
+        assert lease.spec.holder_identity == ""
+        b = LeaderElector(kube, identity="b")
+        assert b.try_acquire_or_renew() is True  # no wait-out needed
+
+    def test_run_loop_transitions(self):
+        kube = KubeCore()
+        started = threading.Event()
+        a = LeaderElector(kube, identity="a", renew_period=0.02,
+                          on_started_leading=started.set)
+        a.start()
+        assert started.wait(timeout=5.0)
+        assert a.is_leader()
+        a.stop()
+
+    def test_over_the_wire(self):
+        """The same protocol through KubeApiClient + the stub server."""
+        import time as _t
+
+        from tests.test_kubeclient import StubHandler
+        from http.server import ThreadingHTTPServer
+        from karpenter_tpu.runtime.kubeclient import KubeApiClient
+
+        core = KubeCore()
+        handler = type("S", (StubHandler,), {"core": core})
+        server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        client = KubeApiClient(f"http://127.0.0.1:{server.server_address[1]}")
+        try:
+            a = LeaderElector(client, identity="a")
+            b = LeaderElector(client, identity="b")
+            assert a.try_acquire_or_renew() is True
+            assert b.try_acquire_or_renew() is False
+            stored = core.get("Lease", LEASE_NAME)
+            assert stored.spec.holder_identity == "a"
+            assert isinstance(client.get("Lease", LEASE_NAME), Lease)
+            clock.DEFAULT.advance(20)
+            assert b.try_acquire_or_renew() is True
+        finally:
+            server.shutdown()
+
+
+class TestElectionRobustness:
+    def setup_method(self):
+        clock.DEFAULT.set(4_000_000.0)
+
+    def teardown_method(self):
+        clock.DEFAULT.reset()
+
+    def test_api_error_demotes_instead_of_killing_thread(self):
+        kube = KubeCore()
+        stopped = threading.Event()
+        a = LeaderElector(kube, identity="a", renew_period=0.02,
+                          on_stopped_leading=stopped.set)
+        started = threading.Event()
+        a.on_started_leading = started.set
+        a.start()
+        assert started.wait(5.0)
+        # sabotage the API: every round now raises
+        def boom(*args, **kw):
+            raise OSError("api down")
+        a.kube = type("K", (), {"get": boom, "create": boom, "update": boom,
+                                "patch": boom})()
+        assert stopped.wait(5.0), "leader must demote on API failure"
+        assert not a.is_leader()
+        assert a._thread.is_alive()  # the loop survives to campaign again
+        a.kube = kube  # API back: must re-acquire (lease still ours/expired)
+        clock.DEFAULT.advance(60)
+        started2 = threading.Event()
+        a.on_started_leading = started2.set
+        assert started2.wait(5.0)
+        a.stop()
+
+    def test_stop_does_not_strand_lease_on_dead_identity(self):
+        kube = KubeCore()
+        a = LeaderElector(kube, identity="a", renew_period=0.01)
+        started = threading.Event()
+        a.on_started_leading = started.set
+        a.start()
+        assert started.wait(5.0)
+        a.stop()
+        lease = kube.get("Lease", LEASE_NAME)
+        assert lease.spec.holder_identity != "a" or lease.spec.renew_time is None
+        b = LeaderElector(kube, identity="b")
+        assert b.try_acquire_or_renew() is True  # immediate, no wait-out
+
+    def test_wait_for_leadership_timeout_is_wall_time(self):
+        kube = KubeCore()
+        blocker = LeaderElector(kube, identity="holder")
+        assert blocker.try_acquire_or_renew()
+        loser = LeaderElector(kube, identity="loser", renew_period=0.02)
+        loser.start()
+        # frozen injectable clock: the wall-time deadline must still fire
+        assert loser.wait_for_leadership(timeout=0.3) is False
+        loser.stop()
