@@ -58,22 +58,32 @@ def pack_batch_sharded(
     )(shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit)
 
 
-@functools.partial(jax.jit, static_argnames=("num_iters", "mesh"))
+@functools.partial(jax.jit,
+                   static_argnames=("num_iters", "mesh", "kernel", "interpret"))
 def pack_batch_sharded_flat(
     shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
     *,
     num_iters: int,
     mesh: Mesh,
+    kernel: str = "xla",
+    interpret: bool = False,
 ):
     """pack_batch_sharded with the six per-problem outputs flattened into ONE
     (B, 2S+1+2L+L·S) int32 buffer. The TPU sits behind a tunnel whose
     round-trip latency (~tens of ms) dwarfs the kernel compute (~ms), so a
     batch solve must cost exactly one device→host fetch — six separately
     awaited outputs would each pay a full RTT. Each row is exactly one
-    ops.pack.pack_chunk_flat buffer (the layout lives only there)."""
-    vmapped = jax.vmap(
-        functools.partial(pack_chunk_flat, num_iters=num_iters),
-        in_axes=(0, 0, 0, 0, 0, 0, 0, 0))
+    ops.pack.pack_chunk_flat buffer (the layout lives only there).
+    ``kernel`` selects the per-problem executor ("xla" scan or the fused
+    "pallas" kernel, models/ffd.default_kernel semantics)."""
+    if kernel == "pallas":
+        from karpenter_tpu.ops.pack_pallas import pack_chunk_pallas_flat
+
+        one = functools.partial(pack_chunk_pallas_flat, num_iters=num_iters,
+                                interpret=interpret)
+    else:
+        one = functools.partial(pack_chunk_flat, num_iters=num_iters)
+    vmapped = jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0, 0, 0))
     spec = P("batch")
     return shard_map(
         vmapped, mesh=mesh,

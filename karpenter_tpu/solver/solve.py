@@ -71,6 +71,21 @@ def solve(
 ) -> SolveResult:
     config = config or SolverConfig()
     packables, sorted_types = build_packables(instance_types, constraints, pods, daemons)
+    pod_vecs = [pod_vector(p) for p in pods]
+    return solve_with_packables(constraints, pods, packables, sorted_types,
+                                pod_vecs, config)
+
+
+def solve_with_packables(
+    constraints: Constraints,
+    pods: Sequence[Pod],
+    packables,
+    sorted_types,
+    pod_vecs,
+    config: SolverConfig,
+) -> SolveResult:
+    """solve() after problem preparation — entry for callers (batch_solve)
+    that already built packables/vectors and must not pay for them twice."""
     if not packables:
         # same contract as host_ffd.pack: no viable types → every pod is
         # reported unschedulable (the reference only logs, packer.go:119-121,
@@ -78,7 +93,6 @@ def solve(
         log.error("no viable instance type options for %d pods", len(pods))
         return SolveResult(packings=[], unschedulable=list(pods))
 
-    pod_vecs = [pod_vector(p) for p in pods]
     pod_ids = list(range(len(pods)))
 
     result = None
@@ -107,6 +121,13 @@ def solve(
         result = host_ffd.pack(pod_vecs, pod_ids, packables,
                                max_instance_types=config.max_instance_types)
 
+    return materialize(result, pods, sorted_types, constraints, config)
+
+
+def materialize(result, pods, sorted_types, constraints: Constraints,
+                config: SolverConfig) -> SolveResult:
+    """HostSolveResult (ids/indices) → SolveResult (objects), with the
+    cost-aware option ordering applied. Shared with the batch solver."""
     packings = [
         Packing(
             pods=[[pods[i] for i in node] for node in hp.pod_ids],
