@@ -260,6 +260,10 @@ def pod_spec_from(s: Dict[str, Any]) -> PodSpec:
         ],
         priority_class_name=s.get("priorityClassName", ""),
         preemption_policy=s.get("preemptionPolicy", "PreemptLowerPriority"),
+        # 0 is a valid, explicit "delete immediately" — only None defaults
+        termination_grace_period_seconds=(
+            30 if s.get("terminationGracePeriodSeconds") is None
+            else int(s["terminationGracePeriodSeconds"])),
     )
 
 
@@ -301,6 +305,7 @@ def pod_spec_to(s: PodSpec) -> Dict[str, Any]:
         ]
     if s.priority_class_name:
         out["priorityClassName"] = s.priority_class_name
+    out["terminationGracePeriodSeconds"] = s.termination_grace_period_seconds
     return out
 
 

@@ -186,6 +186,7 @@ class PodSpec:
     volumes: List[Volume] = field(default_factory=list)
     priority_class_name: str = ""
     preemption_policy: str = "PreemptLowerPriority"
+    termination_grace_period_seconds: int = 30
 
 
 @dataclass

@@ -232,7 +232,12 @@ class KubeCore:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             if stored.metadata.finalizers:
                 if stored.metadata.deletion_timestamp is None:
-                    stored.metadata.deletion_timestamp = clock.now()
+                    # k8s semantics: deletionTimestamp = request time + the
+                    # pod's grace period (a FUTURE time) — termination's
+                    # IsStuckTerminating compares against exactly this
+                    grace = getattr(getattr(stored, "spec", None),
+                                    "termination_grace_period_seconds", 0) or 0
+                    stored.metadata.deletion_timestamp = clock.now() + grace
                     stored.metadata.resource_version = self._next_rv()
                     self._notify("MODIFIED", stored)
                 return copy.deepcopy(stored)

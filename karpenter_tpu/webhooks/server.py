@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional
 
 from karpenter_tpu.api.codec import provisioner_from_manifest, provisioner_to_manifest
 from karpenter_tpu.cloudprovider.spi import CloudProvider
+from karpenter_tpu.controllers.logging_config import validate_config
 from karpenter_tpu.webhooks.admission import default_provisioner, validate_provisioner
 
 log = logging.getLogger("karpenter.webhook")
@@ -85,6 +86,19 @@ def validate_review(review: Dict[str, Any],
     return _review_reply(response)
 
 
+def validate_config_review(review: Dict[str, Any]) -> Dict[str, Any]:
+    """Handle /config-validation: the config-logging ConfigMap gate
+    (cmd/webhook/main.go:84-92)."""
+    request = review.get("request") or {}
+    obj = request.get("object") or {}
+    err = validate_config(dict(obj.get("data") or {}))
+    response: Dict[str, Any] = {"uid": request.get("uid", ""),
+                                "allowed": err is None}
+    if err is not None:
+        response["status"] = {"code": 400, "message": err}
+    return _review_reply(response)
+
+
 def _review_reply(response: Dict[str, Any]) -> Dict[str, Any]:
     return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
             "response": response}
@@ -112,6 +126,8 @@ class AdmissionHandler(BaseHTTPRequestHandler):
                 reply = default_review(review, self.cloud_provider)
             elif self.path == "/validate-resource":
                 reply = validate_review(review, self.cloud_provider)
+            elif self.path == "/config-validation":
+                reply = validate_config_review(review)
             else:
                 self._reply(404, b"not found", "text/plain")
                 return

@@ -374,3 +374,18 @@ class TestRealServerSemantics:
                 name=f"tick-{time.monotonic_ns()}")))  # nudge the stream
             time.sleep(0.2)
         assert not any(t.is_alive() for t in threads_before)
+
+
+class TestGraceCodec:
+    def test_grace_zero_round_trips(self):
+        from karpenter_tpu.api.codec_core import pod_from, pod_to
+
+        obj = {"metadata": {"name": "fast"},
+               "spec": {"terminationGracePeriodSeconds": 0,
+                        "containers": [{"name": "app", "resources": {}}]}}
+        p = pod_from(obj)
+        assert p.spec.termination_grace_period_seconds == 0  # not coerced to 30
+        assert pod_to(p)["spec"]["terminationGracePeriodSeconds"] == 0
+        p300 = pod_from({"metadata": {"name": "slow"},
+                         "spec": {"terminationGracePeriodSeconds": 300}})
+        assert pod_from(pod_to(p300)).spec.termination_grace_period_seconds == 300

@@ -131,3 +131,73 @@ def _expect_gone(kube, kind, name, namespace):
     except NotFound:
         return True
     raise AssertionError(f"{kind} {name} still present")
+
+
+class PDBKube(KubeCore):
+    """Rejects the first N evictions per pod with Conflict — the 429 PDB
+    behavior the reference exercises via fake PDB misconfig
+    (suite_test.go:163-199)."""
+
+    def __init__(self, rejections=3):
+        super().__init__()
+        self.rejections = rejections
+        self.attempts = {}
+
+    def evict_pod(self, name, namespace="default"):
+        from karpenter_tpu.runtime.kubecore import Conflict
+
+        n = self.attempts.get((namespace, name), 0)
+        self.attempts[(namespace, name)] = n + 1
+        if n < self.rejections:
+            raise Conflict("Cannot evict pod as it would violate the pod's "
+                           "disruption budget.")
+        super().evict_pod(name, namespace)
+
+
+class TestEvictionBackoff:
+    def test_pdb_rejections_retry_with_backoff_until_evicted(self):
+        kube = PDBKube(rejections=3)
+        provider = FakeCloudProvider()
+        controller = TerminationController(kube, provider)
+        try:
+            node = terminating_node(kube)
+            pod_on(kube, node.metadata.name, name="guarded")
+            controller.reconcile(node.metadata.name)
+
+            def evicted():
+                names = [p.metadata.name for p in kube.list("Pod")]
+                assert "guarded" not in names, f"still present: {names}"
+            eventually(evicted, timeout=15.0)
+            assert kube.attempts[("default", "guarded")] == 4  # 3 rejections + 1
+            # drained now: next reconcile terminates the instance
+            controller.reconcile(node.metadata.name)
+            with pytest.raises(NotFound):
+                kube.get("Node", node.metadata.name, "")
+        finally:
+            controller.stop_all()
+
+    def test_waits_for_terminating_pods_before_delete(self, env):
+        """suite_test.go:244-303: a pod already terminating (deletion
+        timestamp set, grace not expired) blocks node deletion until it is
+        actually gone — without re-evicting it."""
+        kube, provider, controller = env
+        node = terminating_node(kube)
+        pod = pod_on(kube, node.metadata.name, name="slow")
+        # mark terminating: finalizer-style in-flight deletion
+        stored = kube.get("Pod", "slow")
+        stored.metadata.finalizers.append("example.com/block")
+        kube.update(stored)
+        kube.delete("Pod", "slow")
+
+        assert controller.reconcile(node.metadata.name) == 1.0  # still draining
+        assert kube.get("Node", node.metadata.name, "") is not None
+
+        def release(p):
+            p.metadata.finalizers = []
+        kube.patch("Pod", "slow", "default", release)
+
+        def gone():
+            controller.reconcile(node.metadata.name)
+            with pytest.raises(NotFound):
+                kube.get("Node", node.metadata.name, "")
+        eventually(gone, timeout=10.0)

@@ -182,3 +182,19 @@ class TestWebhookServer:
             assert False, "expected 404"
         except urllib.error.HTTPError as e:
             assert e.code == 404
+
+
+class TestConfigValidation:
+    def test_valid_logging_config_allowed(self, webhook):
+        cm = {"apiVersion": "v1", "kind": "ConfigMap",
+              "metadata": {"name": "config-logging"},
+              "data": {"zap-logger-config": '{"level": "info"}'}}
+        reply = post_review(webhook, "/config-validation", cm)
+        assert reply["response"]["allowed"] is True
+
+    def test_bad_level_denied(self, webhook):
+        cm = {"metadata": {"name": "config-logging"},
+              "data": {"loglevel.solver": "shouty"}}
+        reply = post_review(webhook, "/config-validation", cm)
+        assert reply["response"]["allowed"] is False
+        assert "shouty" in reply["response"]["status"]["message"]
