@@ -1,0 +1,28 @@
+# Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
+# Python analog: pytest suite, native kernel build, benchmarks.
+
+.PHONY: test battletest bench native dryrun clean help
+
+help: ## Show targets
+	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
+
+test: ## Run the test suite (CPU mesh, fail-fast)
+	python -m pytest tests/ -x -q
+
+battletest: ## Randomized order + full run (the reference's battletest analog)
+	python -m pytest tests/ -q -p no:cacheprovider
+
+bench: ## Run the 5-config benchmark on the available accelerator
+	python bench.py
+
+native: ## Build the C++ FFD kernel explicitly (normally built lazily)
+	g++ -O3 -std=c++17 -shared -fPIC \
+		-o karpenter_tpu/native/_libktffd.so karpenter_tpu/native/ffd.cc
+
+dryrun: ## Compile-check the sharded multi-chip step on an 8-device CPU mesh
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+		python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean: ## Remove build artifacts
+	rm -f karpenter_tpu/native/_libktffd.so
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -176,3 +176,55 @@ class TestFinalizer:
         controller.reconcile("node-1")
         stored = kube.get("Node", "node-1", "")
         assert stored.metadata.finalizers == []
+
+
+class TestControllerGates:
+    """Cross-cutting controller behaviors (node/suite_test.go:74-360)."""
+
+    def test_not_ready_node_never_gets_emptiness_ttl(self, env):
+        kube, controller = env
+        kube.create(make_provisioner(ttl_seconds_after_empty=30))
+        node = make_node(ready=False)
+        kube.create(node)
+        controller.reconcile(node.metadata.name)
+        stored = kube.get("Node", node.metadata.name, "")
+        assert wellknown.EMPTINESS_TIMESTAMP_ANNOTATION not in stored.metadata.annotations
+
+    def test_ready_unknown_node_never_gets_emptiness_ttl(self, env):
+        kube, controller = env
+        kube.create(make_provisioner(ttl_seconds_after_empty=30))
+        node = make_node()
+        node.status.conditions[0].status = "Unknown"
+        kube.create(node)
+        controller.reconcile(node.metadata.name)
+        stored = kube.get("Node", node.metadata.name, "")
+        assert wellknown.EMPTINESS_TIMESTAMP_ANNOTATION not in stored.metadata.annotations
+
+    def test_unmanaged_node_fully_ignored(self, env):
+        """No provisioner label → none of the five sub-reconcilers touch it
+        (controller.go:70-80)."""
+        kube, controller = env
+        kube.create(make_provisioner(ttl_seconds_after_empty=1,
+                                     ttl_seconds_until_expired=1))
+        node = make_node(name="byo", finalizers=[], taints=[
+            Taint(key=wellknown.NOT_READY_TAINT_KEY, effect="NoSchedule")])
+        del node.metadata.labels[wellknown.PROVISIONER_NAME_LABEL]
+        kube.create(node)
+        clock.DEFAULT.advance(10_000)
+        controller.reconcile("byo")
+        stored = kube.get("Node", "byo", "")
+        assert stored.metadata.finalizers == []            # no finalizer added
+        assert any(t.key == wellknown.NOT_READY_TAINT_KEY  # taint untouched
+                   for t in stored.spec.taints)
+
+    def test_terminating_node_finalizer_not_readded(self, env):
+        """finalizer.go: do nothing while terminating — re-adding would
+        deadlock the termination controller's strip."""
+        kube, controller = env
+        kube.create(make_provisioner())
+        node = make_node(name="dying")
+        kube.create(node)
+        kube.delete("Node", "dying", "")  # finalizer present → terminating
+        controller.reconcile("dying")
+        stored = kube.get("Node", "dying", "")
+        assert stored.metadata.deletion_timestamp is not None
