@@ -63,7 +63,9 @@ def meta_from(m: Dict[str, Any]) -> ObjectMeta:
         finalizers=list(m.get("finalizers") or []),
         owner_references=[
             OwnerReference(kind=o.get("kind", ""), name=o.get("name", ""),
-                           controller=bool(o.get("controller")))
+                           controller=bool(o.get("controller")),
+                           api_version=o.get("apiVersion", ""),
+                           uid=o.get("uid", ""))
             for o in (m.get("ownerReferences") or [])
         ],
         deletion_timestamp=ts_from(m.get("deletionTimestamp")),
@@ -86,10 +88,16 @@ def meta_to(meta: ObjectMeta, cluster_scoped: bool = False) -> Dict[str, Any]:
     if not cluster_scoped:
         out["namespace"] = meta.namespace or "default"
     if meta.owner_references:
+        # apiVersion/uid round-trip verbatim from decode — the server's
+        # copy is authoritative (uid is REQUIRED server-side; inventing it
+        # would make every update() of an owned object invalid). The
+        # kind-based apiVersion guess remains only for locally-built refs
+        # (tests/fixtures) that never hit a real API server.
         out["ownerReferences"] = [
             {"kind": o.kind, "name": o.name, "controller": o.controller,
-             "apiVersion": "apps/v1" if o.kind == "DaemonSet" else "v1",
-             "uid": ""}
+             "apiVersion": o.api_version or (
+                 "apps/v1" if o.kind == "DaemonSet" else "v1"),
+             **({"uid": o.uid} if o.uid else {})}
             for o in meta.owner_references
         ]
     if meta.resource_version:

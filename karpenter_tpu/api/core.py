@@ -38,6 +38,11 @@ class OwnerReference:
     kind: str = ""
     name: str = ""
     controller: bool = False
+    # server-assigned identity, round-tripped verbatim: a real API server
+    # REQUIRES uid on ownerReferences, so an update that re-sends refs with
+    # a fabricated uid is rejected (or corrupts GC linkage)
+    api_version: str = ""
+    uid: str = ""
 
 
 @dataclass

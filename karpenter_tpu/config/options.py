@@ -23,6 +23,12 @@ class Options:
     kube_client_qps: int = 200
     kube_client_burst: int = 300
     cloud_provider: str = "fake"
+    # the controller's own namespace: where config-logging lives and where
+    # the election Lease is written. Defaults from the POD_NAMESPACE
+    # downward-API env (deploy/controller.yaml) so the deployed namespace
+    # ("karpenter") wins over the dev default.
+    namespace: str = field(
+        default_factory=lambda: os.environ.get("POD_NAMESPACE", "default"))
     # API backend: "in-cluster" (real API server via the service account,
     # runtime/kubeclient.py) or "memory" (runtime/kubecore.py — dev/tests)
     kube_backend: str = "memory"
@@ -88,6 +94,8 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                    default=_env("kube-client-burst", defaults.kube_client_burst))
     p.add_argument("--cloud-provider",
                    default=_env("cloud-provider", defaults.cloud_provider))
+    p.add_argument("--namespace",
+                   default=_env("namespace", defaults.namespace))
     p.add_argument("--kube-backend", choices=["memory", "in-cluster"],
                    default=_env("kube-backend", defaults.kube_backend))
     p.add_argument("--leader-elect", action=argparse.BooleanOptionalAction,

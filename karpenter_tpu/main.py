@@ -74,8 +74,10 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
     manager.register(PVCController(kube))
     manager.register(NodeMetricsController(kube))
     manager.register(PodMetricsController(kube))
-    # live log-level reload from config-logging (cmd/controller/main.go:105-117)
-    manager.register(LoggingConfigController(kube))
+    # live log-level reload from config-logging (cmd/controller/main.go:105-117);
+    # watch the controller's own namespace (POD_NAMESPACE / --namespace), not
+    # a hardcoded one — the deployed map lives in "karpenter"
+    manager.register(LoggingConfigController(kube, namespace=options.namespace))
     return manager
 
 
@@ -141,6 +143,20 @@ def main(argv=None) -> int:
 
     elector = None
     stopping = threading.Event()
+    terminated = threading.Event()
+    # Kubernetes stops pods with SIGTERM; without a handler the process dies
+    # before elector.stop() releases the Lease, stranding it for the full
+    # lease duration on every rollout
+    import signal
+
+    def _on_sigterm(signum, frame):
+        terminated.set()
+        stopping.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:  # not the main thread (tests) — skip
+        pass
     if options.leader_elect:
         # single-writer guard (cmd/controller/main.go:80-81): campaign
         # before starting controllers; losing the lease means exit — the
@@ -152,15 +168,19 @@ def main(argv=None) -> int:
 
         elector = LeaderElector(
             kube, identity=f"{socket.gethostname()}-{uuid.uuid4().hex[:6]}",
+            namespace=options.namespace,
             on_stopped_leading=stopping.set)
         elector.start()
         log.info("campaigning for leadership")
-        elector.wait_for_leadership()
-    manager.start()
-    log.info("karpenter-tpu started (cluster=%s, metrics=:%d)",
-             options.cluster_name, options.metrics_port)
+        # interrupt=stopping: a SIGTERM while standing by must break the
+        # campaign wait, not park until kubelet SIGKILLs the replica
+        elector.wait_for_leadership(interrupt=stopping)
     try:
-        stopping.wait()
+        if not stopping.is_set():
+            manager.start()
+            log.info("karpenter-tpu started (cluster=%s, metrics=:%d)",
+                     options.cluster_name, options.metrics_port)
+            stopping.wait()
     except KeyboardInterrupt:
         pass
     finally:
@@ -168,9 +188,10 @@ def main(argv=None) -> int:
         if elector is not None:
             elector.stop()
         server.shutdown()
-    # stopping only fires on lost leadership → nonzero so the orchestrator
-    # restarts this replica and it re-campaigns
-    return 1 if stopping.is_set() else 0
+    # SIGTERM (rollout) is a clean exit; stopping WITHOUT a signal means
+    # lost leadership → nonzero so the orchestrator restarts this replica
+    # and it re-campaigns
+    return 1 if stopping.is_set() and not terminated.is_set() else 0
 
 
 if __name__ == "__main__":

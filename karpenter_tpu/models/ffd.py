@@ -39,10 +39,18 @@ def default_kernel() -> str:
     """Pallas on real TPU (fused VMEM state + early exit, ~4× less device
     time than the XLA scan); the XLA kernel elsewhere — pallas interpret
     mode on CPU is debug-speed only. Both are record-for-record parity
-    tested (tests/test_pack_pallas.py)."""
+    tested (tests/test_pack_pallas.py).
+
+    Backend-init failure (dead TPU tunnel, missing runtime) answers "xla":
+    the caller's device_put will then raise into the fallback rings in
+    solver/solve.py instead of this probe killing the whole solve."""
     import jax
 
-    return "pallas" if jax.default_backend() == "tpu" else "xla"
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return "xla"
+    return "pallas" if backend == "tpu" else "xla"
 
 
 def solve_ffd_device(

@@ -41,6 +41,13 @@ log = logging.getLogger("karpenter.kubeclient")
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+
+class ResourceExpired(ApiError):
+    """HTTP 410 Gone / watch ERROR with reason=Expired: the requested
+    resourceVersion fell out of the server's watch cache (the most common
+    real-apiserver watch failure). Recovery = re-list + re-watch from the
+    fresh resourceVersion; the watch loop does that immediately."""
+
 # kind → (api prefix, plural, cluster-scoped)
 ROUTES: Dict[str, Tuple[str, str, bool]] = {
     "Pod": ("/api/v1", "pods", False),
@@ -130,6 +137,10 @@ class KubeApiClient:
         self._watch_threads: List[threading.Thread] = []
         self._watch_stop = threading.Event()
         self._watch_queues: List["queue.Queue[Event]"] = []
+        # live streaming connection per watch queue, so unwatch() can close
+        # it and unblock the thread's read immediately (not after the 300 s
+        # socket timeout)
+        self._watch_conns: Dict[int, http.client.HTTPConnection] = {}
 
     @classmethod
     def in_cluster(cls, qps: float = 200.0, burst: int = 300) -> "KubeApiClient":
@@ -162,7 +173,8 @@ class KubeApiClient:
         return h
 
     def _request(self, method: str, path: str, body: Optional[Dict] = None,
-                 content_type: str = "application/json") -> Dict:
+                 content_type: str = "application/json",
+                 _throttle_retries: int = 2) -> Dict:
         self._limiter.acquire()
         conn = self._conn()
         try:
@@ -178,8 +190,28 @@ class KubeApiClient:
                 if method == "POST":
                     raise AlreadyExists(f"{method} {path}: already exists")
                 raise Conflict(f"{method} {path}: conflict")
+            if resp.status == 410:
+                raise ResourceExpired(f"{method} {path}: gone (410)")
             if resp.status == 429:
-                raise Conflict(f"{method} {path}: too many requests (PDB)")
+                # only the eviction subresource uses 429 to mean "PDB would
+                # be violated" (mapped to Conflict so the eviction queue
+                # backs off); anywhere else it is API-Priority-and-Fairness
+                # throttling — honor Retry-After and retry in place
+                if path.split("?")[0].endswith("/eviction"):
+                    raise Conflict(f"{method} {path}: too many requests (PDB)")
+                if _throttle_retries > 0:
+                    import time as _time
+
+                    retry_after = resp.getheader("Retry-After")
+                    try:
+                        delay = max(0.0, min(float(retry_after), 5.0))
+                    except (TypeError, ValueError):
+                        delay = 1.0
+                    conn.close()
+                    _time.sleep(delay)
+                    return self._request(method, path, body, content_type,
+                                         _throttle_retries - 1)
+                raise ApiError(f"{method} {path}: HTTP 429: rate limited")
             if resp.status >= 300:
                 raise ApiError(
                     f"{method} {path}: HTTP {resp.status}: {data[:300]!r}")
@@ -315,12 +347,37 @@ class KubeApiClient:
         self._watch_threads.append(t)
         return q
 
+    @staticmethod
+    def _sever(conn) -> None:
+        """Force-unblock any thread reading this connection: close() alone
+        does not reliably interrupt a concurrent recv(); shutdown() does."""
+        import socket as _socket
+
+        try:
+            if conn.sock is not None:
+                conn.sock.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+
     def unwatch(self, q) -> None:
-        """Stop delivery AND the backing thread/stream (KubeCore parity)."""
+        """Stop delivery AND the backing thread/stream (KubeCore parity):
+        dropping the queue stops delivery; severing the live connection
+        unblocks the thread from its streaming read so it exits now."""
         self._watch_queues = [w for w in self._watch_queues if w is not q]
+        conn = self._watch_conns.pop(id(q), None)
+        if conn is not None:
+            self._sever(conn)
 
     def stop_watches(self) -> None:
         self._watch_stop.set()
+        for key in list(self._watch_conns):
+            conn = self._watch_conns.pop(key, None)
+            if conn is not None:
+                self._sever(conn)
 
     def _watch_active(self, q) -> bool:
         return not self._watch_stop.is_set() and any(
@@ -335,7 +392,24 @@ class KubeApiClient:
                 for item in body.get("items", []):
                     q.put(Event("ADDED", _decode(kind, item)))
                 self._stream(kind, path, rv, q)
-            except (ApiError, OSError, ValueError) as e:
+            except ResourceExpired as e:
+                # 410/Expired means our resourceVersion aged out of the
+                # watch cache — a full re-list is REQUIRED and sufficient.
+                # A short pause (vs the 1 s outage backoff below) guards
+                # against a server that answers 410 persistently: without
+                # it the loop would re-list at the full QPS budget and
+                # flood the queue with duplicate ADDEDs
+                if not self._watch_active(q):
+                    return
+                log.info("watch %s expired, resyncing: %s", kind, e)
+                self._watch_stop.wait(0.2)
+            except (ApiError, OSError, ValueError,
+                    http.client.HTTPException) as e:
+                # HTTPException covers IncompleteRead (truncated chunked
+                # stream) and ResponseNotReady (unwatch closing the conn
+                # mid-handshake) — an uncaught one would kill this thread
+                # while the queue stays registered, silently ending all
+                # events for the kind
                 if not self._watch_active(q):
                     return
                 log.debug("watch %s reconnecting: %s", kind, e)
@@ -347,10 +421,13 @@ class KubeApiClient:
         if rv:
             params["resourceVersion"] = rv
         conn = self._conn(timeout=300.0)
+        self._watch_conns[id(q)] = conn
         try:
             conn.request("GET", path + "?" + urlencode(params),
                          headers=self._headers())
             resp = conn.getresponse()
+            if resp.status == 410:
+                raise ResourceExpired(f"watch {kind}: gone (410)")
             if resp.status >= 300:
                 raise ApiError(f"watch {kind}: HTTP {resp.status}")
             buf = b""
@@ -366,7 +443,14 @@ class KubeApiClient:
                     event = json.loads(line)
                     etype = event.get("type", "")
                     if etype == "ERROR":
-                        raise ApiError(f"watch {kind}: {event.get('object')}")
+                        # the in-band expiry signal: a Status object with
+                        # code 410 / reason Expired mid-stream
+                        obj = event.get("object") or {}
+                        if (obj.get("code") == 410
+                                or obj.get("reason") in ("Expired", "Gone")):
+                            raise ResourceExpired(f"watch {kind}: {obj}")
+                        raise ApiError(f"watch {kind}: {obj}")
                     q.put(Event(etype, _decode(kind, event.get("object") or {})))
         finally:
+            self._watch_conns.pop(id(q), None)
             conn.close()

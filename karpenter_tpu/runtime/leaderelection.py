@@ -146,14 +146,23 @@ class LeaderElector:
     def is_leader(self) -> bool:
         return self._leading
 
-    def wait_for_leadership(self, timeout: Optional[float] = None) -> bool:
-        """Block until this candidate leads (or timeout). Campaigning must
-        already be running via start(). The deadline runs on wall time —
-        this waits on real threads, not the injectable test clock."""
+    def wait_for_leadership(
+        self,
+        timeout: Optional[float] = None,
+        interrupt: Optional[threading.Event] = None,
+    ) -> bool:
+        """Block until this candidate leads (or timeout, or `interrupt` is
+        set — e.g. the process's SIGTERM event, so a standby replica parked
+        here still honors shutdown instead of campaigning until SIGKILL).
+        Campaigning must already be running via start(). The deadline runs
+        on wall time — this waits on real threads, not the injectable test
+        clock."""
         import time as _time
 
         deadline = None if timeout is None else _time.monotonic() + timeout
         while not self._stop.is_set():
+            if interrupt is not None and interrupt.is_set():
+                return False
             if self._leading:
                 return True
             if deadline is not None and _time.monotonic() > deadline:

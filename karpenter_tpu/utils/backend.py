@@ -1,0 +1,104 @@
+"""Accelerator-backend probing that cannot take the process down.
+
+JAX backend initialization is a one-shot, in-process affair: once
+``jax.devices()`` fails (dead TPU tunnel, runtime mismatch) the failure is
+cached and the only recovery is a new process with ``JAX_PLATFORMS``
+overridden. Worse, a wedged tunnel can *hang* init rather than fail it.
+So anything that must survive a sick backend — bench.py, long-lived
+controllers deciding device vs host execution — probes in a **subprocess
+with a hard timeout** before importing jax in-process.
+
+This is the outermost of the solver's failure rings (SURVEY.md §5.3):
+device → native C++ → host oracle. The rings in solver/solve.py handle
+per-solve errors; this module handles "the backend never comes up at all".
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+
+log = logging.getLogger("karpenter.backend")
+
+_PROBE_SRC = "import jax; print(jax.default_backend())"
+
+
+def force_cpu() -> None:
+    """Make THIS process cpu-only, before any backend initializes.
+
+    ``JAX_PLATFORMS=cpu`` alone is NOT enough: an accelerator plugin
+    registered via sitecustomize (the axon TPU tunnel in this image) can
+    ignore it and still open its transport — hanging the process when the
+    fabric is sick. Deregistering its backend factory is the reliable off
+    switch (same mechanism tests/conftest.py uses). No-op if jax is
+    unavailable; must run before the first jax.devices()/jit call.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        from jax._src import xla_bridge as _xb
+
+        # pop only THIRD-PARTY factories: jax's own platform names must
+        # stay registered ("tpu" in particular — pallas/checkify register
+        # lowerings against it at import time and fail if it vanishes)
+        builtin = {"cpu", "gpu", "cuda", "rocm", "tpu", "metal", "METAL"}
+        for name in list(_xb._backend_factories):
+            if name not in builtin:
+                _xb._backend_factories.pop(name, None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as e:  # noqa: BLE001 — best effort, env var still set
+        log.warning("force_cpu: could not deregister plugins: %s", e)
+
+
+@dataclass
+class ProbeResult:
+    ok: bool
+    platform: str          # "tpu" | "cpu" | ... ("cpu" when not ok)
+    attempts: int
+    elapsed_s: float
+    error: str = ""
+
+
+def probe_backend(
+    timeout_s: float = 120.0,
+    retries: int = 3,
+    backoff_s: float = 5.0,
+    env: dict | None = None,
+) -> ProbeResult:
+    """Initialize JAX in a child process and report which platform answered.
+
+    Retries with linear backoff (tunnel hiccups at init are transient more
+    often than not); a hang is converted into a timeout, never inherited by
+    the caller. Returns ok=False with platform="cpu" after the last attempt
+    so callers can set ``JAX_PLATFORMS=cpu`` and proceed degraded.
+    """
+    t0 = time.monotonic()
+    last_err = ""
+    for attempt in range(1, retries + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=timeout_s,
+                env={**os.environ, **(env or {})},
+            )
+            if proc.returncode == 0 and proc.stdout.strip():
+                platform = proc.stdout.strip().splitlines()[-1]
+                return ProbeResult(True, platform, attempt,
+                                   time.monotonic() - t0)
+            last_err = (proc.stderr or "").strip().splitlines()[-1:] or ["rc!=0"]
+            last_err = last_err[0]
+        except subprocess.TimeoutExpired:
+            last_err = f"backend init exceeded {timeout_s:.0f}s"
+        except OSError as e:  # no python, fork failure — no point retrying
+            last_err = str(e)
+            break
+        log.warning("backend probe attempt %d/%d failed: %s",
+                    attempt, retries, last_err)
+        if attempt < retries:
+            time.sleep(backoff_s * attempt)
+    return ProbeResult(False, "cpu", retries, time.monotonic() - t0, last_err)

@@ -214,6 +214,29 @@ class TestLoggingConfig:
             "config-logging", "tenant")
         assert logging.getLogger(root).level == logging.NOTSET
 
+    def test_own_namespace_plumbed_from_options(self):
+        """The deployed map lives in the controller's namespace (e.g.
+        'karpenter'), discovered via POD_NAMESPACE — main.build_manager
+        passes options.namespace, so the reload works outside 'default'."""
+        import logging
+        import uuid
+
+        from karpenter_tpu.api.core import ConfigMap
+        from karpenter_tpu.config.options import parse
+        from karpenter_tpu.controllers.logging_config import LoggingConfigController
+
+        options = parse(["--namespace", "karpenter"])
+        assert options.namespace == "karpenter"
+        kube = KubeCore()
+        root = f"karpenter-own-{uuid.uuid4().hex[:6]}"
+        kube.create(ConfigMap(
+            metadata=ObjectMeta(name="config-logging", namespace="karpenter"),
+            data={"zap-logger-config": '{"level": "debug"}'}))
+        LoggingConfigController(
+            kube, namespace=options.namespace, root_logger=root,
+        ).reconcile("config-logging", "karpenter")
+        assert logging.getLogger(root).level == logging.DEBUG
+
 
 class TestNodeNameIndex:
     """The spec.nodeName field index (manager.go:39-43) must track every

@@ -31,6 +31,13 @@ PLURALS = {plural: kind for kind, (_, plural, _c) in ROUTES.items()}
 class StubHandler(BaseHTTPRequestHandler):
     core: KubeCore = None
     protocol_version = "HTTP/1.1"
+    # fault injection, mutated by tests mid-flight:
+    #   watch_410_next: after the next streamed event, emit an ERROR Status
+    #                   (code 410, reason Expired) and close — the real
+    #                   apiserver's watch-cache-expiry signal
+    #   throttle_429: serve this many 429+Retry-After responses (APF throttle)
+    #   evict_429: eviction subresource answers 429 (PDB would be violated)
+    behavior: dict = None
 
     def log_message(self, *a):
         pass
@@ -63,6 +70,13 @@ class StubHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         kind, namespace, name, _, qs = self._parse()
+        if self.behavior and self.behavior.get("throttle_429", 0) > 0:
+            self.behavior["throttle_429"] -= 1
+            self.send_response(429)
+            self.send_header("Retry-After", "0")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
         if name:
             try:
                 obj = self.core.get(kind, name, namespace or "default"
@@ -99,6 +113,16 @@ class StubHandler(BaseHTTPRequestHandler):
                 }).encode() + b"\n"
                 self.wfile.write(line)
                 self.wfile.flush()
+                if self.behavior and self.behavior.pop("watch_410_next", None):
+                    err = json.dumps({
+                        "type": "ERROR",
+                        "object": {"kind": "Status", "code": 410,
+                                   "reason": "Expired",
+                                   "message": "too old resource version"},
+                    }).encode() + b"\n"
+                    self.wfile.write(err)
+                    self.wfile.flush()
+                    return
         except (BrokenPipeError, ConnectionResetError):
             pass
         finally:
@@ -118,6 +142,11 @@ class StubHandler(BaseHTTPRequestHandler):
                 return self._send(409, b"{}")
             return self._send(201, b"{}")
         if sub == "eviction":
+            if self.behavior and self.behavior.get("evict_429"):
+                self.send_response(429)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
             try:
                 self.core.evict_pod(name, namespace)
             except NotFound:
@@ -154,18 +183,18 @@ class StubHandler(BaseHTTPRequestHandler):
 @pytest.fixture()
 def api():
     core = KubeCore()
-    handler = type("BoundStub", (StubHandler,), {"core": core})
+    handler = type("BoundStub", (StubHandler,), {"core": core, "behavior": {}})
     server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     client = KubeApiClient(f"http://127.0.0.1:{server.server_address[1]}")
-    yield core, client
+    yield core, client, handler.behavior
     client.stop_watches()
     server.shutdown()
 
 
 class TestCrud:
     def test_create_get_roundtrip(self, api):
-        core, client = api
+        core, client, _ = api
         pod = unschedulable_pod(requests={"cpu": "250m", "memory": "64Mi"},
                                 name="web-1")
         client.create(pod)
@@ -176,7 +205,7 @@ class TestCrud:
         assert core.get("Pod", "web-1").metadata.name == "web-1"
 
     def test_not_found_and_conflict(self, api):
-        core, client = api
+        core, client, _ = api
         with pytest.raises(NotFound):
             client.get("Pod", "missing")
         cm = ConfigMap(metadata=ObjectMeta(name="c"), data={"a": "1"})
@@ -189,7 +218,7 @@ class TestCrud:
             client.update(stale)
 
     def test_patch_retries_conflicts(self, api):
-        core, client = api
+        core, client, _ = api
         client.create(ConfigMap(metadata=ObjectMeta(name="c"), data={"n": "0"}))
 
         calls = {"n": 0}
@@ -207,7 +236,7 @@ class TestCrud:
         assert final.data["n"] == "1" and final.data["foreign"] == "x"
 
     def test_field_selector_pods_on_node(self, api):
-        core, client = api
+        core, client, _ = api
         for i, node in enumerate(["n1", "n1", "n2"]):
             core.create(Pod(metadata=ObjectMeta(name=f"p{i}"),
                             spec=PodSpec(node_name=node)))
@@ -215,7 +244,7 @@ class TestCrud:
         assert names == {"p0", "p1"}
 
     def test_cluster_scoped_node(self, api):
-        core, client = api
+        core, client, _ = api
         client.create(Node(metadata=ObjectMeta(name="node-a", namespace="")))
         assert client.get("Node", "node-a", "").metadata.name == "node-a"
         client.delete("Node", "node-a", "")
@@ -223,7 +252,7 @@ class TestCrud:
             client.get("Node", "node-a", "")
 
     def test_bind_and_evict(self, api):
-        core, client = api
+        core, client, _ = api
         pod = unschedulable_pod(name="b1")
         client.create(pod)
         client.bind_pod(pod, "node-z")
@@ -235,7 +264,7 @@ class TestCrud:
 
 class TestWatch:
     def test_watch_streams_events(self, api):
-        core, client = api
+        core, client, _ = api
         core.create(Pod(metadata=ObjectMeta(name="pre")))  # before watch
         q = client.watch("Pod")
         seen = {}
@@ -257,7 +286,7 @@ class TestControlPlaneOverTheWire:
         fake cloud provider) running against the API server over HTTP:
         provisioner + pending pods in → nodes created and pods bound, every
         read/write/watch crossing the wire through KubeApiClient."""
-        core, client = api
+        core, client, _ = api
         from karpenter_tpu.config.options import Options
         from karpenter_tpu.main import build_manager
         from tests.expectations import make_provisioner
@@ -296,7 +325,7 @@ class TestRealServerSemantics:
     def test_update_strips_finalizer_over_the_wire(self, api):
         """Owned-field removal must round-trip (termination's finalizer
         strip is the deprovisioning linchpin)."""
-        core, client = api
+        core, client, _ = api
         core.create(Node(metadata=ObjectMeta(
             name="nx", namespace="", finalizers=["karpenter.sh/termination"])))
         got = client.get("Node", "nx", "")
@@ -336,7 +365,7 @@ class TestRealServerSemantics:
 
         from karpenter_tpu.api.core import LabelSelector, NodeSelectorRequirement
 
-        _, client = api
+        _, client, _b = api
         seen = {}
         original = client._request
 
@@ -360,20 +389,86 @@ class TestRealServerSemantics:
         assert sel == "team=ml,app,!gone,zone notin (z1)"
 
     def test_unwatch_stops_thread(self, api):
-        core, client = api
+        """unwatch() closes the live streaming connection, so the backing
+        thread exits IMMEDIATELY — no event traffic needed to nudge it out
+        of its blocking read, no 300 s socket-timeout wait."""
+        core, client, _ = api
         q = client.watch("Pod")
+        core.create(Pod(metadata=ObjectMeta(name="settle")))
+        q.get(timeout=10.0)  # stream is established and delivering
         threads_before = list(client._watch_threads)  # only THIS client's
         assert threads_before and all(t.is_alive() for t in threads_before)
         client.unwatch(q)
+        deadline = time.time() + 5
+        while time.time() < deadline and any(t.is_alive() for t in threads_before):
+            time.sleep(0.05)  # deliberately NO pod creates: no nudging
+        stuck = [t for t in threads_before if t.is_alive()]
+        if stuck:
+            import sys as _sys
+            import traceback as _tb
+
+            frames = _sys._current_frames()
+            dumps = "\n".join(
+                "".join(_tb.format_stack(frames[t.ident]))
+                for t in stuck if t.ident in frames)
+            raise AssertionError(f"watch thread(s) still alive:\n{dumps}")
+
+    def test_watch_410_resync_loses_no_events(self, api):
+        """The apiserver's most common watch failure: the stream dies with
+        ERROR Status{code:410, reason:Expired}. The client must re-list and
+        re-watch — events created after the expiry must still arrive, and
+        the re-list replay proves the resync actually happened."""
+        core, client, behavior = api
+        core.create(Pod(metadata=ObjectMeta(name="before")))
+        q = client.watch("Pod")
+        ev = q.get(timeout=10.0)
+        assert ev.obj.metadata.name == "before"  # initial list replay
+
+        # arm the fault: the next streamed event is followed by ERROR 410
+        behavior["watch_410_next"] = True
+        core.create(Pod(metadata=ObjectMeta(name="trigger")))
+
+        # after the forced expiry, a new object must still be observed
+        seen = {}
         deadline = time.time() + 15
+        created_after = False
         while time.time() < deadline:
-            alive = [t for t in threads_before if t.is_alive()]
-            if not alive:
+            if not created_after and behavior.get("watch_410_next") is None:
+                # fault has fired (stub popped the flag) — now create the
+                # post-expiry object the resynced watch must deliver
+                core.create(Pod(metadata=ObjectMeta(name="after-410")))
+                created_after = True
+            try:
+                ev = q.get(timeout=0.5)
+            except queue_mod.Empty:
+                continue
+            seen[ev.obj.metadata.name] = seen.get(ev.obj.metadata.name, 0) + 1
+            if "after-410" in seen:
                 break
-            core.create(Pod(metadata=ObjectMeta(
-                name=f"tick-{time.monotonic_ns()}")))  # nudge the stream
-            time.sleep(0.2)
-        assert not any(t.is_alive() for t in threads_before)
+        assert "after-410" in seen, f"event lost across 410 resync: {seen}"
+        # the resync re-list replays pre-existing objects as ADDED again
+        assert seen.get("before", 0) >= 2, f"no re-list replay observed: {seen}"
+
+    def test_429_outside_eviction_retries_not_conflict(self, api):
+        """APF throttling (429 on a plain GET) is retried in place after
+        Retry-After — it must NOT surface as an optimistic-concurrency
+        Conflict (which would make patch() spin on re-reads)."""
+        core, client, behavior = api
+        core.create(ConfigMap(metadata=ObjectMeta(name="cm"), data={"k": "v"}))
+        behavior["throttle_429"] = 1
+        got = client.get("ConfigMap", "cm")  # retries through the 429
+        assert got.data["k"] == "v"
+        assert behavior["throttle_429"] == 0  # the throttle was actually hit
+
+    def test_429_on_eviction_is_pdb_conflict(self, api):
+        """On the eviction subresource 429 means 'PDB would be violated' —
+        that one keeps the Conflict mapping so the eviction queue backs off
+        (termination.py eviction backoff)."""
+        core, client, behavior = api
+        core.create(Pod(metadata=ObjectMeta(name="guarded")))
+        behavior["evict_429"] = True
+        with pytest.raises(Conflict):
+            client.evict_pod("guarded")
 
 
 class TestGraceCodec:
