@@ -201,18 +201,15 @@ class SSMAPI(abc.ABC):
         ...
 
 
-def boto3_clients(region: Optional[str] = None):
-    """Construct real AWS clients. boto3 is not in this image; this import
-    gate mirrors the reference's compile-time provider selection
-    (registry/aws.go build tag) — the AWS path only activates where the SDK
-    exists."""
-    try:
-        import boto3  # noqa: PLC0415
-    except ImportError as e:  # pragma: no cover
-        raise RuntimeError(
-            "boto3 is required for the real AWS cloud provider; "
-            "install it or use --cloud-provider=fake") from e
-    raise NotImplementedError(
-        "boto3 adapter intentionally unimplemented in this TPU build "
-        "environment (zero egress); the EC2API/SSMAPI seam is the "
-        "supported integration point")  # pragma: no cover
+def default_clients(region: Optional[str] = None):
+    """Construct the real AWS clients (no SDK dependency): hand-rolled
+    SigV4 + IMDSv2 + retryer on stdlib HTTP — see awsclient.py. Region
+    resolves env → IMDS exactly like the reference's session
+    (aws/cloudprovider.go:68-103)."""
+    from karpenter_tpu.cloudprovider.aws import awsclient
+
+    return awsclient.default_clients(region=region)
+
+
+# historical name from when this was a boto3 import gate
+boto3_clients = default_clients

@@ -43,7 +43,7 @@ def build_cloud_provider(options: Options):
         import karpenter_tpu.cloudprovider.aws  # noqa: F401 — registers "aws"
         from karpenter_tpu.cloudprovider.aws import sdk as aws_sdk
 
-        ec2api, ssmapi = aws_sdk.boto3_clients()
+        ec2api, ssmapi = aws_sdk.default_clients()
         return spi.resolve(
             "aws", ec2api=ec2api, ssmapi=ssmapi,
             cluster_name=options.cluster_name,

@@ -1,0 +1,538 @@
+"""The real AWS client stack: SigV4 signing against AWS's published
+worked examples, and Ec2Client/SsmClient against a live stub AWS endpoint
+(XML query protocol, pagination, fleet errors, retry/backoff, IMDSv2
+region + role-credential discovery, credential chain precedence)."""
+
+import base64
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from karpenter_tpu.cloudprovider.aws import sdk, sigv4
+from karpenter_tpu.cloudprovider.aws.awsclient import (
+    AwsApiError, AwsHttp, CredentialProvider, Credentials, Ec2Client, Imds,
+    Retryer, SsmClient, credentials_from_env, credentials_from_shared_file,
+    flatten_params, resolve_region,
+)
+
+
+# ---------------------------------------------------------------------------
+# SigV4 known-answer tests (values published in AWS's SigV4 documentation)
+# ---------------------------------------------------------------------------
+
+EXAMPLE_SECRET = "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
+
+
+class TestSigV4Vectors:
+    def test_derived_signing_key_documented_example(self):
+        """AWS docs, 'Deriving a signing key' worked example."""
+        key = sigv4.derive_signing_key(EXAMPLE_SECRET, "20120215",
+                                       "us-east-1", "iam")
+        assert key.hex() == ("f4780e2d9f65fa895f9c67b32ce1baf0b0d8a43505a"
+                             "000a1a9e090d414db404d")
+
+    def test_get_listusers_documented_example(self):
+        """AWS docs, complete GET ListUsers signing walkthrough: the
+        canonical-request hash AND final signature must both reproduce."""
+        headers = {"content-type":
+                   "application/x-www-form-urlencoded; charset=utf-8",
+                   "host": "iam.amazonaws.com",
+                   "x-amz-date": "20150830T123600Z"}
+        q = sigv4.canonical_query({"Action": "ListUsers",
+                                   "Version": "2010-05-08"})
+        canon, signed = sigv4.canonical_request(
+            "GET", "/", q, headers, sigv4.sha256_hex(b""))
+        assert sigv4.sha256_hex(canon.encode()) == (
+            "f536975d06c0309214f805bb90ccff089219ecd68b2577efef23edd43b7e1a59")
+        assert signed == "content-type;host;x-amz-date"
+
+        out = sigv4.sign(
+            method="GET", host="iam.amazonaws.com", path="/",
+            query_params={"Action": "ListUsers", "Version": "2010-05-08"},
+            headers={"content-type":
+                     "application/x-www-form-urlencoded; charset=utf-8"},
+            payload=b"", access_key="AKIDEXAMPLE", secret_key=EXAMPLE_SECRET,
+            region="us-east-1", service="iam", amz_date="20150830T123600Z")
+        assert out["authorization"] == (
+            "AWS4-HMAC-SHA256 "
+            "Credential=AKIDEXAMPLE/20150830/us-east-1/iam/aws4_request, "
+            "SignedHeaders=content-type;host;x-amz-date, "
+            "Signature=5d672d79c15b13162d9279b0855cfba6789a8edb4c82c400e"
+            "06b5924a6f2b5d7")
+
+    def test_session_token_is_signed_header(self):
+        out = sigv4.sign(
+            method="POST", host="ec2.us-east-1.amazonaws.com", path="/",
+            query_params={}, headers={"content-type": "a"}, payload=b"x",
+            access_key="AK", secret_key="SK", region="us-east-1",
+            service="ec2", amz_date="20260729T000000Z", session_token="TOK")
+        assert out["x-amz-security-token"] == "TOK"
+        assert "x-amz-security-token" in out["authorization"]
+
+    def test_query_canonicalization_sorts_and_encodes(self):
+        q = sigv4.canonical_query({"b": "2 2", "a": "1/1", "~ok": "v"})
+        assert q == "a=1%2F1&b=2%202&~ok=v"
+
+
+class TestFlatten:
+    def test_nested_structures(self):
+        out = flatten_params({
+            "Type": "instant",
+            "LaunchTemplateConfigs": [{
+                "LaunchTemplateSpecification": {"LaunchTemplateName": "lt"},
+                "Overrides": [{"InstanceType": "m5.large", "Priority": 1.0}],
+            }],
+            "DryRun": False,
+        })
+        assert out["Type"] == "instant"
+        assert out["LaunchTemplateConfigs.1.LaunchTemplateSpecification."
+                   "LaunchTemplateName"] == "lt"
+        assert out["LaunchTemplateConfigs.1.Overrides.1.InstanceType"] == "m5.large"
+        assert out["LaunchTemplateConfigs.1.Overrides.1.Priority"] == "1.0"
+        assert out["DryRun"] == "false"
+
+
+# ---------------------------------------------------------------------------
+# Stub AWS endpoint
+# ---------------------------------------------------------------------------
+
+
+class AwsStub(BaseHTTPRequestHandler):
+    """Speaks just enough EC2 query/XML + SSM JSON + IMDS to exercise the
+    client. Class attrs are fresh per-fixture (subclassed)."""
+
+    calls: list = None
+    fail_next: list = None        # queue of (status, body) to serve first
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, body, ctype="text/xml"):
+        data = body.encode() if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    # -- IMDS ------------------------------------------------------------
+    def do_PUT(self):
+        if self.path == "/latest/api/token":
+            return self._reply(200, "STUB-TOKEN", "text/plain")
+        self._reply(404, "nope", "text/plain")
+
+    def do_GET(self):
+        assert self.headers.get("x-aws-ec2-metadata-token") == "STUB-TOKEN"
+        if self.path == "/latest/meta-data/placement/region":
+            return self._reply(200, "us-test-7", "text/plain")
+        if self.path == "/latest/meta-data/iam/security-credentials/":
+            return self._reply(200, "stub-role\n", "text/plain")
+        if self.path == "/latest/meta-data/iam/security-credentials/stub-role":
+            return self._reply(200, json.dumps({
+                "AccessKeyId": "ROLE-AK", "SecretAccessKey": "ROLE-SK",
+                "Token": "ROLE-TOK", "Expiration": "2099-01-01T00:00:00Z",
+            }), "application/json")
+        self._reply(404, "nope", "text/plain")
+
+    # -- EC2/SSM ---------------------------------------------------------
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        auth = self.headers.get("authorization", "")
+        form = dict(urllib.parse.parse_qsl(body.decode())) \
+            if b"Action=" in body else {}
+        target = self.headers.get("x-amz-target", "")
+        self.calls.append({"form": form, "target": target, "auth": auth,
+                           "token": self.headers.get("x-amz-security-token")})
+        if self.fail_next:
+            status, payload = self.fail_next.pop(0)
+            return self._reply(status, payload)
+        if target == "AmazonSSM.GetParameter":
+            name = json.loads(body)["Name"]
+            if "missing" in name:
+                return self._reply(400, json.dumps(
+                    {"__type": "ParameterNotFound", "message": name}),
+                    "application/x-amz-json-1.1")
+            return self._reply(200, json.dumps(
+                {"Parameter": {"Value": f"ami-for-{name.rsplit('/', 1)[-1]}"}}),
+                "application/x-amz-json-1.1")
+        action = form.get("Action", "")
+        handler = getattr(self, f"ec2_{action}", None)
+        if handler is None:
+            return self._reply(400, ERROR_XML.format(
+                code="InvalidAction", msg=action))
+        return handler(form)
+
+    def ec2_DescribeInstanceTypes(self, form):
+        if "NextToken" not in form:
+            self._reply(200, DIT_PAGE1)
+        else:
+            assert form["NextToken"] == "tok-2"
+            self._reply(200, DIT_PAGE2)
+
+    def ec2_DescribeInstanceTypeOfferings(self, form):
+        assert form["LocationType"] == "availability-zone"
+        self._reply(200, OFFERINGS_XML)
+
+    def ec2_DescribeSubnets(self, form):
+        # echo back what filter arrived so the test can assert on it
+        self._reply(200, SUBNETS_XML)
+
+    def ec2_DescribeSecurityGroups(self, form):
+        self._reply(200, SGS_XML)
+
+    def ec2_DescribeLaunchTemplates(self, form):
+        if form.get("LaunchTemplateName.1") == "missing-lt":
+            return self._reply(400, ERROR_XML.format(
+                code="InvalidLaunchTemplateName.NotFoundException",
+                msg="missing"))
+        self._reply(200, LTS_XML)
+
+    def ec2_CreateLaunchTemplate(self, form):
+        assert base64.b64decode(
+            form["LaunchTemplateData.UserData"]).decode() == "#!/bin/bash boot"
+        self._reply(200, CREATE_LT_XML)
+
+    def ec2_CreateFleet(self, form):
+        assert form["Type"] == "instant"
+        assert form["TargetCapacitySpecification.TotalTargetCapacity"] == "2"
+        self._reply(200, FLEET_XML)
+
+    def ec2_DescribeInstances(self, form):
+        self._reply(200, INSTANCES_XML)
+
+    def ec2_TerminateInstances(self, form):
+        if form.get("InstanceId.1") == "i-gone":
+            return self._reply(400, ERROR_XML.format(
+                code="InvalidInstanceID.NotFound", msg="i-gone"))
+        self._reply(200, "<TerminateInstancesResponse/>")
+
+
+ERROR_XML = ('<Response><Errors><Error><Code>{code}</Code>'
+             '<Message>{msg}</Message></Error></Errors></Response>')
+
+DIT_PAGE1 = """<DescribeInstanceTypesResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+<instanceTypeSet><item>
+  <instanceType>m5.large</instanceType>
+  <vCpuInfo><defaultVCpus>2</defaultVCpus></vCpuInfo>
+  <memoryInfo><sizeInMiB>8192</sizeInMiB></memoryInfo>
+  <processorInfo><supportedArchitectures><item>x86_64</item></supportedArchitectures></processorInfo>
+  <supportedUsageClasses><item>on-demand</item><item>spot</item></supportedUsageClasses>
+  <supportedVirtualizationTypes><item>hvm</item></supportedVirtualizationTypes>
+  <networkInfo><maximumNetworkInterfaces>3</maximumNetworkInterfaces>
+    <ipv4AddressesPerInterface>10</ipv4AddressesPerInterface></networkInfo>
+  <bareMetal>false</bareMetal>
+</item></instanceTypeSet>
+<nextToken>tok-2</nextToken>
+</DescribeInstanceTypesResponse>"""
+
+DIT_PAGE2 = """<DescribeInstanceTypesResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+<instanceTypeSet><item>
+  <instanceType>p3.8xlarge</instanceType>
+  <vCpuInfo><defaultVCpus>32</defaultVCpus></vCpuInfo>
+  <memoryInfo><sizeInMiB>249856</sizeInMiB></memoryInfo>
+  <processorInfo><supportedArchitectures><item>x86_64</item></supportedArchitectures></processorInfo>
+  <supportedUsageClasses><item>on-demand</item></supportedUsageClasses>
+  <supportedVirtualizationTypes><item>hvm</item></supportedVirtualizationTypes>
+  <gpuInfo><gpus><item><manufacturer>NVIDIA</manufacturer><count>4</count></item></gpus></gpuInfo>
+  <networkInfo><maximumNetworkInterfaces>8</maximumNetworkInterfaces>
+    <ipv4AddressesPerInterface>30</ipv4AddressesPerInterface></networkInfo>
+  <bareMetal>false</bareMetal>
+</item></instanceTypeSet>
+</DescribeInstanceTypesResponse>"""
+
+OFFERINGS_XML = """<DescribeInstanceTypeOfferingsResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+<instanceTypeOfferingSet>
+  <item><instanceType>m5.large</instanceType><location>us-test-7a</location></item>
+  <item><instanceType>m5.large</instanceType><location>us-test-7b</location></item>
+</instanceTypeOfferingSet>
+</DescribeInstanceTypeOfferingsResponse>"""
+
+SUBNETS_XML = """<DescribeSubnetsResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+<subnetSet><item>
+  <subnetId>subnet-1</subnetId><availabilityZone>us-test-7a</availabilityZone>
+  <tagSet><item><key>kubernetes.io/cluster/c</key><value>owned</value></item></tagSet>
+</item></subnetSet>
+</DescribeSubnetsResponse>"""
+
+SGS_XML = """<DescribeSecurityGroupsResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+<securityGroupInfo><item>
+  <groupId>sg-1</groupId><groupName>nodes</groupName>
+  <tagSet><item><key>team</key><value>ml</value></item></tagSet>
+</item></securityGroupInfo>
+</DescribeSecurityGroupsResponse>"""
+
+LTS_XML = """<DescribeLaunchTemplatesResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+<launchTemplates><item>
+  <launchTemplateName>kt-abc</launchTemplateName>
+  <launchTemplateId>lt-123</launchTemplateId>
+</item></launchTemplates>
+</DescribeLaunchTemplatesResponse>"""
+
+CREATE_LT_XML = """<CreateLaunchTemplateResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+<launchTemplate>
+  <launchTemplateName>kt-abc</launchTemplateName>
+  <launchTemplateId>lt-999</launchTemplateId>
+</launchTemplate>
+</CreateLaunchTemplateResponse>"""
+
+FLEET_XML = """<CreateFleetResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+<fleetInstanceSet><item>
+  <instanceIds><item>i-aaa</item><item>i-bbb</item></instanceIds>
+</item></fleetInstanceSet>
+<errorSet><item>
+  <errorCode>InsufficientInstanceCapacity</errorCode>
+  <errorMessage>no p3 left</errorMessage>
+  <launchTemplateAndOverrides><overrides>
+    <instanceType>p3.8xlarge</instanceType>
+    <availabilityZone>us-test-7a</availabilityZone>
+  </overrides></launchTemplateAndOverrides>
+</item></errorSet>
+</CreateFleetResponse>"""
+
+INSTANCES_XML = """<DescribeInstancesResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+<reservationSet><item><instancesSet><item>
+  <instanceId>i-aaa</instanceId><instanceType>m5.large</instanceType>
+  <placement><availabilityZone>us-test-7a</availabilityZone></placement>
+  <privateDnsName>ip-10-0-0-1.ec2.internal</privateDnsName>
+  <imageId>ami-1</imageId><architecture>x86_64</architecture>
+  <spotInstanceRequestId>sir-1</spotInstanceRequestId>
+</item></instancesSet></item></reservationSet>
+</DescribeInstancesResponse>"""
+
+
+@pytest.fixture()
+def aws_stub():
+    handler = type("BoundAwsStub", (AwsStub,), {"calls": [], "fail_next": []})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield url, handler
+    server.shutdown()
+
+
+def _creds(token=None):
+    p = CredentialProvider()
+    p._cached = Credentials("AK-TEST", "SK-TEST", token)
+    return p
+
+
+def _ec2(url, token=None, retryer=None):
+    return Ec2Client(AwsHttp("ec2", "us-test-7", _creds(token), endpoint=url,
+                             retryer=retryer or Retryer(sleep=lambda s: None)))
+
+
+class TestEc2ClientWire:
+    def test_describe_instance_types_paginates_and_parses(self, aws_stub):
+        url, handler = aws_stub
+        infos = _ec2(url).describe_instance_types()
+        assert [i.instance_type for i in infos] == ["m5.large", "p3.8xlarge"]
+        m5, p3 = infos
+        assert (m5.vcpus, m5.memory_mib, m5.maximum_network_interfaces,
+                m5.ipv4_addresses_per_interface) == (2, 8192, 3, 10)
+        assert p3.gpus[0].manufacturer == "NVIDIA" and p3.gpus[0].count == 4
+        assert len(handler.calls) == 2  # two pages
+        # every call carried a SigV4 Authorization with the right scope
+        for c in handler.calls:
+            assert "Credential=AK-TEST/" in c["auth"]
+            assert "/us-test-7/ec2/aws4_request" in c["auth"]
+            assert "Signature=" in c["auth"]
+
+    def test_offerings_subnets_sgs(self, aws_stub):
+        url, handler = aws_stub
+        ec2 = _ec2(url)
+        offs = ec2.describe_instance_type_offerings()
+        assert {(o.instance_type, o.location) for o in offs} == {
+            ("m5.large", "us-test-7a"), ("m5.large", "us-test-7b")}
+        subnets = ec2.describe_subnets({"kubernetes.io/cluster/c": "*"})
+        assert subnets[0].subnet_id == "subnet-1"
+        # '*' → tag-key wildcard filter on the wire (aws/subnets.go:63-76)
+        call = [c for c in handler.calls
+                if c["form"].get("Action") == "DescribeSubnets"][0]
+        assert call["form"]["Filter.1.Name"] == "tag-key"
+        assert call["form"]["Filter.1.Value.1"] == "kubernetes.io/cluster/c"
+        sgs = ec2.describe_security_groups({"team": "ml"})
+        assert sgs[0].group_id == "sg-1"
+        call = [c for c in handler.calls
+                if c["form"].get("Action") == "DescribeSecurityGroups"][0]
+        assert call["form"]["Filter.1.Name"] == "tag:team"
+
+    def test_launch_template_roundtrip_and_notfound(self, aws_stub):
+        url, _ = aws_stub
+        ec2 = _ec2(url)
+        assert ec2.describe_launch_templates(["missing-lt"]) == []
+        lts = ec2.describe_launch_templates(["kt-abc"])
+        assert lts[0].launch_template_id == "lt-123"
+        created = ec2.create_launch_template(sdk.LaunchTemplate(
+            launch_template_name="kt-abc", user_data="#!/bin/bash boot",
+            image_id="ami-1", instance_profile="karpenter",
+            security_group_ids=["sg-1"],
+            metadata_options={"HttpTokens": "required"},
+            tags={"Name": "karpenter"}))
+        assert created.launch_template_id == "lt-999"
+
+    def test_create_fleet_instances_and_ice_errors(self, aws_stub):
+        url, _ = aws_stub
+        resp = _ec2(url).create_fleet(sdk.CreateFleetRequest(
+            launch_template_configs=[sdk.FleetLaunchTemplateConfig(
+                launch_template_name="kt-abc",
+                overrides=[sdk.FleetOverride(instance_type="m5.large",
+                                             subnet_id="subnet-1",
+                                             availability_zone="us-test-7a",
+                                             priority=1.0)])],
+            total_target_capacity=2))
+        assert resp.instance_ids == ["i-aaa", "i-bbb"]
+        err = resp.errors[0]
+        assert err.error_code == sdk.INSUFFICIENT_CAPACITY_ERROR_CODE
+        assert (err.instance_type, err.availability_zone) == (
+            "p3.8xlarge", "us-test-7a")
+
+    def test_describe_and_terminate_instances(self, aws_stub):
+        url, _ = aws_stub
+        ec2 = _ec2(url)
+        inst = ec2.describe_instances(["i-aaa"])[0]
+        assert (inst.instance_id, inst.availability_zone,
+                inst.spot_instance_request_id) == ("i-aaa", "us-test-7a", "sir-1")
+        ec2.terminate_instances(["i-aaa"])  # no raise
+        with pytest.raises(sdk.EC2Error) as ei:
+            ec2.terminate_instances(["i-gone"])
+        assert ei.value.is_not_found
+
+    def test_session_token_travels(self, aws_stub):
+        url, handler = aws_stub
+        _ec2(url, token="TOK-1").describe_instances(["i-aaa"])
+        assert handler.calls[0]["token"] == "TOK-1"
+
+    def test_retry_on_throttle_then_success(self, aws_stub):
+        url, handler = aws_stub
+        handler.fail_next.extend([
+            (503, ERROR_XML.format(code="RequestLimitExceeded", msg="slow")),
+            (500, ERROR_XML.format(code="InternalError", msg="oops")),
+        ])
+        slept = []
+        r = Retryer(sleep=slept.append, rand=lambda: 1.0)
+        inst = _ec2(url, retryer=r).describe_instances(["i-aaa"])
+        assert inst[0].instance_id == "i-aaa"
+        assert len(handler.calls) == 3
+        assert slept == [0.2, 0.4]  # exponential, jitter pinned to 1.0
+
+    def test_non_retryable_error_raises_immediately(self, aws_stub):
+        url, handler = aws_stub
+        handler.fail_next.append(
+            (400, ERROR_XML.format(code="InvalidParameterValue", msg="bad")))
+        with pytest.raises(AwsApiError) as ei:
+            _ec2(url).describe_instances(["i-aaa"])
+        assert ei.value.code == "InvalidParameterValue"
+        assert len(handler.calls) == 1
+
+    def test_retries_exhausted_raises_last(self, aws_stub):
+        url, handler = aws_stub
+        handler.fail_next.extend(
+            [(503, ERROR_XML.format(code="ServiceUnavailable", msg="x"))] * 9)
+        r = Retryer(max_attempts=3, sleep=lambda s: None)
+        with pytest.raises(AwsApiError) as ei:
+            _ec2(url, retryer=r).describe_instances(["i-aaa"])
+        assert ei.value.code == "ServiceUnavailable"
+        assert len(handler.calls) == 3
+
+
+class TestSsmClient:
+    def test_get_parameter(self, aws_stub):
+        url, handler = aws_stub
+        ssm = SsmClient(AwsHttp("ssm", "us-test-7", _creds(), endpoint=url,
+                                retryer=Retryer(sleep=lambda s: None)))
+        val = ssm.get_parameter(
+            "/aws/service/eks/optimized-ami/1.21/amazon-linux-2/recommended/image_id")
+        assert val == "ami-for-image_id"
+        assert handler.calls[0]["target"] == "AmazonSSM.GetParameter"
+        assert "/us-test-7/ssm/aws4_request" in handler.calls[0]["auth"]
+
+    def test_parameter_not_found(self, aws_stub):
+        url, _ = aws_stub
+        ssm = SsmClient(AwsHttp("ssm", "us-test-7", _creds(), endpoint=url,
+                                retryer=Retryer(sleep=lambda s: None)))
+        with pytest.raises(AwsApiError) as ei:
+            ssm.get_parameter("/missing/param")
+        assert ei.value.code == "ParameterNotFound"
+
+
+class TestImdsAndCredentials:
+    def test_imds_region_and_role_credentials(self, aws_stub):
+        url, _ = aws_stub
+        imds = Imds(endpoint=url)
+        assert imds.region() == "us-test-7"
+        creds = imds.role_credentials()
+        assert (creds.access_key, creds.secret_key, creds.session_token) == (
+            "ROLE-AK", "ROLE-SK", "ROLE-TOK")
+        assert creds.expiration is not None and not creds.expired()
+        # Expiration is UTC: 2099-01-01T00:00:00Z must decode to the UTC
+        # epoch regardless of the host timezone (timegm, not mktime)
+        import calendar, time as _time
+        assert creds.expiration == calendar.timegm(
+            _time.strptime("2099-01-01T00:00:00", "%Y-%m-%dT%H:%M:%S"))
+
+    def test_imds_session_token_cached(self, aws_stub):
+        """One PUT /latest/api/token serves many reads (IMDS is per-instance
+        rate limited); only near TTL expiry is a new token fetched."""
+        url, _ = aws_stub
+        imds = Imds(endpoint=url)
+        puts = {"n": 0}
+        orig = imds._req
+
+        def counting(method, path, headers=None):
+            if method == "PUT":
+                puts["n"] += 1
+            return orig(method, path, headers)
+
+        imds._req = counting
+        imds.region()
+        imds.role_credentials()
+        assert puts["n"] == 1
+        imds._token_expiry = 0.0  # force expiry → exactly one refresh
+        imds.region()
+        assert puts["n"] == 2
+
+    def test_resolve_region_env_wins(self, aws_stub, monkeypatch):
+        url, _ = aws_stub
+        monkeypatch.setenv("AWS_REGION", "eu-env-1")
+        assert resolve_region(Imds(endpoint=url)) == "eu-env-1"
+        monkeypatch.delenv("AWS_REGION")
+        monkeypatch.delenv("AWS_DEFAULT_REGION", raising=False)
+        assert resolve_region(Imds(endpoint=url)) == "us-test-7"
+
+    def test_credential_chain_env_then_file_then_imds(self, aws_stub,
+                                                      monkeypatch, tmp_path):
+        url, _ = aws_stub
+        # env wins
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", "ENV-AK")
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "ENV-SK")
+        assert credentials_from_env().access_key == "ENV-AK"
+        # shared file
+        monkeypatch.delenv("AWS_ACCESS_KEY_ID")
+        monkeypatch.delenv("AWS_SECRET_ACCESS_KEY")
+        f = tmp_path / "credentials"
+        f.write_text("[default]\naws_access_key_id = FILE-AK\n"
+                     "aws_secret_access_key = FILE-SK\n")
+        monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE", str(f))
+        assert credentials_from_shared_file().access_key == "FILE-AK"
+        # full chain falls through to IMDS when neither exists
+        monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE",
+                           str(tmp_path / "nope"))
+        provider = CredentialProvider(Imds(endpoint=url))
+        assert provider.get().access_key == "ROLE-AK"
+        # cached until expiry
+        assert provider.get() is provider._cached
+
+    def test_provider_constructs_without_boto3(self):
+        """VERDICT #2 'done' criterion: no NotImplementedError left and no
+        third-party SDK import anywhere in the client stack."""
+        import karpenter_tpu.cloudprovider.aws.awsclient as ac
+        import karpenter_tpu.cloudprovider.aws.sdk as s
+        import inspect
+
+        src = inspect.getsource(ac) + inspect.getsource(s)
+        assert "NotImplementedError" not in src
+        assert "import boto3" not in src
