@@ -30,7 +30,7 @@ from karpenter_tpu.api.core import (
     PersistentVolume, PersistentVolumeClaim, PersistentVolumeClaimSpec,
     PersistentVolumeClaimVolumeSource, PersistentVolumeSpec, Pod,
     PodCondition, PodSpec, PodStatus, PodTemplateSpec,
-    PreferredSchedulingTerm, ResourceRequirements, StorageClass, Taint,
+    PreferredSchedulingTerm, ResourceRequirements, Secret, StorageClass, Taint,
     Toleration, TopologySelectorTerm, TopologySpreadConstraint, Volume,
     VolumeNodeAffinity,
 )
@@ -425,6 +425,17 @@ def configmap_to(cm: ConfigMap) -> Dict[str, Any]:
             "metadata": meta_to(cm.metadata), "data": dict(cm.data)}
 
 
+def secret_from(obj: Dict[str, Any]) -> Secret:
+    return Secret(metadata=meta_from(obj.get("metadata") or {}),
+                  data=dict(obj.get("data") or {}),
+                  type=obj.get("type", "Opaque"))
+
+
+def secret_to(s: Secret) -> Dict[str, Any]:
+    return {"apiVersion": "v1", "kind": "Secret", "type": s.type,
+            "metadata": meta_to(s.metadata), "data": dict(s.data)}
+
+
 def lease_from(obj: Dict[str, Any]) -> Lease:
     spec = obj.get("spec") or {}
     return Lease(
@@ -528,6 +539,7 @@ def storageclass_from(obj: Dict[str, Any]) -> StorageClass:
 # -- dispatch ---------------------------------------------------------------
 
 DECODERS = {
+    "Secret": secret_from,
     "Lease": lease_from,
     "Pod": pod_from,
     "Node": node_from,
@@ -539,6 +551,7 @@ DECODERS = {
 }
 
 ENCODERS = {
+    "Secret": secret_to,
     "Lease": lease_to,
     "Pod": pod_to,
     "Node": node_to,

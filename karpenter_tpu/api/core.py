@@ -289,6 +289,19 @@ class ConfigMap:
 
 
 @dataclass
+class Secret:
+    """v1 Secret; ``data`` values are base64-encoded strings (wire form).
+    Backs the webhook serving certificate (cmd/webhook/main.go:49,57 —
+    knative's certificates controller persists its CA + serving pair the
+    same way)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+    type: str = "Opaque"
+    kind: str = "Secret"
+
+
+@dataclass
 class PersistentVolumeClaimSpec:
     storage_class_name: Optional[str] = None
     volume_name: str = ""

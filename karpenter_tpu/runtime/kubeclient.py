@@ -53,6 +53,7 @@ ROUTES: Dict[str, Tuple[str, str, bool]] = {
     "Pod": ("/api/v1", "pods", False),
     "Node": ("/api/v1", "nodes", True),
     "ConfigMap": ("/api/v1", "configmaps", False),
+    "Secret": ("/api/v1", "secrets", False),
     "PersistentVolumeClaim": ("/api/v1", "persistentvolumeclaims", False),
     "PersistentVolume": ("/api/v1", "persistentvolumes", True),
     "DaemonSet": ("/apis/apps/v1", "daemonsets", False),
@@ -309,6 +310,15 @@ class KubeApiClient:
 
     def delete(self, kind: str, name: str, namespace: str = "default"):
         return self._request("DELETE", self._item(kind, name, namespace)) or None
+
+    # -- raw access ----------------------------------------------------------
+    # For kinds without a modeled codec (e.g. admissionregistration
+    # webhook configurations, patched by the webhook's cert reconciler).
+    def get_raw(self, path: str) -> Dict:
+        return self._request("GET", path)
+
+    def put_raw(self, path: str, body: Dict) -> Dict:
+        return self._request("PUT", path, body)
 
     # -- subresources --------------------------------------------------------
     def bind_pod(self, pod: Pod, node_name: str) -> None:

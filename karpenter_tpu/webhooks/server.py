@@ -9,9 +9,12 @@ a base64 JSONPatch, validation with allowed/denied + message. Cloud
 providers hook in via spi.CloudProvider.default/validate exactly as the
 registry wires DefaultHook/ValidateHook (v1alpha5/register.go:27-29).
 
-Run: ``python -m karpenter_tpu.webhooks.server [--port 8443]`` (plain HTTP;
-terminate TLS in front — the reference's cert controller is deploy-time
-concern, see deploy/admission.yaml).
+Run: ``python -m karpenter_tpu.webhooks.server [--port 8443]``. TLS is on
+by default in-cluster: a Secret-backed CA + serving cert with rotation
+(webhooks/certs.py — the counterpart of the reference's knative
+certificates controller, cmd/webhook/main.go:49,57); the API server only
+calls HTTPS webhooks. ``--no-tls`` keeps plain HTTP for dev/tests behind a
+TLS-terminating proxy.
 """
 
 from __future__ import annotations
@@ -20,6 +23,7 @@ import argparse
 import base64
 import json
 import logging
+import os
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
@@ -149,24 +153,84 @@ class AdmissionHandler(BaseHTTPRequestHandler):
 
 
 def serve(port: int = 8443,
-          cloud_provider: Optional[CloudProvider] = None) -> ThreadingHTTPServer:
+          cloud_provider: Optional[CloudProvider] = None,
+          cert_manager=None,
+          host: str = "0.0.0.0") -> ThreadingHTTPServer:
+    """With a ``certs.CertManager``, the socket serves HTTPS off the
+    manager's live SSLContext — serving-cert rotation applies to new
+    handshakes without restarting or rebinding."""
     handler = type("BoundAdmissionHandler", (AdmissionHandler,),
                    {"cloud_provider": cloud_provider})
-    server = ThreadingHTTPServer(("0.0.0.0", port), handler)
-    log.info("admission webhook listening on :%d", port)
+    server = ThreadingHTTPServer((host, port), handler)
+    if cert_manager is not None:
+        server.socket = cert_manager.ssl_context().wrap_socket(
+            server.socket, server_side=True)
+        log.info("admission webhook listening on :%d (TLS)", port)
+    else:
+        log.info("admission webhook listening on :%d (plain HTTP)", port)
     return server
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     parser = argparse.ArgumentParser(description="karpenter-tpu admission webhook")
     parser.add_argument("--port", type=int, default=8443)
+    parser.add_argument("--tls", action=argparse.BooleanOptionalAction,
+                        default=True)
+    parser.add_argument("--namespace",
+                        default=os.environ.get("POD_NAMESPACE", "karpenter"))
+    parser.add_argument("--kube-backend", choices=["in-cluster", "memory"],
+                        default="in-cluster")
+    # provider Default/Validate hooks run in the webhook exactly as the
+    # registry wires them in the reference (v1alpha5/register.go:27-29)
+    parser.add_argument("--cloud-provider", default="")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    server = serve(args.port)
+    cloud_provider = None
+    if args.cloud_provider:
+        from karpenter_tpu.cloudprovider import spi
+
+        if args.cloud_provider == "fake":
+            import karpenter_tpu.cloudprovider.fake.provider  # noqa: F401
+            cloud_provider = spi.resolve("fake")
+        else:
+            from karpenter_tpu.config.options import Options
+            from karpenter_tpu.main import build_cloud_provider
+
+            cloud_provider = build_cloud_provider(
+                Options(cloud_provider=args.cloud_provider))
+    cert_manager = None
+    rotation = None
+    if args.tls:
+        from karpenter_tpu.webhooks import certs
+
+        if args.kube_backend == "in-cluster":
+            from karpenter_tpu.runtime.kubeclient import KubeApiClient
+
+            kube = KubeApiClient.in_cluster()
+        else:
+            from karpenter_tpu.runtime.kubecore import KubeCore
+
+            kube = KubeCore()
+        cert_manager = certs.CertManager(kube, namespace=args.namespace)
+        cert_manager.ensure()
+        rotation = certs.start_rotation_thread(cert_manager)
+        if hasattr(kube, "get_raw"):
+            # stamp our CA into the live webhook configurations so the API
+            # server trusts this endpoint (stable across serving-cert
+            # rotations — the CA outlives them by design)
+            try:
+                n = certs.reconcile_ca_bundles(kube, cert_manager.ca.cert_pem)
+                log.info("caBundle stamped into %d webhook configuration(s)", n)
+            except Exception:  # noqa: BLE001 — apply may come later
+                log.exception("caBundle reconcile failed; will serve anyway")
+    server = serve(args.port, cloud_provider=cloud_provider,
+                   cert_manager=cert_manager)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         server.shutdown()
+        if rotation is not None:
+            rotation.stop_event.set()
 
 
 if __name__ == "__main__":

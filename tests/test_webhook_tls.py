@@ -1,0 +1,248 @@
+"""Webhook TLS lifecycle: CA/serving-cert generation, Secret persistence
+shared across replicas, HTTPS AdmissionReview round-trip, and serving-cert
+rotation mid-flight with zero downtime (certs.py; reference counterpart:
+cmd/webhook/main.go:49,57 knative certificates controller)."""
+
+import base64
+import datetime
+import json
+import ssl
+import threading
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.webhooks import certs
+from karpenter_tpu.webhooks.certs import (
+    CertManager, cert_not_after, generate_ca, generate_serving_cert,
+    inject_ca_bundle,
+)
+from karpenter_tpu.webhooks.server import serve
+
+
+class TestCertGeneration:
+    def test_ca_signs_serving_cert_with_sans(self):
+        from cryptography import x509
+
+        ca = generate_ca()
+        pair = generate_serving_cert(
+            ca, ["karpenter-webhook", "karpenter-webhook.karpenter.svc"])
+        cert = x509.load_pem_x509_certificate(pair.cert_pem)
+        ca_cert = x509.load_pem_x509_certificate(ca.cert_pem)
+        assert cert.issuer == ca_cert.subject
+        sans = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+        assert set(sans.get_values_for_type(x509.DNSName)) == {
+            "karpenter-webhook", "karpenter-webhook.karpenter.svc"}
+        # the CA verifies its own signature chain
+        ca_cert.public_key().verify(
+            cert.signature, cert.tbs_certificate_bytes,
+            __import__("cryptography.hazmat.primitives.asymmetric.ec",
+                       fromlist=["ECDSA"]).ECDSA(
+                cert.signature_hash_algorithm))
+
+    def test_serving_cert_shorter_than_ca(self):
+        ca = generate_ca()
+        pair = generate_serving_cert(ca, ["x"])
+        assert cert_not_after(pair.cert_pem) < cert_not_after(ca.cert_pem)
+
+
+class TestCertManagerSecret:
+    def test_persists_and_second_replica_loads(self):
+        kube = KubeCore()
+        m1 = CertManager(kube, namespace="karpenter")
+        m1.ensure()
+        secret = kube.get("Secret", certs.SECRET_NAME, "karpenter")
+        assert set(secret.data) == {"ca.crt", "ca.key", "tls.crt", "tls.key"}
+        assert secret.type == "kubernetes.io/tls"
+        # a second manager (another replica) loads the SAME identity
+        m2 = CertManager(kube, namespace="karpenter")
+        m2.ensure()
+        assert m2.serving.cert_pem == m1.serving.cert_pem
+        assert m2.ca.cert_pem == m1.ca.cert_pem
+
+    def test_near_expiry_reissues_keeping_ca(self):
+        kube = KubeCore()
+        m = CertManager(kube, namespace="karpenter")
+        m.ensure()
+        old_serving, old_ca = m.serving.cert_pem, m.ca.cert_pem
+        # shrink lifetime below the margin by issuing a short-lived cert
+        m.serving = generate_serving_cert(m.ca, m.dns_names, days=1)
+        m._store()
+        m2 = CertManager(kube, namespace="karpenter")
+        m2.ensure()  # loads, sees near-expiry, re-issues under the same CA
+        assert m2.ca.cert_pem == old_ca
+        assert m2.serving.cert_pem != old_serving
+        assert (cert_not_after(m2.serving.cert_pem)
+                - datetime.datetime.now(datetime.timezone.utc)
+                > m2.rotation_margin)
+
+    def test_bootstrap_race_adopts_winner(self):
+        """Two replicas bootstrapping concurrently must converge on ONE
+        identity: the loser of the Secret create race adopts the winner's
+        pair instead of patching its own over it."""
+        kube = KubeCore()
+        winner = CertManager(kube, namespace="karpenter")
+        loser = CertManager(kube, namespace="karpenter")
+        # both load nothing (simulating the race window), winner stores first
+        winner.ensure()
+        # loser minted its own pair before discovering the Secret exists
+        loser.ca = generate_ca()
+        loser.serving = generate_serving_cert(loser.ca, loser.dns_names)
+        assert loser._store(adopt_on_conflict=True) is False
+        assert loser.ca.cert_pem == winner.ca.cert_pem
+        assert loser.serving.cert_pem == winner.serving.cert_pem
+        # the stored Secret still holds the winner's pair
+        stored = kube.get("Secret", certs.SECRET_NAME, "karpenter")
+        assert base64.b64decode(stored.data["ca.crt"]) == winner.ca.cert_pem
+
+    def test_ca_bundle_injection(self):
+        ca = generate_ca()
+        manifest = {"kind": "ValidatingWebhookConfiguration",
+                    "webhooks": [{"name": "a", "clientConfig": {"service": {}}},
+                                 {"name": "b"}]}
+        out = inject_ca_bundle(manifest, ca.cert_pem)
+        for hook in out["webhooks"]:
+            assert base64.b64decode(hook["clientConfig"]["caBundle"]) == ca.cert_pem
+
+
+@pytest.fixture()
+def https_webhook():
+    kube = KubeCore()
+    manager = CertManager(kube, namespace="karpenter",
+                          dns_names=["localhost"])
+    manager.ensure()
+    server = serve(port=0, cert_manager=manager, host="127.0.0.1")
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.socket.getsockname()[1]
+    yield manager, port, kube
+    server.shutdown()
+
+
+def _post_review(port: int, ca_pem: bytes, path: str, review: dict) -> dict:
+    import tempfile
+
+    ctx = ssl.create_default_context()
+    with tempfile.NamedTemporaryFile(suffix=".crt") as f:
+        f.write(ca_pem)
+        f.flush()
+        ctx.load_verify_locations(f.name)
+    req = urllib.request.Request(
+        f"https://localhost:{port}{path}",
+        data=json.dumps(review).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _peer_cert_serial(port: int, ca_pem: bytes) -> int:
+    import socket
+    import tempfile
+
+    from cryptography import x509
+
+    ctx = ssl.create_default_context()
+    with tempfile.NamedTemporaryFile(suffix=".crt") as f:
+        f.write(ca_pem)
+        f.flush()
+        ctx.load_verify_locations(f.name)
+    with socket.create_connection(("localhost", port), timeout=10) as sock:
+        with ctx.wrap_socket(sock, server_hostname="localhost") as tls:
+            der = tls.getpeercert(binary_form=True)
+    return x509.load_der_x509_certificate(der).serial_number
+
+
+class TestHttpsAdmission:
+    def test_https_roundtrip_defaulting(self, https_webhook):
+        """The API server only dials HTTPS with a trusted caBundle — this
+        is that call: CA-pinned client, AdmissionReview in, JSONPatch out."""
+        manager, port, _ = https_webhook
+        review = {"request": {"uid": "u-1", "object": {
+            "apiVersion": "karpenter.sh/v1alpha5", "kind": "Provisioner",
+            "metadata": {"name": "default"}, "spec": {}}}}
+        reply = _post_review(port, manager.ca.cert_pem,
+                             "/default-resource", review)
+        assert reply["response"]["uid"] == "u-1"
+        assert reply["response"]["allowed"] is True
+
+    def test_untrusted_ca_is_rejected(self, https_webhook):
+        manager, port, _ = https_webhook
+        other_ca = generate_ca("imposter")
+        with pytest.raises(Exception) as ei:
+            _post_review(port, other_ca.cert_pem, "/default-resource",
+                         {"request": {"uid": "u"}})
+        assert "certificate" in str(ei.value).lower()
+
+    def test_rotation_mid_flight(self, https_webhook):
+        """Force the serving cert inside the rotation margin; the live
+        server must present the NEW cert on the next handshake (same CA,
+        same socket, no restart), and reviews keep working throughout."""
+        manager, port, kube = https_webhook
+        serial_before = _peer_cert_serial(port, manager.ca.cert_pem)
+        # shrink remaining lifetime below the margin
+        manager.serving = generate_serving_cert(manager.ca, manager.dns_names,
+                                                days=1)
+        manager._store()
+        manager._reload_ctx()
+        assert manager.rotate_if_needed() is True
+        serial_after = _peer_cert_serial(port, manager.ca.cert_pem)
+        assert serial_after != serial_before
+        # rotated cert persisted for other replicas
+        stored = kube.get("Secret", certs.SECRET_NAME, "karpenter")
+        assert base64.b64decode(
+            stored.data["tls.crt"]) == manager.serving.cert_pem
+        # and admission still round-trips over the rotated cert
+        reply = _post_review(port, manager.ca.cert_pem, "/validate-resource",
+                             {"request": {"uid": "u-2", "object": {
+                                 "apiVersion": "karpenter.sh/v1alpha5",
+                                 "kind": "Provisioner",
+                                 "metadata": {"name": "default"},
+                                 "spec": {}}}})
+        assert reply["response"]["uid"] == "u-2"
+
+    def test_no_rotation_outside_margin(self, https_webhook):
+        manager, _, _ = https_webhook
+        assert manager.rotate_if_needed() is False
+
+
+class TestCaBundleReconcile:
+    def test_stamps_live_webhook_configurations(self):
+        """certs.reconcile_ca_bundles patches the caBundle of the deployed
+        (Mutating|Validating)WebhookConfiguration objects over raw API
+        paths, skipping absent ones and avoiding no-op writes."""
+        from karpenter_tpu.runtime.kubecore import NotFound as KNotFound
+        from karpenter_tpu.webhooks.certs import (
+            MUTATING_PATH, VALIDATING_PATH, reconcile_ca_bundles,
+        )
+
+        store = {
+            MUTATING_PATH + "defaulting.webhook.karpenter.sh": {
+                "metadata": {"name": "defaulting.webhook.karpenter.sh"},
+                "webhooks": [{"name": "defaulting.webhook.karpenter.sh",
+                              "clientConfig": {"service": {"name": "w"}}}],
+            },
+        }
+        puts = []
+
+        class RawClient:
+            def get_raw(self, path):
+                if path not in store:
+                    raise KNotFound(path)
+                return json.loads(json.dumps(store[path]))
+
+            def put_raw(self, path, body):
+                puts.append(path)
+                store[path] = body
+                return body
+
+        ca = generate_ca()
+        n = reconcile_ca_bundles(RawClient(), ca.cert_pem)
+        assert n == 1  # validating config not applied yet → skipped
+        stamped = store[MUTATING_PATH + "defaulting.webhook.karpenter.sh"]
+        assert base64.b64decode(
+            stamped["webhooks"][0]["clientConfig"]["caBundle"]) == ca.cert_pem
+        # idempotent: second run sees the bundle already present, no PUT
+        puts.clear()
+        assert reconcile_ca_bundles(RawClient(), ca.cert_pem) == 1
+        assert puts == []
