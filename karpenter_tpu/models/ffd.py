@@ -184,8 +184,12 @@ def solve_ffd_numpy(
             continue
         chosen = int(np.argmax(npacked == max_pods))
         packedv = k_all[:, chosen]
-        terms = np.where(packedv > 0, (counts - maxfit) // np.maximum(packedv, 1), _INT32_MAX)
-        q = int(1 + max(0, terms.min()))
+        # fast-forward validity (see ops/pack.py + docs/solver.md): every
+        # packed shape must stay STRICTLY above maxfit through all repeats
+        terms = np.where(packedv > 0,
+                         (counts - maxfit - 1) // np.maximum(packedv, 1),
+                         _INT32_MAX)
+        q = int(max(1, 1 + terms.min()))
         counts = counts - q * packedv
         records.append((chosen, q, packedv))
     return _decode(enc, records, dropped, packables, max_instance_types)

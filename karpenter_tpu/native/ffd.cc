@@ -49,7 +49,7 @@ int64_t kt_ffd_pack(
   std::vector<int64_t> dropped(S, 0);
 
   // maxfit[s]: most pods of shape s any EMPTY instance fits — the
-  // fast-forward divisor (models/ffd.py maxfit).
+  // fast-forward validity bound (docs/solver.md).
   std::vector<int64_t> maxfit(S, 0);
   for (int64_t s = 0; s < S; ++s) {
     int64_t best = 0;
@@ -67,6 +67,7 @@ int64_t kt_ffd_pack(
     }
     maxfit[s] = best;
   }
+
 
   std::vector<int64_t> reserved(T * R);
   std::vector<char> stopped(T);
@@ -139,22 +140,25 @@ int64_t kt_ffd_pack(
     int64_t chosen = 0;
     while (npacked[chosen] != max_pods) ++chosen;
 
-    // fast-forward: emit q identical nodes at once. q is chosen so no shape
-    // drops below its maxfit watermark before the next re-plan (the point
-    // where a different instance type could start winning).
+    // fast-forward: emit q identical nodes at once. Validity (ops/pack.py,
+    // proof in docs/solver.md): every packed shape must stay STRICTLY
+    // above maxfit through all repeated rounds — that keeps every type's
+    // clip inactive (so all simulated fills and the tie-break repeat) and
+    // every failure flag strict, which is what arms the is_full_for early
+    // exit. The final round where equality would be reached runs live.
     int64_t min_terms = kInf;
     for (int64_t s = 0; s < S; ++s) {
       const int64_t kv = k_all[s * T + chosen];
       if (kv > 0) {
-        const int64_t diff = counts[s] - maxfit[s];
-        // floor division to match numpy (sign differences wash out under
-        // the max(0, .) below, but keep it exact anyway)
+        const int64_t diff = counts[s] - maxfit[s] - 1;
+        // floor division to match numpy
         int64_t q = diff / kv;
         if (diff % kv != 0 && ((diff < 0) != (kv < 0))) --q;
         if (q < min_terms) min_terms = q;
       }
     }
-    const int64_t q = 1 + (min_terms > 0 ? min_terms : 0);
+    int64_t q = 1 + min_terms;
+    if (q < 1) q = 1;
     if (n_records >= max_records) return -1;
     out_chosen[n_records] = chosen;
     out_qty[n_records] = q;

@@ -7,10 +7,11 @@ XLA program whose sequential axis is *distinct packing decisions*, not pods:
 - inner ``lax.scan`` over unique pod shapes (S ≈ dozens), each step a
   vectorized fit over ALL instance types at once (T×R int32 math on the VPU);
 - outer ``lax.scan`` over node-packing iterations, with an exact
-  *fast-forward*: when the remaining shape counts dominate every type's
-  capacity-bound fit, the same packing provably repeats, so q identical
-  nodes are committed in one step (the device analog of the reference's
-  dedupe-by-hash NodeQuantity++, packer.go:130-139).
+  *fast-forward*: while every consumed shape's remaining count stays
+  strictly above its maxfit bound, the whole round provably repeats, so q
+  identical nodes are committed in one step (the device analog of the
+  reference's dedupe-by-hash NodeQuantity++, packer.go:130-139). The
+  validity condition is derived in docs/solver.md.
 
 Semantics preserved per quirk list in solver/host_ffd.py; differential tests
 in tests/test_pack_parity.py enforce exact node-count equality.
@@ -51,9 +52,9 @@ def pack_chunk(
     pods_one = jnp.zeros((R,), jnp.int32).at[R_PODS].set(pods_unit)
 
     # Upper bound on any type's capacity fit per shape, from the initial
-    # reservation (reserved only grows during a node pack). Used by the
-    # fast-forward validity condition: count_s >= maxfit_s ⇒ every type is
-    # capacity-bound for shape s ⇒ the greedy outcome can't depend on count.
+    # reservation (reserved only grows during a node pack). Fast-forward
+    # validity needs counts to stay STRICTLY above this on every repeated
+    # round — see the derivation in docs/solver.md.
     avail0 = totals - reserved0  # (T, R)
     kr0 = jnp.where(shapes[:, None, :] > 0,
                     avail0[None, :, :] // jnp.maximum(shapes[:, None, :], 1),
@@ -98,10 +99,20 @@ def pack_chunk(
         packedv = k_all[:, chosen]                           # (S,)
         nothing = max_pods == 0
 
-        # exact fast-forward: q identical nodes in one iteration
+        # Exact fast-forward: q identical nodes in one iteration. Validity
+        # (proof in docs/solver.md): a round repeats identically iff every
+        # shape it consumes stays STRICTLY above maxfit on every repeated
+        # round — count' > maxfit ≥ kr keeps every type's clip inactive
+        # (so all T simulated fills, max_pods and the tie-break repeat) AND
+        # every failure flag strict (k < count'), which is what arms the Go
+        # packer's is_full_for early exit. Consuming down TO maxfit (the
+        # old ≥-bound) flips a failure flag at equality: the real packer
+        # then keeps filling that node with smaller shapes instead of
+        # stopping. Hence count - (q-1)·pv ≥ maxfit+1 per packed shape.
         terms = jnp.where(packedv > 0,
-                          (counts - maxfit) // jnp.maximum(packedv, 1), INT32_MAX)
-        q = 1 + jnp.maximum(0, jnp.min(terms))
+                          (counts - maxfit - 1) // jnp.maximum(packedv, 1),
+                          INT32_MAX)
+        q = jnp.maximum(1, 1 + jnp.min(terms))
         q = jnp.where(nothing | done, 0, q)
 
         # drop path: largest remaining shape fits nowhere (packer.go:124-128);

@@ -84,7 +84,7 @@ def _pack_kernel(
     avail0 = totals_t[:] - reserved0_t[:]          # (R, T)
 
     # maxfit_s = max over valid types of the capacity-bound fit from the
-    # initial reservation (the fast-forward validity bound, ops/pack.py)
+    # initial reservation (fast-forward validity bound — docs/solver.md)
     def maxfit_body(s, _):
         shape_col = lane_col(shapes_t[:], iota_s, s)   # (R, 1)
         kr = jnp.where(shape_col > 0,
@@ -179,11 +179,12 @@ def _pack_kernel(
             (resv0_col, jnp.int32(0), jnp.int32(0)))
 
         packed = packedv_s[:]                                 # (1, S)
-        # exact fast-forward (ops/pack.py): q identical nodes at once
+        # exact fast-forward (ops/pack.py, proof in docs/solver.md): every
+        # packed shape must stay STRICTLY above maxfit through all repeats
         terms = jnp.where(packed > 0,
-                          (counts - maxfit[:]) // jnp.maximum(packed, 1),
+                          (counts - maxfit[:] - 1) // jnp.maximum(packed, 1),
                           INT32_MAX)
-        q = 1 + jnp.maximum(0, jnp.min(terms))
+        q = jnp.maximum(1, 1 + jnp.min(terms))
         q = jnp.where(nothing, 0, q)
 
         # drop path: the largest remaining shape fits nowhere

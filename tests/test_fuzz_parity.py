@@ -1,0 +1,225 @@
+"""Randomized differential fuzz of the executor quartet (SURVEY.md §5.2).
+
+Every case runs the SAME problem through:
+  1. host_ffd.pack        — per-pod Go-parity oracle (ground truth)
+  2. solve_ffd_numpy      — shape-level numpy mirror of the device kernel
+  3. solve_ffd_native     — C++ kernel via ctypes
+  4. solve_ffd_device     — XLA scan kernel
+  5. pack via pallas interpret (subset of cases; Mosaic needs real TPU)
+and asserts node counts, per-node shape multisets, instance-option
+multisets, and unschedulable sets all agree.
+
+Quantities mix realistic values with ADVERSARIAL ones chosen to sit at the
+encode boundary (ops/encode.py): prime nano values force the per-resource
+GCD to 1 so totals overflow int32 and encode() must return None — those
+cases verify the fallback ring still answers exactly (solve() ≡ oracle)
+instead of silently masking a device bug. The observed encode-fallback
+rate is printed and bounded.
+
+Case count scales with KARPENTER_FUZZ_CASES (default 150; crank for a
+soak run).
+"""
+
+import os
+import random
+from collections import Counter
+
+import pytest
+
+from karpenter_tpu.api.core import Container, Pod, PodSpec, ResourceRequirements
+from karpenter_tpu.cloudprovider.fake.provider import make_instance_type
+from karpenter_tpu.cloudprovider.spi import Offering
+from karpenter_tpu.controllers.provisioning import universe_constraints
+from karpenter_tpu.models.ffd import solve_ffd_device, solve_ffd_numpy
+from karpenter_tpu.ops.encode import encode
+from karpenter_tpu.solver import host_ffd
+from karpenter_tpu.solver.adapter import build_packables, pod_vector
+from karpenter_tpu.solver.native_ffd import solve_ffd_native
+from karpenter_tpu.solver.solve import SolverConfig, solve
+
+N_CASES = int(os.environ.get("KARPENTER_FUZZ_CASES", "150"))
+PALLAS_EVERY = 25          # pallas interpret is debug-speed; sample cases
+
+REALISTIC_CPU = ["50m", "100m", "250m", "500m", "1", "1500m", "2", "4"]
+REALISTIC_MEM = ["64Mi", "128Mi", "256Mi", "512Mi", "1Gi", "3Gi", "8Gi"]
+# encode-boundary adversaries: primes in nano units (GCD collapses to 1 →
+# instance totals no longer fit int32 → encode returns None), giant and
+# sub-milli values, decimal strings with awkward factorizations
+BOUNDARY_CPU = ["123456789n", "333m", "0.333", "7n", "1000000007n", "3"]
+BOUNDARY_MEM = ["1000000001", "1.5Gi", "333Mi", "8Ti", "999999937", "3Mi"]
+
+
+def _make_pod(requests):
+    return Pod(spec=PodSpec(containers=[
+        Container(resources=ResourceRequirements.make(requests=requests))]))
+
+
+def _random_catalog(rng):
+    """cpu and memory are drawn INDEPENDENTLY: heterogeneous cpu:mem ratios
+    (a cpu-rich and a mem-rich type in one catalog) are exactly what makes
+    different instance types win different shapes mid-pack — the regime
+    where the fast-forward validity condition earns its keep. Correlated
+    catalogs (mem = cpu × ratio) structurally cannot exercise it."""
+    n = rng.randint(1, 24)
+    catalog = []
+    for i in range(n):
+        cpu = rng.choice([1, 2, 4, 8, 16, 21, 32, 35, 64, 96])
+        mem = rng.choice([2, 5, 11, 16, 29, 36, 64, 128, 384])
+        kwargs = {}
+        if rng.random() < 0.15:
+            kwargs["nvidia_gpus"] = str(rng.choice([1, 4, 8]))
+        if rng.random() < 0.08:
+            kwargs["aws_neurons"] = str(rng.choice([1, 4]))
+        catalog.append(make_instance_type(
+            f"fz-{i}-{cpu}c{mem}g", cpu=str(cpu), memory=f"{mem}Gi",
+            pods=str(rng.choice([8, 29, 58, 110, 234])),
+            offerings=[Offering(ct, z)
+                       for ct in ("on-demand", "spot")
+                       for z in ("fz-zone-a", "fz-zone-b")],
+            price=round(rng.uniform(0.01, 3.0), 2), **kwargs))
+    return catalog
+
+
+def _random_pods(rng):
+    kinds = rng.randint(1, 10)
+    boundary_case = rng.random() < 0.35
+    shapes = []
+    for _ in range(kinds):
+        cpu_pool = BOUNDARY_CPU if (boundary_case and rng.random() < 0.5) \
+            else REALISTIC_CPU
+        mem_pool = BOUNDARY_MEM if (boundary_case and rng.random() < 0.5) \
+            else REALISTIC_MEM
+        shape = {"cpu": rng.choice(cpu_pool), "memory": rng.choice(mem_pool)}
+        if rng.random() < 0.12:
+            shape["nvidia.com/gpu"] = str(rng.randint(1, 4))
+        if rng.random() < 0.05:
+            shape["example.com/exotic"] = "1"
+        shapes.append(shape)
+    return [_make_pod(dict(rng.choice(shapes)))
+            for _ in range(rng.randint(1, 250))]
+
+
+def _random_daemons(rng):
+    if rng.random() < 0.6:
+        return []
+    return [_make_pod({"cpu": rng.choice(["50m", "100m", "333m"]),
+                       "memory": rng.choice(["32Mi", "100Mi"])})
+            for _ in range(rng.randint(1, 3))]
+
+
+def _node_shape_multiset(result, vec_of):
+    """Multiset of per-node pod-shape multisets — the strongest structural
+    signature that is invariant to pod-id permutation within a shape."""
+    nodes = []
+    for p in result.packings:
+        for node in p.pod_ids:
+            nodes.append(tuple(sorted(vec_of[i] for i in node)))
+    return Counter(nodes)
+
+
+def _signature(result, vec_of):
+    return (
+        result.node_count,
+        sorted((tuple(p.instance_type_indices), p.node_quantity)
+               for p in result.packings),
+        sorted(result.unschedulable),
+        _node_shape_multiset(result, vec_of),
+    )
+
+
+class TestExecutorQuartetFuzz:
+    def test_fuzz_differential(self):
+        rng = random.Random(20260729)
+        encode_fallbacks = 0
+        compared = 0
+        pallas_checked = 0
+        for case in range(N_CASES):
+            catalog = _random_catalog(rng)
+            pods = _random_pods(rng)
+            daemons = _random_daemons(rng)
+            constraints = universe_constraints(catalog)
+            packables, sorted_types = build_packables(
+                catalog, constraints, pods, daemons)
+            vecs = [pod_vector(p) for p in pods]
+            ids = list(range(len(pods)))
+
+            oracle = host_ffd.pack(vecs, ids, packables)
+            ctx = f"case={case} pods={len(pods)} types={len(catalog)}"
+
+            enc = encode(vecs, ids, packables) if packables else None
+            if enc is None:
+                encode_fallbacks += 1
+                # the fallback ring must still answer, exactly
+                full = solve(constraints, pods, catalog, daemons,
+                             config=SolverConfig(device_min_pods=0))
+                assert full.node_count == oracle.node_count, ctx
+                assert len(full.unschedulable) == len(oracle.unschedulable), ctx
+                continue
+
+            oracle_sig = _signature(oracle, vecs)
+            for name, result in (
+                ("numpy", solve_ffd_numpy(vecs, ids, packables)),
+                ("native", solve_ffd_native(vecs, ids, packables)),
+                ("xla", solve_ffd_device(vecs, ids, packables, kernel="xla")),
+            ):
+                assert result is not None, f"{ctx}: {name} returned None"
+                assert _signature(result, vecs) == oracle_sig, f"{ctx}: {name}"
+            compared += 1
+
+            if pallas_checked < compared // PALLAS_EVERY + 3 and len(pods) <= 80:
+                result = solve_ffd_device(vecs, ids, packables, kernel="pallas")
+                assert result is not None, f"{ctx}: pallas returned None"
+                assert _signature(result, vecs) == oracle_sig, f"{ctx}: pallas"
+                pallas_checked += 1
+
+        rate = encode_fallbacks / N_CASES
+        print(f"\nfuzz summary: {N_CASES} cases, {compared} quartet-compared, "
+              f"{pallas_checked} pallas-checked, "
+              f"encode-fallback rate {rate:.1%}")
+        # the adversarial mix is tuned to exercise BOTH paths: most cases
+        # must reach the device executors, and the boundary cases must
+        # actually trigger fallbacks (else they test nothing)
+        assert compared >= N_CASES * 0.5, "fuzz mix stopped reaching the device path"
+        assert encode_fallbacks >= N_CASES * 0.05, (
+            "boundary quantities no longer trigger encode fallback — "
+            "adversarial pools need retuning")
+        assert pallas_checked >= 3
+
+
+class TestEncodeBoundaryPinned:
+    """Deterministic pins of the encode boundary (not left to randomness)."""
+
+    def test_prime_nano_cpu_falls_back(self):
+        catalog = [make_instance_type("t", cpu="96", memory="384Gi", pods="110")]
+        pods = [_make_pod({"cpu": "1000000007n", "memory": "128Mi"})]
+        constraints = universe_constraints(catalog)
+        packables, _ = build_packables(catalog, constraints, pods, [])
+        assert encode([pod_vector(p) for p in pods], [0], packables) is None
+        # and the public path still answers via the oracle
+        res = solve(constraints, pods, catalog,
+                    config=SolverConfig(device_min_pods=0))
+        assert res.node_count == 1
+
+    def test_gcd_aligned_values_encode(self):
+        catalog = [make_instance_type("t", cpu="4", memory="16Gi", pods="110")]
+        pods = [_make_pod({"cpu": "250m", "memory": "512Mi"})] * 3
+        constraints = universe_constraints(catalog)
+        packables, _ = build_packables(catalog, constraints, pods, [])
+        enc = encode([pod_vector(p) for p in pods], [0, 1, 2], packables)
+        assert enc is not None
+        assert enc.num_shapes == 1 and enc.counts[0] == 3
+
+    def test_int32_limit_edge_encodes(self):
+        """Values that land exactly AT the int32 limit after GCD scaling
+        must encode; one unit over must not."""
+        import numpy as np
+
+        from karpenter_tpu.ops.encode import INT32_LIMIT, _gcd_scale
+
+        at_limit = _gcd_scale([[INT32_LIMIT, 1]])
+        assert at_limit == (1,)
+        over = _gcd_scale([[INT32_LIMIT + 1, 1]])
+        assert over is None
+        # scaled-to-limit: gcd 2 divides both, max value scales to exactly limit
+        scaled = _gcd_scale([[2 * INT32_LIMIT, 2]])
+        assert scaled == (2,)
