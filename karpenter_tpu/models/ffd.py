@@ -35,6 +35,18 @@ def device_args(enc: EncodedProblem):
     )
 
 
+def encode_prices(prices, padded_t: int) -> np.ndarray:
+    """Effective $/h per packable → (T_padded,) int32 micro-$ for the
+    kernel's cost tie-break. Only the ORDERING matters on device; inf
+    (no viable offering) and the padding both map to int32 max so they
+    never win a tie."""
+    out = np.full((padded_t,), _INT32_MAX, np.int32)
+    for i, p in enumerate(prices):
+        if p != float("inf"):
+            out[i] = min(int(p * 1e6), _INT32_MAX)
+    return out
+
+
 def default_kernel() -> str:
     """Pallas on real TPU (fused VMEM state + early exit, ~4× less device
     time than the XLA scan); the XLA kernel elsewhere — pallas interpret
@@ -60,10 +72,16 @@ def solve_ffd_device(
     max_instance_types: int = MAX_INSTANCE_TYPES,
     chunk_iters: int = DEFAULT_CHUNK_ITERS,
     kernel: Optional[str] = None,   # "xla" | "pallas" | None = auto
+    prices: Optional[Sequence[float]] = None,  # per-packable effective $/h
+    cost_tiebreak: bool = False,
 ) -> Optional[HostSolveResult]:
     """Solve on device; None when the problem is not device-encodable
     (caller falls back to the host oracle). Pods may arrive unsorted; the
-    same descending total order as the host oracle is applied here."""
+    same descending total order as the host oracle is applied here.
+
+    ``cost_tiebreak`` picks the cheapest max-pods type per node (capacity
+    order on price ties); currently served by the XLA kernel — a pallas
+    request silently routes there in this mode."""
     import jax
 
     from karpenter_tpu.ops.pack import pack_chunk_flat, unpack_flat
@@ -80,7 +98,8 @@ def solve_ffd_device(
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown device kernel {kernel!r}: "
                          "expected None, 'xla' or 'pallas'")
-    if kernel == "pallas":
+    use_cost = cost_tiebreak and prices is not None
+    if kernel == "pallas" and not use_cost:
         import functools
 
         from karpenter_tpu.ops.pack_pallas import pack_chunk_pallas_flat
@@ -90,7 +109,14 @@ def solve_ffd_device(
             pack_chunk_pallas_flat,
             interpret=jax.default_backend() != "tpu")
     else:
+        import functools
+
         _chunk = pack_chunk_flat
+        if use_cost:
+            prices_dev = jax.device_put(
+                encode_prices(prices, enc.totals.shape[0]))
+            _chunk = functools.partial(pack_chunk_flat, prices=prices_dev,
+                                       cost_tiebreak=True)
 
     S, L = enc.shapes.shape[0], chunk_iters
     # one host→device transfer for the whole problem (tunnel-latency bound)
@@ -123,6 +149,8 @@ def solve_ffd_numpy(
     pod_ids: Sequence[int],
     packables: Sequence[Packable],
     max_instance_types: int = MAX_INSTANCE_TYPES,
+    prices: Optional[Sequence[float]] = None,
+    cost_tiebreak: bool = False,
 ) -> Optional[HostSolveResult]:
     """Numpy mirror of the device kernel (ops/pack.py), shape-level greedy
     with the same fast-forward. Fast enough for 50k-pod parity checks where
@@ -182,7 +210,13 @@ def solve_ffd_numpy(
             dropped[largest] += counts[largest]
             counts[largest] = 0
             continue
-        chosen = int(np.argmax(npacked == max_pods))
+        tie = npacked == max_pods
+        if cost_tiebreak and prices is not None:
+            p_arr = encode_prices(prices, T).astype(np.int64)
+            best_price = p_arr[tie].min()
+            chosen = int(np.argmax(tie & (p_arr == best_price)))
+        else:
+            chosen = int(np.argmax(tie))
         packedv = k_all[:, chosen]
         # fast-forward validity (see ops/pack.py + docs/solver.md): every
         # packed shape must stay STRICTLY above maxfit through all repeats

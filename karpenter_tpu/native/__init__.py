@@ -49,6 +49,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64, ctypes.c_int64,              # pods_unit, r_pods
         i64p, i64p, i64p, i64p,                      # out chosen/qty/packed/dropped
         ctypes.c_int64,                              # max_records
+        i64p, ctypes.c_int64,                        # prices (nullable), cost_tiebreak
     ]
     return lib
 

@@ -39,12 +39,16 @@ extern "C" {
 //   out_qty     (max_records,)     identical nodes for this record
 //   out_packed  (max_records, S)   pods-per-shape on each such node
 //   out_dropped (S,)               unpackable pods per shape
+//   prices    (T,) effective micro-$/h per type, or nullptr; with
+//             cost_tiebreak != 0 the cheapest max-pods type wins the tie
+//             (capacity order on price ties) — beyond-reference cost mode.
 int64_t kt_ffd_pack(
     const int64_t* shapes, const int64_t* counts_in,
     const int64_t* totals, const int64_t* reserved0,
     int64_t S, int64_t T, int64_t R, int64_t pods_unit, int64_t r_pods,
     int64_t* out_chosen, int64_t* out_qty, int64_t* out_packed,
-    int64_t* out_dropped, int64_t max_records) {
+    int64_t* out_dropped, int64_t max_records,
+    const int64_t* prices, int64_t cost_tiebreak) {
   std::vector<int64_t> counts(counts_in, counts_in + S);
   std::vector<int64_t> dropped(S, 0);
 
@@ -139,6 +143,11 @@ int64_t kt_ffd_pack(
     }
     int64_t chosen = 0;
     while (npacked[chosen] != max_pods) ++chosen;
+    if (cost_tiebreak && prices != nullptr) {
+      for (int64_t t = chosen + 1; t < T; ++t) {
+        if (npacked[t] == max_pods && prices[t] < prices[chosen]) chosen = t;
+      }
+    }
 
     // fast-forward: emit q identical nodes at once. Validity (ops/pack.py,
     // proof in docs/solver.md): every packed shape must stay STRICTLY

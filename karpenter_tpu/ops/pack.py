@@ -32,7 +32,7 @@ from karpenter_tpu.solver.host_ffd import R_PODS
 INT32_MAX = jnp.iinfo(jnp.int32).max
 
 
-@functools.partial(jax.jit, static_argnames=("num_iters",))
+@functools.partial(jax.jit, static_argnames=("num_iters", "cost_tiebreak"))
 def pack_chunk(
     shapes: jax.Array,     # (S, R) int32, descending, reserve semantics
     counts: jax.Array,     # (S,) int32 remaining pods per shape
@@ -43,10 +43,17 @@ def pack_chunk(
     last_valid: jax.Array,  # () int32 index of largest viable type
     pods_unit: jax.Array,  # () int32 one pod in device units
     num_iters: int,
+    prices: jax.Array = None,      # (T,) int32 effective micro-$/h, optional
+    cost_tiebreak: bool = False,
 ):
     """Run up to ``num_iters`` node-packing iterations; host loops chunks
     until ``done``. Returns (counts, dropped, done, chosen[L], qty[L],
-    packed[L,S])."""
+    packed[L,S]).
+
+    ``cost_tiebreak``: when several types achieve max-pods, pick the one
+    with the lowest effective price (capacity order breaks price ties)
+    instead of Go's smallest-capacity-first. Parity mode (default) ignores
+    ``prices`` entirely — Go semantics bit-for-bit."""
     S, R = shapes.shape
     T = totals.shape[0]
     pods_one = jnp.zeros((R,), jnp.int32).at[R_PODS].set(pods_unit)
@@ -95,7 +102,15 @@ def pack_chunk(
         # k_all: (S, T) pods of each shape packed per candidate type
 
         max_pods = npacked[last_valid]
-        chosen = jnp.argmax(valid & (npacked == max_pods))   # first (smallest) type
+        tie = valid & (npacked == max_pods)
+        if cost_tiebreak and prices is not None:
+            # cheapest type among the max-pods ties; capacity order (first
+            # index) breaks price ties — beyond-reference capability, the
+            # device analog of models/cost.order_options_by_price
+            best_price = jnp.min(jnp.where(tie, prices, INT32_MAX))
+            chosen = jnp.argmax(tie & (prices == best_price))
+        else:
+            chosen = jnp.argmax(tie)                         # first (smallest) type
         packedv = k_all[:, chosen]                           # (S,)
         nothing = max_pods == 0
 
@@ -131,10 +146,10 @@ def pack_chunk(
     return counts_f, dropped_f, done_f, chosen_seq, q_seq, packed_seq
 
 
-@functools.partial(jax.jit, static_argnames=("num_iters",))
+@functools.partial(jax.jit, static_argnames=("num_iters", "cost_tiebreak"))
 def pack_chunk_flat(
     shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
-    num_iters: int,
+    num_iters: int, prices=None, cost_tiebreak: bool = False,
 ):
     """pack_chunk with all outputs flattened into ONE int32 buffer so a solve
     costs exactly one device→host fetch. The TPU here sits behind a tunnel
@@ -143,7 +158,8 @@ def pack_chunk_flat(
     q L | packed L*S]."""
     return flatten_chunk_outputs(*pack_chunk(
         shapes, counts, dropped, totals, reserved0, valid, last_valid,
-        pods_unit, num_iters=num_iters))
+        pods_unit, num_iters=num_iters, prices=prices,
+        cost_tiebreak=cost_tiebreak))
 
 
 def flatten_chunk_outputs(counts_f, dropped_f, done_f, chosen_seq, q_seq,

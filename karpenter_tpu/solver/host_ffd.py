@@ -153,10 +153,16 @@ def pack(
     pod_ids: Sequence[int],
     packables: Sequence[Packable],
     max_instance_types: int = MAX_INSTANCE_TYPES,
+    prices: Optional[Sequence[float]] = None,   # per-packable effective $/h
+    cost_tiebreak: bool = False,
 ) -> HostSolveResult:
     """Full FFD loop (packer.go:109-141). ``packables`` must already be
     viable (validators + overhead + daemons applied) and sorted ascending
     (packable.go:74-89); pods must be sorted descending by (cpu, mem).
+
+    ``cost_tiebreak`` (beyond-reference): among types achieving max pods,
+    choose the cheapest (capacity order breaks price ties) instead of Go's
+    first-smallest. Default preserves Go semantics exactly.
     """
     order = sorted(range(len(pod_ids)), key=lambda i: tuple(-v for v in pod_vecs[i]))
     vecs = [pod_vecs[i] for i in order]
@@ -170,7 +176,9 @@ def pack(
         if not packables:
             unschedulable.extend(ids)
             break
-        packing, vecs, ids = _pack_with_largest_pod(vecs, ids, packables, max_instance_types)
+        packing, vecs, ids = _pack_with_largest_pod(
+            vecs, ids, packables, max_instance_types,
+            prices=prices if cost_tiebreak else None)
         if not packing.pod_ids[0]:
             # nothing fit anywhere: drop the largest pod (packer.go:124-128)
             unschedulable.append(ids[0])
@@ -188,25 +196,34 @@ def pack(
 
 
 def _pack_with_largest_pod(
-    vecs: List[Vec], ids: List[int], packables: Sequence[Packable], max_instance_types: int
+    vecs: List[Vec], ids: List[int], packables: Sequence[Packable],
+    max_instance_types: int, prices: Optional[Sequence[float]] = None,
 ) -> Tuple[HostPacking, List[Vec], List[int]]:
-    """packer.go:167-198."""
+    """packer.go:167-198. With ``prices``, the cheapest max-achieving type
+    wins instead of the first (cost tie-break mode)."""
     max_pods_packed = len(pack_one(packables[-1].copy(), vecs, ids).packed)
     if max_pods_packed == 0:
         return HostPacking(pod_ids=[[]], instance_type_indices=[]), vecs, ids
 
+    best: Optional[Tuple[int, PackResult]] = None
     for i, packable in enumerate(packables):
         result = pack_one(packable.copy(), vecs, ids)
-        if len(result.packed) == max_pods_packed:
-            options = instance_options(packables, i, max_instance_types)
-            packed_set = set(result.packed)
-            rem = [(v, pid) for v, pid in zip(vecs, ids) if pid not in packed_set]
-            new_vecs = [v for v, _ in rem]
-            new_ids = [pid for _, pid in rem]
-            return (
-                HostPacking(pod_ids=[result.packed], instance_type_indices=options),
-                new_vecs,
-                new_ids,
-            )
+        if len(result.packed) != max_pods_packed:
+            continue
+        if prices is None:
+            best = (i, result)
+            break  # Go semantics: first (smallest) achieving type
+        if best is None or prices[i] < prices[best[0]]:
+            best = (i, result)
+    if best is not None:
+        i, result = best
+        options = instance_options(packables, i, max_instance_types)
+        packed_set = set(result.packed)
+        rem = [(v, pid) for v, pid in zip(vecs, ids) if pid not in packed_set]
+        return (
+            HostPacking(pod_ids=[result.packed], instance_type_indices=options),
+            [v for v, _ in rem],
+            [pid for _, pid in rem],
+        )
     # unreachable if packables[-1] achieved max_pods_packed, kept for safety
     return HostPacking(pod_ids=[[]], instance_type_indices=[]), vecs, ids

@@ -29,6 +29,8 @@ def solve_ffd_native(
     pod_ids: Sequence[int],
     packables: Sequence[Packable],
     max_instance_types: int = MAX_INSTANCE_TYPES,
+    prices=None,                 # per-packable effective $/h (cost mode)
+    cost_tiebreak: bool = False,
 ) -> Optional[HostSolveResult]:
     """None when the native library or an exact encoding is unavailable."""
     lib = native.load()
@@ -57,11 +59,19 @@ def solve_ffd_native(
     def ptr(a):
         return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
 
+    if cost_tiebreak and prices is not None:
+        from karpenter_tpu.models.ffd import encode_prices
+
+        prices_arr = np.ascontiguousarray(encode_prices(prices, T), np.int64)
+        prices_ptr, cost_flag = ptr(prices_arr), 1
+    else:
+        prices_ptr, cost_flag = None, 0
+
     n = lib.kt_ffd_pack(
         ptr(shapes), ptr(counts), ptr(totals), ptr(reserved0),
         S, T, shapes.shape[1], int(enc.pods_unit), R_PODS,
         ptr(out_chosen), ptr(out_qty), ptr(out_packed), ptr(out_dropped),
-        max_records)
+        max_records, prices_ptr, cost_flag)
     if n < 0:
         return None  # record buffer overflow — fall back
 

@@ -15,7 +15,9 @@ from typing import List, Optional, Sequence
 from karpenter_tpu.api.constraints import Constraints
 from karpenter_tpu.api.core import Pod
 from karpenter_tpu.cloudprovider.spi import InstanceType
-from karpenter_tpu.models.cost import CostConfig, order_options_by_price
+from karpenter_tpu.models.cost import (
+    CostConfig, effective_price, order_options_by_price,
+)
 from karpenter_tpu.models.ffd import solve_ffd_device
 from karpenter_tpu.solver import host_ffd
 from karpenter_tpu.solver.adapter import build_packables, pod_vector
@@ -41,6 +43,12 @@ class SolverConfig:
     # catalog carries prices (models/cost.py); capacity order otherwise
     cost_aware: bool = True
     cost_config: CostConfig = field(default_factory=CostConfig)
+    # IN-KERNEL cost tie-break (beyond-reference): when several types
+    # achieve max pods for a node, the solver picks the cheapest instead of
+    # Go's first-smallest. Changes which node SET is produced (not just the
+    # option ordering), so it is off by default — parity mode is the
+    # differential-test contract.
+    cost_tiebreak: bool = False
 
 
 @dataclass
@@ -95,6 +103,16 @@ def solve_with_packables(
 
     pod_ids = list(range(len(pods)))
 
+    # per-packable effective $/h for the in-kernel cost tie-break; the SAME
+    # vector feeds every executor so the fallback rings stay differential
+    prices = None
+    if config.cost_tiebreak and any(it.price for it in sorted_types):
+        prices = [
+            effective_price(sorted_types[p.index], constraints.requirements,
+                            config.cost_config)[0]
+            for p in packables
+        ]
+
     result = None
     if config.use_device and len(pods) >= config.device_min_pods:
         try:
@@ -103,7 +121,8 @@ def solve_with_packables(
                     pod_vecs, pod_ids, packables,
                     max_instance_types=config.max_instance_types,
                     chunk_iters=config.chunk_iters,
-                    kernel=config.device_kernel)
+                    kernel=config.device_kernel,
+                    prices=prices, cost_tiebreak=prices is not None)
         except Exception:  # device failure ring: never drop a provisioning loop
             log.exception("device solve failed; falling back to host FFD")
             result = None
@@ -113,13 +132,16 @@ def solve_with_packables(
         try:
             result = solve_ffd_native(
                 pod_vecs, pod_ids, packables,
-                max_instance_types=config.max_instance_types)
+                max_instance_types=config.max_instance_types,
+                prices=prices, cost_tiebreak=prices is not None)
         except Exception:  # same failure posture as the device ring
             log.exception("native solve failed; falling back to host FFD")
             result = None
     if result is None:
         result = host_ffd.pack(pod_vecs, pod_ids, packables,
-                               max_instance_types=config.max_instance_types)
+                               max_instance_types=config.max_instance_types,
+                               prices=prices,
+                               cost_tiebreak=prices is not None)
 
     return materialize(result, pods, sorted_types, constraints, config)
 
