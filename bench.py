@@ -1,10 +1,16 @@
 """Benchmark: the five BASELINE.json configs.
 
-Headline (the one JSON line): p99 end-to-end solve latency for config 4 —
-50k mixed pods × 400 instance types (host marshal + encode + device pack +
-decode). Target (BASELINE.md): < 200 ms p99 on TPU v5e-4, node count within
-±1 of the reference Go FFD packer — we assert EXACT node parity against the
-host oracle, which implements the Go packer's semantics verbatim.
+Headline (the one JSON line): p99 latency of the PUBLIC ``solve()`` path for
+config 4 — Pod objects in → node set out: marshal (cached vector gather —
+vectors are computed once per pod at watch/codec ingest, solver/adapter.py),
+packables (memoized per catalog/constraints), encode, device pack, decode,
+materialize. The one-time ingest marshal cost for all 50k pods is reported
+separately (``ingest_marshal_ms``) — in production it is paid per watch
+event, off the solve path. Target (BASELINE.md): < 200 ms p99 on TPU v5e-4,
+node count within ±1 of the reference Go FFD packer — we assert EXACT node
+parity against the C++ per-pod oracle (native/ffd.cc), which implements the
+Go packer's semantics verbatim and is itself differentially tested against
+the Python per-pod oracle and both device kernels.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": p99_ms, "unit": "ms", "vs_baseline": 200/p99_ms,
@@ -127,28 +133,24 @@ MIXED_SHAPES = [
 ]
 
 
-def bench_pack(pods, catalog, parity=True):
-    """Time solve_ffd_device end-to-end; assert exact node parity vs the
-    shape-level host oracle (Go packer semantics; itself differentially
-    tested against the per-pod oracle in tests/)."""
-    from karpenter_tpu.controllers.provisioning import universe_constraints
-    from karpenter_tpu.models.ffd import solve_ffd_device, solve_ffd_numpy
-    from karpenter_tpu.solver.adapter import build_packables, pod_vector
+def oracle_node_count(constraints, pods, catalog, daemons=()):
+    """Per-POD Go-semantics node count from the C++ oracle
+    (native/ffd.cc kt_ffd_pack_per_pod — packer.go:109-141 transcribed, no
+    fast-forward, one record per node) — every config's forward solve
+    asserts parity against this. Falls back through the executor rings if
+    the native toolchain is unavailable."""
+    from karpenter_tpu.models.ffd import solve_ffd_numpy
+    from karpenter_tpu.solver.adapter import build_packables_cached, pod_vectors
+    from karpenter_tpu.solver.native_ffd import solve_ffd_per_pod_native
 
-    constraints = universe_constraints(catalog)
-    packables, _ = build_packables(catalog, constraints, pods, [])
-    vecs = [pod_vector(p) for p in pods]
-    ids = list(range(len(pods)))
-
-    device = solve_ffd_device(vecs, ids, packables)  # warm-up (compile)
-    assert device is not None, "bench workload must be device-encodable"
-    if parity:
-        host = solve_ffd_numpy(vecs, ids, packables)
-        assert device.node_count == host.node_count, (
-            f"node-count mismatch: device={device.node_count} host={host.node_count}")
-
-    times = run_timed(lambda: solve_ffd_device(vecs, ids, packables))
-    return times, device.node_count
+    packables, _ = build_packables_cached(catalog, constraints, pods, daemons)
+    vecs, ids = pod_vectors(pods), list(range(len(pods)))
+    result = solve_ffd_per_pod_native(vecs, ids, packables)
+    label = "exact (per-pod C++ oracle)"
+    if result is None:  # no C++ toolchain: shape-level numpy mirror instead
+        result = solve_ffd_numpy(vecs, ids, packables)
+        label = "exact (shape-level numpy fallback — no C++ toolchain)"
+    return result.node_count, label
 
 
 def config_1_smoke():
@@ -172,7 +174,7 @@ def config_1_smoke():
     return {"pods": 100, **st,
             "node_count": result.node_count,
             "pods_per_sec": round(100 / (st["p50_ms"] / 1000.0 or 1e-9)),
-            "node_parity_vs_go_ffd_oracle": "exact"}
+            "node_parity_vs_per_pod_go_oracle": "exact (python per-pod oracle)"}
 
 
 def config_2_constrained():
@@ -197,61 +199,50 @@ def config_2_constrained():
     tightened.taints = constraints.taints
     result = solve(tightened, pods, catalog)  # warm-up
     assert not result.unschedulable
+    oracle, oracle_label = oracle_node_count(tightened, pods, catalog)
+    assert result.node_count == oracle, (
+        f"node-count mismatch: solve={result.node_count} per-pod-oracle={oracle}")
     times = run_timed(lambda: solve(tightened, pods, catalog))
     st = _stats(times)
     return {"pods": 5_000, **st,
             "node_count": result.node_count,
+            "node_parity_vs_per_pod_go_oracle": oracle_label,
             "pods_per_sec": round(5_000 / (st["p50_ms"] / 1000.0 or 1e-9))}
 
 
 def config_3_topology():
-    """20k pods spread over 3 zones → 3 per-zone schedules solved as one
-    sharded batch (parallel/sharded_pack.py) — the pods-axis scaling story."""
-    import numpy as np
-
-    import jax
-
+    """20k pods spread over 3 zones → 3 per-zone schedules solved through
+    the PUBLIC solve_batch() — marshal + encode + ONE sharded device call
+    (vmap within a chip, shard_map across the mesh, one flattened fetch) +
+    decode/materialize, exactly what the provisioning worker runs
+    (controllers/provisioning.py:127). Per-zone node parity asserted against
+    the per-pod C++ oracle."""
     from karpenter_tpu.controllers.provisioning import universe_constraints
-    from karpenter_tpu.ops.encode import encode
-    from karpenter_tpu.parallel.mesh import solver_mesh
-    from karpenter_tpu.parallel.sharded_pack import (
-        pack_batch_sharded_flat, pad_problems, unpack_batch_flat,
-    )
-    from karpenter_tpu.solver.adapter import build_packables, pod_vector
+    from karpenter_tpu.solver.batch_solve import Problem, solve_batch
 
     catalog = make_catalog(100)
     constraints = universe_constraints(catalog)
     pods = make_pods(20_000, MIXED_SHAPES)
-    packables, _ = build_packables(catalog, constraints, pods, [])
-
     # topology-spread: each zone domain receives len(pods)/3 (topology.go:112-140)
-    problems = []
-    for z in range(3):
-        zone_pods = pods[z::3]
-        vecs = [pod_vector(p) for p in zone_pods]
-        ids = list(range(len(zone_pods)))
-        order = sorted(range(len(ids)), key=lambda i: tuple(-v for v in vecs[i]))
-        enc = encode([vecs[i] for i in order], [ids[i] for i in order], packables)
-        assert enc is not None
-        problems.append(enc)
+    problems = [
+        Problem(constraints=constraints, pods=pods[z::3], instance_types=catalog)
+        for z in range(3)
+    ]
 
-    mesh = solver_mesh(jax.devices()[:1])
-    batch = pad_problems(problems, mesh.devices.size)
-    S, L = batch[0].shape[1], 64  # ~32 shapes/zone converge well under 64
+    results = solve_batch(problems)  # warm-up (compile)
+    node_count = 0
+    for prob, res in zip(problems, results):
+        assert not res.unschedulable
+        oracle, oracle_label = oracle_node_count(constraints, prob.pods, catalog)
+        assert res.node_count == oracle, (
+            f"node-count mismatch: solve={res.node_count} per-pod-oracle={oracle}")
+        node_count += res.node_count
 
-    def run():
-        # ONE flattened output buffer + ONE fetch: the tunnel RTT (~tens of
-        # ms) dominates the kernel, so extra awaited outputs are pure waste
-        buf = pack_batch_sharded_flat(*batch[:-1], num_iters=L, mesh=mesh)
-        return np.asarray(buf)
-
-    out = run()  # warm-up
-    _, _, done, _, q, _ = unpack_batch_flat(out, S, L)
-    assert done.all(), "batch solve must converge in one chunk for the bench"
-    times = run_timed(run)
-    node_count = int(q[q > 0].sum())
+    times = run_timed(lambda: solve_batch(problems))
     st = _stats(times)
     return {"pods": 20_000, "zones": 3, **st, "node_count": node_count,
+            "node_parity_vs_per_pod_go_oracle": f"{oracle_label} — each zone",
+            "timed_path": "public solve_batch(): 3 schedules, one device call",
             "pods_per_sec": round(20_000 / (st["p50_ms"] / 1000.0 or 1e-9))}
 
 
@@ -303,13 +294,37 @@ def _kernel_breakdown(pods, catalog):
 
 
 def config_4_headline():
+    """THE production path: Pod objects in → node set out through the public
+    solve() — cached-marshal gather + memoized packables + encode + device
+    pack + decode + materialize all inside the timed region. The one-time
+    ingest marshal (watch/codec primes each pod's vector) is measured and
+    reported separately."""
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.solver.adapter import pod_vectors
+    from karpenter_tpu.solver.solve import solve
+
     catalog = make_catalog(400)
     pods = make_pods(50_000, MIXED_SHAPES)
-    times, nodes = bench_pack(pods, catalog)
+    constraints = universe_constraints(catalog)
+
+    t0 = time.perf_counter()
+    pod_vectors(pods)  # ingest-time marshal (codec does this per watch event)
+    ingest_marshal_ms = round((time.perf_counter() - t0) * 1000.0, 1)
+
+    result = solve(constraints, pods, catalog)  # warm-up (compile)
+    oracle, oracle_label = oracle_node_count(constraints, pods, catalog)
+    assert result.node_count == oracle, (
+        f"node-count mismatch: solve={result.node_count} per-pod-oracle={oracle}")
+    assert not result.unschedulable
+
+    times = run_timed(lambda: solve(constraints, pods, catalog))
     st = _stats(times)
-    return times, {"pods": 50_000, "types": 400, **st, "node_count": nodes,
+    return times, {"pods": 50_000, "types": 400, **st,
+                   "node_count": result.node_count,
                    "pods_per_sec": round(50_000 / (st["p50_ms"] / 1000.0 or 1e-9)),
-                   "node_parity_vs_go_ffd_oracle": "exact",
+                   "node_parity_vs_per_pod_go_oracle": oracle_label,
+                   "timed_path": "public solve(): Pod objects in, node set out",
+                   "ingest_marshal_ms_50k_cold": ingest_marshal_ms,
                    "kernel_breakdown": _kernel_breakdown(pods, catalog)}
 
 
@@ -347,12 +362,16 @@ def config_5_consolidation():
 
     plan = repack_plan(nodes, pods_by_node, constraints, catalog)  # warm-up
     assert plan.saves, "fragmented fleet must consolidate"
+    oracle, oracle_label = oracle_node_count(constraints, pods, catalog)
+    assert plan.planned_nodes == oracle, (
+        f"node-count mismatch: repack={plan.planned_nodes} per-pod-oracle={oracle}")
     times = run_timed(
         lambda: repack_plan(nodes, pods_by_node, constraints, catalog),
         budget_s=60.0)
     st = _stats(times)
     return {"running_nodes": 2_000, "pods": 6_000, **st,
             "planned_nodes": plan.planned_nodes,
+            "node_parity_vs_per_pod_go_oracle": f"{oracle_label} — re-pack forward solve",
             "cost_before_per_hour": round(plan.current_cost_per_hour, 2),
             "cost_after_per_hour": round(plan.planned_cost_per_hour, 2)}
 

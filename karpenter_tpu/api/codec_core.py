@@ -319,7 +319,7 @@ def pod_spec_to(s: PodSpec) -> Dict[str, Any]:
 
 def pod_from(obj: Dict[str, Any]) -> Pod:
     status = obj.get("status") or {}
-    return Pod(
+    pod = Pod(
         metadata=meta_from(obj.get("metadata") or {}),
         spec=pod_spec_from(obj.get("spec") or {}),
         status=PodStatus(
@@ -332,6 +332,14 @@ def pod_from(obj: Dict[str, Any]) -> Pod:
             nominated_node_name=status.get("nominatedNodeName", ""),
         ),
     )
+    # Prime the solver marshal cache at ingest: the codec touches every pod
+    # exactly once per watch event, so the per-pod resource-vector extraction
+    # happens here — off the solve path — and the hot loop's marshal becomes
+    # a cached gather (SURVEY.md §7 "including marshal of 50k pods").
+    from karpenter_tpu.solver.adapter import pod_vector
+
+    pod_vector(pod)
+    return pod
 
 
 def pod_to(p: Pod) -> Dict[str, Any]:

@@ -51,6 +51,14 @@ class InstanceTypeProvider:
         # expiry restores an offering without re-discovery
         self._cache = TTLCache(INSTANCE_TYPES_AND_ZONES_CACHE_TTL)
         self._unavailable = TTLCache(INSUFFICIENT_CAPACITY_ERROR_CACHE_TTL)
+        # Interned adapted objects: the same (discovery generation, name,
+        # offerings) must yield the SAME InstanceType object call over call,
+        # so the solver's identity-keyed packables memo (solver/adapter.py)
+        # hits between catalog refreshes. An ICE poisoning or discovery
+        # refresh changes the key → fresh object → the memo recomputes,
+        # never stale.
+        self._interned: Dict[tuple, InstanceType] = {}
+        self._types_generation = 0
 
     def get(self, provider: AWSProvider) -> List[InstanceType]:
         """All viable instance types for the provider's subnets
@@ -60,12 +68,22 @@ class InstanceTypeProvider:
         subnet_zones = {s.availability_zone for s in self.subnet_provider.get(provider)}
         type_zones = self._get_instance_type_zones()
         result = []
+        interned: Dict[tuple, InstanceType] = {}
         max_pods = None if self.eni_limited_pod_density else 110
         for info in infos.values():
             offerings = self._create_offerings(
                 info, subnet_zones, type_zones.get(info.instance_type, set()))
-            if offerings:
-                result.append(adapt(info, offerings, max_pods=max_pods))
+            if not offerings:
+                continue
+            key = (self._types_generation, info.instance_type,
+                   tuple(offerings), max_pods)
+            it = self._interned.get(key)
+            if it is None:
+                it = adapt(info, offerings, max_pods=max_pods)
+            interned[key] = it
+            result.append(it)
+        # keep only live keys: expired infos/offering sets age out with them
+        self._interned = interned
         return result
 
     def _create_offerings(self, info: sdk.InstanceTypeInfo, subnet_zones: Set[str],
@@ -102,6 +120,7 @@ class InstanceTypeProvider:
         }
         log.debug("Discovered %d EC2 instance types", len(types))
         self._cache.set("types", types)
+        self._types_generation += 1  # fresh infos → fresh interned objects
         return types
 
     @staticmethod

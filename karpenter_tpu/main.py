@@ -37,20 +37,25 @@ log = logging.getLogger("karpenter")
 
 
 def build_cloud_provider(options: Options):
-    """Resolve the provider from the registry; the AWS provider needs its
-    SDK clients constructed first (cmd/controller/main.go:76-77)."""
+    """Resolve the provider from the registry and wrap it in the metrics
+    decorator so all SPI calls emit cloudprovider_duration_seconds — the
+    reference installs this unconditionally (cmd/controller/main.go:76-77,
+    metrics/cloudprovider.go:65-92). The AWS provider needs its SDK clients
+    constructed first."""
+    from karpenter_tpu.cloudprovider.metrics import decorate
+
     if options.cloud_provider == "aws":
         import karpenter_tpu.cloudprovider.aws  # noqa: F401 — registers "aws"
         from karpenter_tpu.cloudprovider.aws import sdk as aws_sdk
 
         ec2api, ssmapi = aws_sdk.default_clients()
-        return spi.resolve(
+        return decorate(spi.resolve(
             "aws", ec2api=ec2api, ssmapi=ssmapi,
             cluster_name=options.cluster_name,
             cluster_endpoint=options.cluster_endpoint,
             eni_limited_pod_density=options.aws_eni_limited_pod_density,
-            node_name_convention=options.aws_node_name_convention)
-    return spi.resolve(options.cloud_provider)
+            node_name_convention=options.aws_node_name_convention))
+    return decorate(spi.resolve(options.cloud_provider))
 
 
 def build_manager(kube: KubeCore, options: Options) -> Manager:

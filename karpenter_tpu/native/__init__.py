@@ -51,6 +51,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64,                              # max_records
         i64p, ctypes.c_int64,                        # prices (nullable), cost_tiebreak
     ]
+    lib.kt_ffd_pack_per_pod.restype = ctypes.c_int64
+    lib.kt_ffd_pack_per_pod.argtypes = [
+        i64p, i64p, i64p, i64p,                      # shapes, counts, totals, reserved0
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # S, T, R
+        ctypes.c_int64, ctypes.c_int64,              # pods_unit, r_pods
+        i64p, i64p, i64p, i64p,                      # out chosen/qty/packed/dropped
+        ctypes.c_int64,                              # max_records
+    ]
     return lib
 
 

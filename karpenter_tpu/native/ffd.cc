@@ -183,4 +183,122 @@ int64_t kt_ffd_pack(
   return n_records;
 }
 
+// Per-POD Go-semantics oracle: a direct transcription of the reference
+// packer's loop (packer.go:109-141 pack, packer.go:167-198
+// packWithLargestPod, packable.go:111-130 pack_one) — NOT the shape-level
+// greedy above. It exists so benchmark parity at 50k pods is asserted
+// against genuinely per-pod semantics (the Python per-pod oracle,
+// solver/host_ffd.py, is too slow beyond ~5k pods).
+//
+// Pods are implicit: the descending per-pod sort order the Go packer uses
+// (packer.go:100-108, extended to the full resource vector as in
+// host_ffd.pack) equals the encoded shape order expanded by counts, since
+// encode() sorts shapes by the same descending key and pods of equal shape
+// are interchangeable. Within one pack_one pass, after a pod of shape s
+// fails to reserve, every later pod of the same shape fails identically
+// (reservations only grow and is_full_for reads unchanged state), so the
+// skip-and-continue quirk (packable.go:111-130) collapses to skip-to-next-
+// shape without changing semantics.
+//
+// Outputs one record PER NODE (qty is always 1): decoding reuses the same
+// path as the fast-forward executors.
+int64_t kt_ffd_pack_per_pod(
+    const int64_t* shapes, const int64_t* counts_in,
+    const int64_t* totals, const int64_t* reserved0,
+    int64_t S, int64_t T, int64_t R, int64_t pods_unit, int64_t r_pods,
+    int64_t* out_chosen, int64_t* out_qty, int64_t* out_packed,
+    int64_t* out_dropped, int64_t max_records) {
+  std::vector<int64_t> counts(counts_in, counts_in + S);
+  std::vector<int64_t> dropped(S, 0);
+  std::vector<int64_t> reserved(R);
+  std::vector<int64_t> packed(S);
+  std::vector<int64_t> best_packed(S);
+  std::vector<int64_t> smallest_raw(R);
+
+  // pack_one (packable.go:111-130) of the remaining pod list onto type t.
+  // Returns pods packed; fills packed[s]. smallest_raw is the LAST pod's
+  // raw requests (no implicit pods:1) for the is_full_for early exit
+  // (packable.go:145-155).
+  auto pack_one = [&](int64_t t) -> int64_t {
+    for (int64_t r = 0; r < R; ++r) reserved[r] = reserved0[t * R + r];
+    std::fill(packed.begin(), packed.end(), 0);
+    int64_t total_packed = 0;
+    for (int64_t s = 0; s < S; ++s) {
+      if (counts[s] == 0) continue;
+      for (int64_t j = 0; j < counts[s]; ++j) {
+        bool fits = true;
+        for (int64_t r = 0; r < R; ++r) {
+          if (reserved[r] + shapes[s * R + r] > totals[t * R + r]) {
+            fits = false;
+            break;
+          }
+        }
+        if (fits) {
+          for (int64_t r = 0; r < R; ++r) reserved[r] += shapes[s * R + r];
+          ++packed[s];
+          ++total_packed;
+          continue;
+        }
+        // is_full_for(smallest remaining pod): >= against any nonzero total
+        for (int64_t r = 0; r < R; ++r) {
+          if (totals[t * R + r] != 0 &&
+              reserved[r] + smallest_raw[r] >= totals[t * R + r])
+            return total_packed;           // rest unpacked (early exit)
+        }
+        if (total_packed == 0) return 0;   // nothing packed yet → empty
+        break;  // this pod unpacked; later same-shape pods fail identically
+      }
+    }
+    return total_packed;
+  };
+
+  int64_t n_records = 0;
+  for (;;) {
+    int64_t largest = -1, smallest = -1;
+    for (int64_t s = 0; s < S; ++s) {
+      if (counts[s] > 0) {
+        if (largest < 0) largest = s;
+        smallest = s;
+      }
+    }
+    if (largest < 0) break;
+    for (int64_t r = 0; r < R; ++r) {
+      int64_t v = shapes[smallest * R + r];
+      if (r == r_pods) v -= pods_unit;
+      smallest_raw[r] = v;
+    }
+
+    // probe the LARGEST type for the max-pods upper bound (packer.go:170)
+    const int64_t max_pods = pack_one(T - 1);
+    if (max_pods == 0) {
+      // drop the single largest pod (packer.go:124-128)
+      dropped[largest] += 1;
+      counts[largest] -= 1;
+      continue;
+    }
+    // first (smallest) type achieving the bound wins (packer.go:174-183)
+    int64_t chosen = -1;
+    for (int64_t t = 0; t < T; ++t) {
+      if (pack_one(t) == max_pods) {
+        chosen = t;
+        best_packed = packed;
+        break;
+      }
+    }
+    if (chosen < 0) chosen = T - 1, pack_one(T - 1), best_packed = packed;
+
+    if (n_records >= max_records) return -1;
+    out_chosen[n_records] = chosen;
+    out_qty[n_records] = 1;
+    for (int64_t s = 0; s < S; ++s) {
+      out_packed[n_records * S + s] = best_packed[s];
+      counts[s] -= best_packed[s];
+    }
+    ++n_records;
+  }
+
+  std::memcpy(out_dropped, dropped.data(), sizeof(int64_t) * S);
+  return n_records;
+}
+
 }  // extern "C"

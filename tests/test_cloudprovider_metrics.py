@@ -1,0 +1,76 @@
+"""CloudProvider metrics decorator: every SPI call must land in
+cloudprovider_duration_seconds{method, provider}
+(metrics/cloudprovider.go:65-92, installed at cmd/controller/main.go:76-77).
+"""
+
+from karpenter_tpu.cloudprovider.fake.provider import FakeCloudProvider, instance_types
+from karpenter_tpu.cloudprovider.metrics import METRIC, MeteredCloudProvider, decorate
+from karpenter_tpu.controllers.provisioning import universe_constraints
+from karpenter_tpu.metrics.registry import HISTOGRAMS, NAMESPACE
+
+
+def _series():
+    hist = HISTOGRAMS.histogram(METRIC)
+    return {dict(lv)["method"]: total
+            for lv, (_, _, total) in hist.collect().items()}
+
+
+class TestMeteredCloudProvider:
+    def test_all_spi_methods_metered(self):
+        catalog = instance_types(3)
+        provider = decorate(FakeCloudProvider(catalog=catalog))
+        constraints = universe_constraints(catalog)
+        before = _series()
+
+        got = provider.get_instance_types(constraints)
+        assert [it.name for it in got] == [it.name for it in catalog]
+        provider.default(constraints)
+        provider.validate(constraints)
+        bound = []
+        provider.create(constraints, got, 2, lambda n: bound.append(n) and None)
+        assert len(bound) == 2
+        provider.delete(bound[0])
+
+        after = _series()
+        for method in ("Create", "Delete", "GetInstanceTypes", "Default",
+                       "Validate"):
+            assert after.get(method, 0) > before.get(method, 0), method
+
+    def test_failure_still_observed(self):
+        class Exploding(FakeCloudProvider):
+            def get_instance_types(self, constraints):
+                raise RuntimeError("boom")
+
+        provider = decorate(Exploding())
+        before = _series().get("GetInstanceTypes", 0)
+        try:
+            provider.get_instance_types(None)
+        except RuntimeError:
+            pass
+        assert _series()["GetInstanceTypes"] == before + 1
+
+    def test_idempotent_decorate_and_passthrough(self):
+        inner = FakeCloudProvider(catalog=instance_types(2))
+        wrapped = decorate(inner)
+        assert decorate(wrapped) is wrapped
+        assert isinstance(wrapped, MeteredCloudProvider)
+        assert wrapped.name() == "fake"
+        # non-SPI extras (fault injection) pass through to the inner provider
+        wrapped.insufficient_capacity.add(("x", "z", "spot"))
+        assert inner.insufficient_capacity == {("x", "z", "spot")}
+
+    def test_exposed_with_labels(self):
+        catalog = instance_types(2)
+        provider = decorate(FakeCloudProvider(catalog=catalog))
+        provider.get_instance_types(universe_constraints(catalog))
+        text = HISTOGRAMS.expose()
+        assert f"{NAMESPACE}_{METRIC}_bucket" in text
+        assert 'method="GetInstanceTypes"' in text
+        assert 'provider="fake"' in text
+
+    def test_main_installs_decorator(self):
+        from karpenter_tpu.config.options import Options
+        from karpenter_tpu.main import build_cloud_provider
+
+        provider = build_cloud_provider(Options(cloud_provider="fake"))
+        assert isinstance(provider, MeteredCloudProvider)

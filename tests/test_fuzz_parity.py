@@ -1,11 +1,12 @@
 """Randomized differential fuzz of the executor quartet (SURVEY.md §5.2).
 
 Every case runs the SAME problem through:
-  1. host_ffd.pack        — per-pod Go-parity oracle (ground truth)
-  2. solve_ffd_numpy      — shape-level numpy mirror of the device kernel
-  3. solve_ffd_native     — C++ kernel via ctypes
-  4. solve_ffd_device     — XLA scan kernel
-  5. pack via pallas interpret (subset of cases; Mosaic needs real TPU)
+  1. host_ffd.pack              — per-pod Go-parity oracle (ground truth)
+  2. solve_ffd_numpy            — shape-level numpy mirror of the device kernel
+  3. solve_ffd_native           — shape-level C++ kernel via ctypes
+  4. solve_ffd_per_pod_native   — per-pod C++ oracle (bench parity checker)
+  5. solve_ffd_device           — XLA scan kernel
+  6. pack via pallas interpret (subset of cases; Mosaic needs real TPU)
 and asserts node counts, per-node shape multisets, instance-option
 multisets, and unschedulable sets all agree.
 
@@ -34,7 +35,9 @@ from karpenter_tpu.models.ffd import solve_ffd_device, solve_ffd_numpy
 from karpenter_tpu.ops.encode import encode
 from karpenter_tpu.solver import host_ffd
 from karpenter_tpu.solver.adapter import build_packables, pod_vector
-from karpenter_tpu.solver.native_ffd import solve_ffd_native
+from karpenter_tpu.solver.native_ffd import (
+    solve_ffd_native, solve_ffd_per_pod_native,
+)
 from karpenter_tpu.solver.solve import SolverConfig, solve
 
 N_CASES = int(os.environ.get("KARPENTER_FUZZ_CASES", "150"))
@@ -160,6 +163,8 @@ class TestExecutorQuartetFuzz:
             for name, result in (
                 ("numpy", solve_ffd_numpy(vecs, ids, packables)),
                 ("native", solve_ffd_native(vecs, ids, packables)),
+                ("native-per-pod",
+                 solve_ffd_per_pod_native(vecs, ids, packables)),
                 ("xla", solve_ffd_device(vecs, ids, packables, kernel="xla")),
             ):
                 assert result is not None, f"{ctx}: {name} returned None"

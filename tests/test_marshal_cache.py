@@ -1,0 +1,138 @@
+"""Marshal-cache correctness: the cached pod-vector/packables fast path must
+be bit-identical to the uncached computation, and staleness must be
+structurally impossible (new objects → new identity tokens).
+
+The cache exists because the 200 ms p99 budget INCLUDES marshal of 50k pods
+(SURVEY.md §7); see solver/adapter.py module docstring.
+"""
+
+import copy
+
+from karpenter_tpu.api.core import Container, Pod, PodSpec, ResourceRequirements
+from karpenter_tpu.controllers.provisioning import universe_constraints
+from karpenter_tpu.solver.adapter import (
+    _compute_pod_marshal, _required_resources, build_packables,
+    build_packables_cached, invalidate_pod_marshal, pod_special_mask,
+    pod_vector, pod_vectors,
+)
+from karpenter_tpu.cloudprovider.fake.provider import instance_types
+from karpenter_tpu.solver.host_ffd import R_CPU, R_MEMORY, R_NVIDIA
+
+
+def make_catalog_simple():
+    return instance_types(6)
+
+
+def make_pod(requests=None, limits=None):
+    return Pod(spec=PodSpec(containers=[Container(
+        resources=ResourceRequirements.make(requests=requests, limits=limits))]))
+
+
+class TestPodVectorCache:
+    def test_cached_equals_computed(self):
+        pod = make_pod({"cpu": "250m", "memory": "1Gi"})
+        vec = pod_vector(pod)
+        assert vec == _compute_pod_marshal(pod)[0]
+        assert vec[R_CPU] == 250 * 10**6
+        assert vec[R_MEMORY] == 2**30 * 10**9
+        # second call returns the identical cached tuple
+        assert pod_vector(pod) is vec
+
+    def test_special_mask_requests_and_limits(self):
+        # requiresResource (packable.go:221-233) checks requests OR limits
+        by_request = make_pod({"nvidia.com/gpu": "1"})
+        by_limit = make_pod({"cpu": "1"}, limits={"nvidia.com/gpu": "1"})
+        neither = make_pod({"cpu": "1"})
+        assert pod_special_mask(by_request) == pod_special_mask(by_limit) != 0
+        assert pod_special_mask(neither) == 0
+        assert pod_vector(by_request)[R_NVIDIA] == 10**9
+        # a limits-only GPU request reserves nothing but still gates viability
+        assert pod_vector(by_limit)[R_NVIDIA] == 0
+
+    def test_required_resources_from_masks(self):
+        pods = [make_pod({"cpu": "1"}) for _ in range(10)]
+        pods.append(make_pod({"cpu": "1"}, limits={"amd.com/gpu": "2"}))
+        assert _required_resources(pods) == frozenset({"amd.com/gpu"})
+
+    def test_invalidate(self):
+        pod = make_pod({"cpu": "1"})
+        v0 = pod_vector(pod)
+        pod.spec.containers[0].resources = ResourceRequirements.make(
+            requests={"cpu": "2"})
+        assert pod_vector(pod) is v0  # stale until invalidated
+        invalidate_pod_marshal(pod)
+        assert pod_vector(pod)[R_CPU] == 2 * 10**9
+
+    def test_deepcopy_carries_cache(self):
+        pod = make_pod({"cpu": "3"})
+        v0 = pod_vector(pod)
+        clone = copy.deepcopy(pod)
+        assert pod_vector(clone) == v0
+
+    def test_bulk_gather_matches(self):
+        pods = [make_pod({"cpu": f"{i % 7 + 1}", "memory": f"{i % 5 + 1}Gi"})
+                for i in range(200)]
+        assert pod_vectors(pods) == [_compute_pod_marshal(p)[0] for p in pods]
+
+    def test_codec_primes_cache(self):
+        from karpenter_tpu.api.codec_core import pod_from
+
+        pod = pod_from({"metadata": {"name": "x"}, "spec": {"containers": [
+            {"name": "app", "resources": {"requests": {"cpu": "500m"}}}]}})
+        assert "_marshal" in pod.__dict__
+        assert pod_vector(pod)[R_CPU] == 500 * 10**6
+
+
+class TestPackablesCache:
+    def test_hit_is_bit_identical_and_mutation_safe(self):
+        catalog = make_catalog_simple()
+        constraints = universe_constraints(catalog)
+        pods = [make_pod({"cpu": "1"})]
+        want_p, want_t = build_packables(catalog, constraints, pods, [])
+        got1_p, got1_t = build_packables_cached(catalog, constraints, pods, [])
+        got2_p, got2_t = build_packables_cached(catalog, constraints, pods, [])
+        key = lambda ps: [(p.index, p.total, p.reserved) for p in ps]
+        assert key(got1_p) == key(got2_p) == key(want_p)
+        assert got1_t == got2_t == want_t
+        # hits hand out fresh copies: mutating one must not poison the cache
+        got1_p[0].reserved[0] += 999
+        got3_p, _ = build_packables_cached(catalog, constraints, pods, [])
+        assert key(got3_p) == key(want_p)
+
+    def test_new_catalog_objects_never_hit_stale(self):
+        # a provider refresh builds NEW InstanceType objects → new tokens →
+        # recompute, even if the old catalog had identical values
+        catalog1 = make_catalog_simple()
+        catalog2 = make_catalog_simple()
+        constraints = universe_constraints(catalog1)
+        pods = [make_pod({"cpu": "1"})]
+        build_packables_cached(catalog1, constraints, pods, [])
+        catalog2[0].cpu = copy.copy(catalog2[0].cpu)
+        catalog2[0].cpu.nano *= 2  # semantically different catalog
+        got_p, _ = build_packables_cached(catalog2, constraints, pods, [])
+        want_p, _ = build_packables(catalog2, constraints, pods, [])
+        assert [(p.index, p.total) for p in got_p] == \
+            [(p.index, p.total) for p in want_p]
+
+    def test_required_resources_partition_cache_key(self):
+        catalog = make_catalog_simple()
+        constraints = universe_constraints(catalog)
+        plain = [make_pod({"cpu": "1"})]
+        gpu = [make_pod({"cpu": "1"}, limits={"nvidia.com/gpu": "1"})]
+        p_plain, _ = build_packables_cached(catalog, constraints, plain, [])
+        p_gpu, _ = build_packables_cached(catalog, constraints, gpu, [])
+        w_plain, _ = build_packables(catalog, constraints, plain, [])
+        w_gpu, _ = build_packables(catalog, constraints, gpu, [])
+        assert len(p_plain) == len(w_plain)
+        assert len(p_gpu) == len(w_gpu)
+
+    def test_daemons_enter_key(self):
+        catalog = make_catalog_simple()
+        constraints = universe_constraints(catalog)
+        pods = [make_pod({"cpu": "1"})]
+        daemon = make_pod({"cpu": "500m"})
+        p0, _ = build_packables_cached(catalog, constraints, pods, [])
+        p1, _ = build_packables_cached(catalog, constraints, pods, [daemon])
+        w1, _ = build_packables(catalog, constraints, pods, [daemon])
+        assert [(p.reserved) for p in p1] == [(p.reserved) for p in w1]
+        assert [(p.reserved) for p in p0] != [(p.reserved) for p in p1]

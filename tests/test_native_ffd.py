@@ -16,7 +16,9 @@ from karpenter_tpu.controllers.provisioning import universe_constraints
 from karpenter_tpu.models.ffd import solve_ffd_numpy
 from karpenter_tpu.solver import host_ffd
 from karpenter_tpu.solver.adapter import build_packables, pod_vector
-from karpenter_tpu.solver.native_ffd import solve_ffd_native
+from karpenter_tpu.solver.native_ffd import (
+    solve_ffd_native, solve_ffd_per_pod_native,
+)
 
 from tests.expectations import unschedulable_pod
 
@@ -90,3 +92,57 @@ class TestNativeParity:
         result = solve_ffd_native([(10**9, 0, 0, 0, 0, 0, 0, 0)], [0], [])
         assert result.node_count == 0
         assert result.unschedulable == [0]
+
+
+def _result_key(r):
+    return (
+        sorted((tuple(p.instance_type_indices), p.node_quantity,
+                sorted(tuple(sorted(n)) for n in p.pod_ids))
+               for p in r.packings),
+        sorted(r.unschedulable),
+    )
+
+
+class TestPerPodNativeOracle:
+    """kt_ffd_pack_per_pod is a transcription of packer.go:109-141, not the
+    shape-level greedy: it must reproduce the Python per-pod oracle to the
+    FULL result key (per-node pod sets, option lists, quantities), since the
+    bench's 50k-pod parity claim rests on it being genuinely per-pod."""
+
+    def test_full_result_key_randomized(self):
+        rng = random.Random(3_2026)
+        for trial in range(15):
+            catalog = instance_types(rng.randint(1, 25))
+            pods = [
+                unschedulable_pod(requests={
+                    "cpu": f"{rng.choice([50, 100, 250, 500, 1000, 2000, 3000])}m",
+                    "memory": f"{rng.choice([32, 64, 256, 512, 1024, 4096])}Mi",
+                })
+                for _ in range(rng.randint(1, 300))
+            ]
+            vecs, ids, packables = _problem(pods, catalog)
+            want = host_ffd.pack(vecs, ids, packables)
+            got = solve_ffd_per_pod_native(vecs, ids, packables)
+            assert got is not None
+            assert _result_key(got) == _result_key(want), f"trial {trial}"
+
+    def test_agrees_with_fast_forward_executors(self):
+        # independent algorithms, same node count (the ±1 target, held exact)
+        pods = [unschedulable_pod(requests={"cpu": f"{c}m", "memory": f"{m}Mi"})
+                for c, m in [(100, 128), (500, 512), (1500, 1024), (4000, 4096)]
+                for _ in range(250)]
+        vecs, ids, packables = _problem(pods, instance_types(20))
+        per_pod = solve_ffd_per_pod_native(vecs, ids, packables)
+        shape_level = solve_ffd_native(vecs, ids, packables)
+        numpy_mirror = solve_ffd_numpy(vecs, ids, packables)
+        assert per_pod.node_count == shape_level.node_count == numpy_mirror.node_count
+
+    def test_unschedulable_single_drop(self):
+        catalog = [make_instance_type("tiny", cpu="1", memory="1Gi", pods="10")]
+        pods = [unschedulable_pod(requests={"cpu": "2", "memory": "512Mi"}),
+                unschedulable_pod(requests={"cpu": "500m", "memory": "128Mi"})]
+        vecs, ids, packables = _problem(pods, catalog)
+        got = solve_ffd_per_pod_native(vecs, ids, packables)
+        want = host_ffd.pack(vecs, ids, packables)
+        assert _result_key(got) == _result_key(want)
+        assert got.unschedulable == [0]
