@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench native dryrun clean help
+.PHONY: test battletest bench native dryrun lint chart clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -18,6 +18,13 @@ bench: ## Run the 5-config benchmark on the available accelerator
 native: ## Build the C++ FFD kernel explicitly (normally built lazily)
 	g++ -O3 -std=c++17 -shared -fPIC \
 		-o karpenter_tpu/native/_libktffd.so karpenter_tpu/native/ffd.cc
+
+lint: ## ruff + mypy quality gate (the golangci/gocyclo analog, SURVEY §5.2)
+	ruff check karpenter_tpu tests bench.py __graft_entry__.py
+	mypy karpenter_tpu/solver karpenter_tpu/ops karpenter_tpu/api
+
+chart: ## Render the Helm chart with the in-repo renderer (no helm needed)
+	python -m karpenter_tpu.utils.helmlite charts/karpenter-tpu
 
 dryrun: ## Compile-check the sharded multi-chip step on an 8-device CPU mesh
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

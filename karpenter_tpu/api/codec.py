@@ -9,7 +9,7 @@ loading `kubectl`-shaped manifests.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict
 
 from karpenter_tpu.api.constraints import Constraints, KubeletConfiguration, Limits, Taints
 from karpenter_tpu.api.core import NodeSelectorRequirement, ObjectMeta, Taint

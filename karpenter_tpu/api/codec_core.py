@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import calendar
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from karpenter_tpu.api.core import (
     Affinity, ConfigMap, Container, DaemonSet, DaemonSetSpec, LabelSelector,

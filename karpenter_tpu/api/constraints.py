@@ -9,7 +9,7 @@ import copy
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-from karpenter_tpu.api.core import Pod, Taint, Toleration
+from karpenter_tpu.api.core import Pod, Taint
 from karpenter_tpu.api.requirements import Requirements, pod_requirements
 from karpenter_tpu.utils.resources import ResourceList
 

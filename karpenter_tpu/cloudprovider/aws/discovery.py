@@ -8,7 +8,7 @@ they live in one module here.
 from __future__ import annotations
 
 import logging
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List
 
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.cloudprovider.aws import sdk

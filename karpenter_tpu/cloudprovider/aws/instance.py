@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.constraints import Constraints

@@ -10,7 +10,7 @@ immutable arrays for the TPU pack kernel.
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Set
 
 from karpenter_tpu.cloudprovider.aws import sdk
 from karpenter_tpu.cloudprovider.aws.discovery import SubnetProvider

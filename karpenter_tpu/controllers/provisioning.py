@@ -102,7 +102,17 @@ class ProvisionerWorker:
             if not items or self._stop.is_set():
                 return None
             log.info("batched %d pods in %.2fs", len(items), window)
-            pods = [p for p in items if self._is_provisionable(p)]
+            # dedupe within the batch: the non-blocking selection path can
+            # requeue a still-pending pod into the same window (selection.py
+            # concurrency note); packing it twice would double-count it
+            seen = set()
+            deduped = []
+            for p in items:
+                key = (p.metadata.namespace, p.metadata.name)
+                if key not in seen:
+                    seen.add(key)
+                    deduped.append(p)
+            pods = [p for p in deduped if self._is_provisionable(p)]
             with HISTOGRAMS.time("scheduling_duration_seconds",
                                  provisioner=self.provisioner.metadata.name):
                 schedules = self.scheduler.solve(self.provisioner, pods)
@@ -137,13 +147,16 @@ class ProvisionerWorker:
             self.batcher.flush()
 
     def _is_provisionable(self, candidate: Pod) -> bool:
-        """Re-GET each pod to avoid duplicate binds (provisioner.go:126-135)."""
+        """Fresh read per pod to avoid duplicate binds (provisioner.go:
+        126-135). Uses the no-copy cache read: the Go analog reads the
+        informer cache, and deep-copying every batched pod costs seconds
+        at the 10k-pod regime for a one-field check."""
         try:
-            stored = self.kube.get("Pod", candidate.metadata.name,
-                                   candidate.metadata.namespace)
+            return not self.kube.read(
+                "Pod", candidate.metadata.name, candidate.metadata.namespace,
+                podutil.is_scheduled)
         except NotFound:
             return False
-        return not podutil.is_scheduled(stored)
 
     def _get_daemons(self, constraints: Constraints) -> List[Pod]:
         """Daemonset pods that would schedule on these nodes (packer.go:148-162)."""

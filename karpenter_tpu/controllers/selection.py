@@ -162,15 +162,30 @@ class VolumeTopology:
 
 
 class SelectionController:
-    """controller.go:59-111."""
+    """controller.go:59-111.
+
+    Concurrency model: the reference runs 10,000 concurrent reconciles
+    (controller.go:181) so every reconciler can BLOCK on the batch gate
+    (controller.go:108-111) without throttling intake. Python threads don't
+    scale to 10k, so the equivalent here is NON-blocking by default: the
+    pod is enqueued to the batcher and the 5-second requeue performs the
+    same post-batch re-verification the gate wait enabled (a still-pending
+    pod re-enters; the provisioning worker dedupes within a batch and
+    re-GETs provisionability, provisioner.go:126-135). With 64 workers a
+    blocking gate caps intake at 64 pods per window — three orders below
+    the reference's regime; non-blocking restores it. Set ``gate_timeout``
+    > 0 to restore the reference's blocking behavior.
+    """
 
     REQUEUE_SECONDS = 5.0  # re-verify scheduling after the batch
 
-    def __init__(self, kube: KubeCore, provisioning_controller):
+    def __init__(self, kube: KubeCore, provisioning_controller,
+                 gate_timeout: float = 0.0):
         self.kube = kube
         self.provisioning = provisioning_controller
         self.preferences = Preferences()
         self.volume_topology = VolumeTopology(kube)
+        self.gate_timeout = gate_timeout
 
     def kind(self) -> str:
         return "Pod"
@@ -213,5 +228,6 @@ class SelectionController:
         if chosen is None:
             return f"matched 0/{len(errs)} provisioners: " + "; ".join(errs)
         gate = chosen.add(pod)
-        gate.wait(timeout=30.0)
+        if self.gate_timeout > 0:
+            gate.wait(timeout=self.gate_timeout)
         return None

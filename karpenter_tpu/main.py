@@ -14,7 +14,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from karpenter_tpu.cloudprovider import spi
-from karpenter_tpu.cloudprovider.fake import provider as _fake  # registers "fake"
+from karpenter_tpu.cloudprovider.fake import provider as _fake  # noqa: F401 — registers "fake"
 from karpenter_tpu.config.options import Options, parse
 from karpenter_tpu.controllers.consolidation import ConsolidationController
 from karpenter_tpu.controllers.counter import CounterController

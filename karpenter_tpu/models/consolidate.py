@@ -97,6 +97,18 @@ class ConsolidationPlan:
         return self.planned_cost_per_hour < self.current_cost_per_hour - 1e-9
 
 
+@dataclass
+class Fleet:
+    """One provisioner's consolidation scope: its running nodes, their pods,
+    and the constraints/catalog its replacement capacity must come from."""
+
+    nodes: Sequence[Node]
+    pods_by_node: Dict[str, List[Pod]]
+    constraints: Constraints
+    catalog: Sequence[InstanceType]
+    daemons: Sequence[Pod] = ()
+
+
 def repack_plan(
     nodes: Sequence[Node],
     pods_by_node: Dict[str, List[Pod]],
@@ -107,25 +119,61 @@ def repack_plan(
     cost_config: CostConfig = CostConfig(),
 ) -> ConsolidationPlan:
     """Minimal-set re-pack of every candidate node's reschedulable pods —
-    one batched solve on the same device kernel as provisioning."""
-    candidates: List[Node] = []
-    movable: List[Pod] = []
-    for node in nodes:
-        pods, ok = reschedulable_pods(pods_by_node.get(node.metadata.name, []))
-        if not ok:
-            continue
-        candidates.append(node)
-        movable.extend(pods)
-    replacement = solve(constraints, movable, catalog, daemons=daemons,
-                        config=solver_config)
-    return ConsolidationPlan(
-        nodes_to_remove=candidates,
-        replacement=replacement,
-        current_nodes=len(candidates),
-        current_cost_per_hour=current_cost(candidates, catalog, cost_config),
-        planned_cost_per_hour=plan_cost(
-            replacement.packings, constraints.requirements, cost_config),
-    )
+    one solve on the same device kernel as provisioning."""
+    return repack_plan_multi(
+        [Fleet(nodes, pods_by_node, constraints, catalog, daemons)],
+        solver_config=solver_config, cost_config=cost_config)[0]
+
+
+def repack_plan_multi(
+    fleets: Sequence[Fleet],
+    solver_config: Optional[SolverConfig] = None,
+    cost_config: CostConfig = CostConfig(),
+) -> List[ConsolidationPlan]:
+    """Whole-fleet re-packs for MANY provisioners in one batched device
+    call: the per-fleet forward solves ride solver/batch_solve.solve_batch
+    (vmap within a chip, shard_map over the mesh batch axis, one flattened
+    fetch) — consolidation scales across the mesh exactly like the
+    provisioning hot loop (controllers/provisioning.py:127)."""
+    from karpenter_tpu.solver.batch_solve import Problem, solve_batch
+
+    prepared = []
+    for fleet in fleets:
+        candidates: List[Node] = []
+        movable: List[Pod] = []
+        for node in fleet.nodes:
+            pods, ok = reschedulable_pods(
+                fleet.pods_by_node.get(node.metadata.name, []))
+            if not ok:
+                continue
+            candidates.append(node)
+            movable.extend(pods)
+        prepared.append((fleet, candidates, movable))
+
+    if len(prepared) == 1:  # solo fleet: no batch machinery
+        fleet, candidates, movable = prepared[0]
+        replacements = [solve(fleet.constraints, movable, fleet.catalog,
+                              daemons=fleet.daemons, config=solver_config)]
+    else:
+        replacements = solve_batch(
+            [Problem(constraints=fleet.constraints, pods=movable,
+                     instance_types=fleet.catalog, daemons=fleet.daemons)
+             for fleet, _, movable in prepared],
+            config=solver_config)
+
+    return [
+        ConsolidationPlan(
+            nodes_to_remove=candidates,
+            replacement=replacement,
+            current_nodes=len(candidates),
+            current_cost_per_hour=current_cost(
+                candidates, fleet.catalog, cost_config),
+            planned_cost_per_hour=plan_cost(
+                replacement.packings, fleet.constraints.requirements,
+                cost_config),
+        )
+        for (fleet, candidates, _), replacement in zip(prepared, replacements)
+    ]
 
 
 # ---------------------------------------------------------------------------

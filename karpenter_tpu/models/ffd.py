@@ -74,6 +74,8 @@ def solve_ffd_device(
     kernel: Optional[str] = None,   # "xla" | "pallas" | None = auto
     prices: Optional[Sequence[float]] = None,  # per-packable effective $/h
     cost_tiebreak: bool = False,
+    max_shapes: Optional[int] = None,  # decline above this cardinality
+    enc: Optional[EncodedProblem] = None,  # precomputed (possibly unpadded)
 ) -> Optional[HostSolveResult]:
     """Solve on device; None when the problem is not device-encodable
     (caller falls back to the host oracle). Pods may arrive unsorted; the
@@ -81,15 +83,29 @@ def solve_ffd_device(
 
     ``cost_tiebreak`` picks the cheapest max-pods type per node (capacity
     order on price ties); currently served by the XLA kernel — a pallas
-    request silently routes there in this mode."""
+    request silently routes there in this mode.
+
+    ``max_shapes``: return None above this distinct-shape count so the
+    caller's native ring answers instead (SolverConfig.device_max_shapes —
+    at high cardinality the chunked record fetches cost a round trip each).
+
+    ``enc``: a precomputed encoding (padded or exact-size) so the solve
+    path pays the O(pods) dedupe + GCD scaling once across all rings."""
     import jax
 
+    from karpenter_tpu.ops.encode import pad_encoding
     from karpenter_tpu.ops.pack import pack_chunk_flat, unpack_flat
 
     if not packables:
         return HostSolveResult(packings=[], unschedulable=list(pod_ids))
 
-    enc = encode(pod_vecs, pod_ids, packables)
+    if enc is None:
+        enc = encode(pod_vecs, pod_ids, packables, pad=False)
+    if enc is None:
+        return None
+    if max_shapes is not None and enc.num_shapes > max_shapes:
+        return None
+    enc = pad_encoding(enc)
     if enc is None:
         return None
 
@@ -173,10 +189,16 @@ def solve_ffd_numpy(
     pods_one[_R_PODS] = enc.pods_unit
 
     avail0 = totals - reserved0
+    # unrolled over R so peak memory stays (S, T), never (S, T, R) — the
+    # dense intermediate is ~0.5 GB at the 8192-shape bucket
+    kfit0 = np.full((S, T), _INT32_MAX, np.int64)
     with np.errstate(divide="ignore"):
-        kr0 = np.where(shapes[:, None, :] > 0,
-                       avail0[None, :, :] // np.maximum(shapes[:, None, :], 1), _INT32_MAX)
-    maxfit = np.min(kr0, axis=-1).max(axis=1)  # (S,)
+        for r in range(shapes.shape[1]):
+            col = shapes[:, r][:, None]
+            kr_r = np.where(col > 0, avail0[None, :, r] // np.maximum(col, 1),
+                            _INT32_MAX)
+            np.minimum(kfit0, kr_r, out=kfit0)
+    maxfit = kfit0.max(axis=1)  # (S,)
 
     dropped = np.zeros(S, np.int64)
     records = []
@@ -245,13 +267,21 @@ def _decode(
     for chosen, qty, packedv in records:
         options = instance_options(packables, chosen, max_instance_types)
         key = tuple(options)
+        # iterate only the shapes this record touches: at high cardinality
+        # (tens of thousands of shapes) a per-record full-S Python loop
+        # would dominate the whole solve. Records carry either a dense
+        # per-shape vector or an already-sparse [(shape, count), ...] list
+        # (the native per-pod kernel's ABI).
+        if isinstance(packedv, list):
+            touched = packedv
+        else:
+            arr = np.asarray(packedv[:enc.num_shapes])
+            touched = [(int(s), int(arr[s])) for s in np.flatnonzero(arr)]
         for _ in range(qty):
             node_pods: List[int] = []
-            for s in range(enc.num_shapes):
-                n = int(packedv[s])
-                if n:
-                    node_pods.extend(queues[s][heads[s]:heads[s] + n])
-                    heads[s] += n
+            for s, n in touched:
+                node_pods.extend(queues[s][heads[s]:heads[s] + n])
+                heads[s] += n
             if key in by_options:
                 main = by_options[key]
                 main.node_quantity += 1

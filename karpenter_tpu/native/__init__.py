@@ -56,8 +56,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         i64p, i64p, i64p, i64p,                      # shapes, counts, totals, reserved0
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # S, T, R
         ctypes.c_int64, ctypes.c_int64,              # pods_unit, r_pods
-        i64p, i64p, i64p, i64p,                      # out chosen/qty/packed/dropped
-        ctypes.c_int64,                              # max_records
+        i64p, i64p, i64p, i64p, i64p,                # chosen/offsets/pair_shape/pair_count/dropped
+        ctypes.c_int64, ctypes.c_int64,              # max_records, max_pairs
     ]
     return lib
 

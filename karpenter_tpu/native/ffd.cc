@@ -200,31 +200,79 @@ int64_t kt_ffd_pack(
 // skip-and-continue quirk (packable.go:111-130) collapses to skip-to-next-
 // shape without changing semantics.
 //
-// Outputs one record PER NODE (qty is always 1): decoding reuses the same
-// path as the fast-forward executors.
+// Outputs one record PER NODE (qty is always 1), in SPARSE form: record i
+// covers pairs [out_offsets[i], out_offsets[i+1]) of
+// (out_pair_shape, out_pair_count). A dense (records × S) matrix would be
+// O(pods × S) at high cardinality (50k nodes × 50k shapes ≈ 20 GB); the
+// pair total is instead bounded by Σ pods-per-node ≤ pods, so callers
+// allocate max_pairs = pods + S and never reallocate. Returns the record
+// count, or -1 if either capacity was too small.
 int64_t kt_ffd_pack_per_pod(
     const int64_t* shapes, const int64_t* counts_in,
     const int64_t* totals, const int64_t* reserved0,
     int64_t S, int64_t T, int64_t R, int64_t pods_unit, int64_t r_pods,
-    int64_t* out_chosen, int64_t* out_qty, int64_t* out_packed,
-    int64_t* out_dropped, int64_t max_records) {
+    int64_t* out_chosen, int64_t* out_offsets,
+    int64_t* out_pair_shape, int64_t* out_pair_count,
+    int64_t* out_dropped, int64_t max_records, int64_t max_pairs) {
   std::vector<int64_t> counts(counts_in, counts_in + S);
   std::vector<int64_t> dropped(S, 0);
   std::vector<int64_t> reserved(R);
-  std::vector<int64_t> packed(S);
-  std::vector<int64_t> best_packed(S);
   std::vector<int64_t> smallest_raw(R);
+  // per-pack_one (shape, pods) pairs — only touched shapes, so commit cost
+  // is O(pods-per-node), independent of S
+  std::vector<std::pair<int64_t, int64_t>> pairs, chosen_pairs;
+
+  // Active-shape skip list: next[s] = first shape index >= s with
+  // counts > 0 (S terminates). Consumed shapes are unlinked lazily with
+  // path compression during traversal, so pack_one visits only live
+  // shapes — at high cardinality (tens of thousands of distinct shapes) a
+  // plain counts[s]==0 skip scan would cost O(S) per type per node and
+  // dominate everything.
+  std::vector<int64_t> next(S + 1);
+  for (int64_t s = 0; s <= S; ++s) next[s] = s;
+  auto advance = [&](int64_t s) -> int64_t {
+    int64_t cur = s;
+    while (cur < S && counts[cur] == 0) {
+      int64_t hop = next[cur];
+      cur = (hop > cur) ? hop : cur + 1;
+    }
+    if (cur > s) next[s] = cur;  // compress for the next traversal
+    return cur;
+  };
 
   // pack_one (packable.go:111-130) of the remaining pod list onto type t.
-  // Returns pods packed; fills packed[s]. smallest_raw is the LAST pod's
-  // raw requests (no implicit pods:1) for the is_full_for early exit
-  // (packable.go:145-155).
+  // Returns pods packed; fills `pairs` with (shape, packed>0) entries.
+  // smallest_raw is the LAST pod's raw requests (no implicit pods:1) for
+  // the is_full_for early exit (packable.go:145-155).
+  //
+  // Failure-run jump: shapes are sorted descending LEXICOGRAPHICALLY with
+  // CPU as the primary dimension (encode() mirrors host_ffd.pack's sort),
+  // so once a pod fails and the pack continues (skip-and-continue,
+  // packable.go:128-130), every following shape with cpu > free_cpu must
+  // also fail its fit test — and since `reserved` is unchanged across a
+  // run of consecutive failures, is_full_for is CONSTANT over the run
+  // (checked once, at the run's first failure). Binary-searching past the
+  // cpu-infeasible prefix therefore preserves semantics exactly while
+  // cutting the wandering tail at high shape cardinality from O(S) fit
+  // tests to O(log S) per free-capacity level.
+  auto cpu_jump = [&](int64_t s, int64_t free_cpu) -> int64_t {
+    // smallest index > s with shapes[idx][0] <= free_cpu (cpu is dim 0,
+    // non-increasing); returns S when none
+    int64_t lo = s + 1, hi = S;
+    while (lo < hi) {
+      const int64_t mid = lo + (hi - lo) / 2;
+      if (shapes[mid * R + 0] > free_cpu) lo = mid + 1; else hi = mid;
+    }
+    return lo;
+  };
+
   auto pack_one = [&](int64_t t) -> int64_t {
     for (int64_t r = 0; r < R; ++r) reserved[r] = reserved0[t * R + r];
-    std::fill(packed.begin(), packed.end(), 0);
+    pairs.clear();
     int64_t total_packed = 0;
-    for (int64_t s = 0; s < S; ++s) {
-      if (counts[s] == 0) continue;
+    for (int64_t s = advance(0); s < S;) {
+      int64_t got = 0;
+      bool stop = false, give_up = false, failed = false;
       for (int64_t j = 0; j < counts[s]; ++j) {
         bool fits = true;
         for (int64_t r = 0; r < R; ++r) {
@@ -235,33 +283,44 @@ int64_t kt_ffd_pack_per_pod(
         }
         if (fits) {
           for (int64_t r = 0; r < R; ++r) reserved[r] += shapes[s * R + r];
-          ++packed[s];
+          ++got;
           ++total_packed;
           continue;
         }
         // is_full_for(smallest remaining pod): >= against any nonzero total
         for (int64_t r = 0; r < R; ++r) {
           if (totals[t * R + r] != 0 &&
-              reserved[r] + smallest_raw[r] >= totals[t * R + r])
-            return total_packed;           // rest unpacked (early exit)
+              reserved[r] + smallest_raw[r] >= totals[t * R + r]) {
+            stop = true;  // rest unpacked (early exit)
+            break;
+          }
         }
-        if (total_packed == 0) return 0;   // nothing packed yet → empty
+        if (!stop && total_packed == 0) give_up = true;  // empty pack
+        failed = true;
         break;  // this pod unpacked; later same-shape pods fail identically
+      }
+      if (got > 0) pairs.emplace_back(s, got);
+      if (give_up) return 0;
+      if (stop) return total_packed;
+      if (failed) {
+        // skip the cpu-infeasible run in O(log S); memory-bound failures
+        // inside the jump target region still step shape by shape
+        const int64_t free_cpu = totals[t * R + 0] - reserved[0];
+        const int64_t tgt = cpu_jump(s, free_cpu);
+        s = advance(tgt > s + 1 ? tgt : s + 1);
+      } else {
+        s = advance(s + 1);
       }
     }
     return total_packed;
   };
 
-  int64_t n_records = 0;
+  int64_t n_records = 0, n_pairs = 0;
   for (;;) {
-    int64_t largest = -1, smallest = -1;
-    for (int64_t s = 0; s < S; ++s) {
-      if (counts[s] > 0) {
-        if (largest < 0) largest = s;
-        smallest = s;
-      }
-    }
-    if (largest < 0) break;
+    const int64_t largest = advance(0);
+    if (largest >= S) break;
+    int64_t smallest = largest;
+    for (int64_t s = largest; s < S; s = advance(s + 1)) smallest = s;
     for (int64_t r = 0; r < R; ++r) {
       int64_t v = shapes[smallest * R + r];
       if (r == r_pods) v -= pods_unit;
@@ -281,21 +340,30 @@ int64_t kt_ffd_pack_per_pod(
     for (int64_t t = 0; t < T; ++t) {
       if (pack_one(t) == max_pods) {
         chosen = t;
-        best_packed = packed;
+        chosen_pairs = pairs;
         break;
       }
     }
-    if (chosen < 0) chosen = T - 1, pack_one(T - 1), best_packed = packed;
+    if (chosen < 0) {  // unreachable: T-1 achieved max_pods above
+      chosen = T - 1;
+      pack_one(T - 1);
+      chosen_pairs = pairs;
+    }
 
     if (n_records >= max_records) return -1;
+    if (n_pairs + static_cast<int64_t>(chosen_pairs.size()) > max_pairs)
+      return -1;
     out_chosen[n_records] = chosen;
-    out_qty[n_records] = 1;
-    for (int64_t s = 0; s < S; ++s) {
-      out_packed[n_records * S + s] = best_packed[s];
-      counts[s] -= best_packed[s];
+    out_offsets[n_records] = n_pairs;
+    for (const auto& [s, got] : chosen_pairs) {
+      out_pair_shape[n_pairs] = s;
+      out_pair_count[n_pairs] = got;
+      ++n_pairs;
+      counts[s] -= got;
     }
     ++n_records;
   }
+  out_offsets[n_records] = n_pairs;
 
   std::memcpy(out_dropped, dropped.data(), sizeof(int64_t) * S);
   return n_records;

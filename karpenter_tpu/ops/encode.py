@@ -25,7 +25,10 @@ INT32_LIMIT = 2**31 - 1
 
 # Pad shapes/types to these static sizes so XLA compiles one executable per
 # bucket pair instead of one per batch (SURVEY.md §7 "ragged shapes").
-SHAPE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+# The 8192 bucket serves heterogeneous clusters (50k pods with thousands of
+# distinct request vectors); the kernel's shape scan is block-tiled
+# (ops/pack.py) so the longer sequential axis stays scan-overhead-efficient.
+SHAPE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 TYPE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
 
 
@@ -68,6 +71,7 @@ def encode(
     pod_vecs: Sequence[Vec],
     pod_ids: Sequence[int],
     packables: Sequence[Packable],
+    pad: bool = True,
 ) -> Optional[EncodedProblem]:
     """Returns None when the problem can't be encoded exactly (host fallback).
 
@@ -78,6 +82,12 @@ def encode(
 
     All nano-unit arithmetic stays in Python ints until after GCD scaling
     (nano memory values overflow int64 beyond ~9Gi).
+
+    ``pad=True`` (the device path) pads to the static SHAPE/TYPE buckets and
+    fails beyond the largest bucket — XLA needs static shapes. ``pad=False``
+    (the native C++ executors) emits exact-size arrays with NO cardinality
+    limit: host kernels don't recompile per shape, so a 50k-distinct-shape
+    problem still gets an exact integer encoding.
     """
     if not packables:
         return None
@@ -100,9 +110,11 @@ def encode(
         shape_pods.append(pids)
 
     S, T = len(shape_vecs), len(packables)
-    SB, TB = bucket(S, SHAPE_BUCKETS), bucket(T, TYPE_BUCKETS)
-    if SB is None or TB is None:
-        return None
+    SB, TB = S, T
+    if pad:
+        SB, TB = bucket(S, SHAPE_BUCKETS), bucket(T, TYPE_BUCKETS)
+        if SB is None or TB is None:
+            return None
 
     # -- per-resource exact scaling -----------------------------------------
     columns = []
@@ -137,4 +149,33 @@ def encode(
         valid=valid, last_valid=T - 1, num_shapes=S, num_types=T,
         shape_pods=shape_pods, scales=scales,
         pods_unit=10**9 // scales[R_PODS],
+    )
+
+
+def pad_encoding(enc: EncodedProblem) -> Optional[EncodedProblem]:
+    """Pad an exact-size encoding (``encode(pad=False)``) to the static
+    device buckets; None above the largest bucket. Lets the solve path
+    encode ONCE and serve both the device ring (padded) and the native C++
+    ring (exact-size) without re-running the O(pods) dedupe + GCD scaling."""
+    S, T = enc.num_shapes, enc.num_types
+    if enc.shapes.shape[0] != S or enc.totals.shape[0] != T:
+        return enc  # already padded
+    SB, TB = bucket(S, SHAPE_BUCKETS), bucket(T, TYPE_BUCKETS)
+    if SB is None or TB is None:
+        return None
+    shapes = np.zeros((SB, NUM_RESOURCES), np.int32)
+    counts = np.zeros((SB,), np.int32)
+    totals = np.zeros((TB, NUM_RESOURCES), np.int32)
+    reserved0 = np.zeros((TB, NUM_RESOURCES), np.int32)
+    valid = np.zeros((TB,), bool)
+    shapes[:S] = enc.shapes
+    counts[:S] = enc.counts
+    totals[:T] = enc.totals
+    reserved0[:T] = enc.reserved0
+    valid[:T] = enc.valid
+    return EncodedProblem(
+        shapes=shapes, counts=counts, totals=totals, reserved0=reserved0,
+        valid=valid, last_valid=enc.last_valid, num_shapes=S, num_types=T,
+        shape_pods=enc.shape_pods, scales=enc.scales,
+        pods_unit=enc.pods_unit,
     )

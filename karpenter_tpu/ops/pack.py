@@ -22,7 +22,6 @@ All tensors are int32 (TPU-native); encode.py guarantees exactness.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -61,13 +60,25 @@ def pack_chunk(
     # Upper bound on any type's capacity fit per shape, from the initial
     # reservation (reserved only grows during a node pack). Fast-forward
     # validity needs counts to stay STRICTLY above this on every repeated
-    # round — see the derivation in docs/solver.md.
+    # round — see the derivation in docs/solver.md. Computed with an
+    # unrolled loop over R so peak memory is (S, T), never (S, T, R) —
+    # at the 8192-shape bucket the dense intermediate would be ~270 MB.
     avail0 = totals - reserved0  # (T, R)
-    kr0 = jnp.where(shapes[:, None, :] > 0,
-                    avail0[None, :, :] // jnp.maximum(shapes[:, None, :], 1),
-                    INT32_MAX)
-    kfit0 = jnp.min(kr0, axis=-1)  # (S, T)
+    kfit0 = jnp.full((S, T), INT32_MAX, jnp.int32)
+    for r in range(R):
+        col = shapes[:, r][:, None]  # (S, 1)
+        kr_r = jnp.where(col > 0, avail0[None, :, r] // jnp.maximum(col, 1),
+                         INT32_MAX)
+        kfit0 = jnp.minimum(kfit0, kr_r)
     maxfit = jnp.max(jnp.where(valid[None, :], kfit0, -1), axis=1)  # (S,)
+
+    # Block-tile the sequential shape axis: scan over S/B blocks with B
+    # steps unrolled inside each. Semantics are identical (the shapes are
+    # still consumed strictly in order); the tiling only amortizes per-step
+    # scan overhead, which dominates at the large shape buckets. Every
+    # SHAPE_BUCKET is a multiple of 8.
+    BLK = 8 if S % 8 == 0 else 1
+    n_blocks = S // BLK
 
     def node_iter(carry, _):
         counts, dropped, done = carry
@@ -77,10 +88,8 @@ def pack_chunk(
         # fits() uses raw requests (no implicit pods:1) — packable.go:118,146
         smallest_fits = jnp.maximum(shapes[smallest_idx] - pods_one, 0)
 
-        def shape_step(c2, s):
+        def one_shape(c2, shape, count):
             reserved, stopped, npacked = c2
-            shape = shapes[s]          # (R,)
-            count = counts[s]
             active = (count > 0) & (~stopped)
             avail = totals - reserved  # (T, R)
             kr = jnp.where(shape[None, :] > 0,
@@ -96,9 +105,21 @@ def pack_chunk(
             stopped = stopped | (failure & (full | (npacked == 0)))
             return (reserved, stopped, npacked), k
 
+        def block_step(c2, b):
+            base = b * BLK
+            blk_shapes = jax.lax.dynamic_slice(shapes, (base, 0), (BLK, R))
+            blk_counts = jax.lax.dynamic_slice(counts, (base,), (BLK,))
+            ks = []
+            for j in range(BLK):  # unrolled: one fused kernel per block
+                c2, k = one_shape(c2, blk_shapes[j], blk_counts[j])
+                ks.append(k)
+            return c2, jnp.stack(ks)  # (BLK, T)
+
         # inits derive from inputs so varying-axis types line up under shard_map
         init = (reserved0, ~valid, jnp.zeros_like(totals[:, 0]))
-        (_, _, npacked), k_all = jax.lax.scan(shape_step, init, jnp.arange(S))
+        (_, _, npacked), k_blocks = jax.lax.scan(
+            block_step, init, jnp.arange(n_blocks))
+        k_all = k_blocks.reshape(S, T)
         # k_all: (S, T) pods of each shape packed per candidate type
 
         max_pods = npacked[last_valid]

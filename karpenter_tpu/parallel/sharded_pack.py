@@ -15,10 +15,8 @@ schedules × shapes, and it rides ICI by sharding the batch axis.
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 

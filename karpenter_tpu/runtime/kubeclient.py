@@ -234,6 +234,15 @@ class KubeApiClient:
         return f"{prefix}/namespaces/{quote(namespace or 'default')}/{plural}/{quote(name)}"
 
     # -- CRUD ----------------------------------------------------------------
+    def scan(self, kind: str, fn):
+        """KubeCore.scan analog: over the wire there is no zero-copy read,
+        so this is list + map (same contract for callers)."""
+        return [fn(obj) for obj in self.list(kind)]
+
+    def read(self, kind: str, name: str, namespace: str, fn):
+        """KubeCore.read analog (a GET is unavoidable remotely)."""
+        return fn(self.get(kind, name, namespace))
+
     def get(self, kind: str, name: str, namespace: str = "default"):
         return _decode(kind, self._request("GET", self._item(kind, name, namespace)))
 

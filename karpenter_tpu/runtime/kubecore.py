@@ -21,15 +21,16 @@ Semantics implemented:
 
 from __future__ import annotations
 
-import copy
+import copy  # noqa: F401 — external callers may rely on module parity
 import itertools
 import queue
 import threading
 from dataclasses import dataclass
-from typing import Callable, Dict, Iterable, List, Optional, Tuple, Type
+from typing import Callable, Dict, List, Optional, Tuple
 
-from karpenter_tpu.api.core import LabelSelector, Node, Pod
+from karpenter_tpu.api.core import LabelSelector, Pod
 from karpenter_tpu.utils import clock
+from karpenter_tpu.utils.fastcopy import deep_copy
 
 
 class ApiError(Exception):
@@ -102,7 +103,7 @@ class KubeCore:
     def _notify(self, event_type: str, obj) -> None:
         for kind, q in self._watchers:
             if kind is None or kind == obj.kind:
-                q.put(Event(event_type, copy.deepcopy(obj)))
+                q.put(Event(event_type, deep_copy(obj)))
 
     # -- watch --------------------------------------------------------------
     def watch(self, kind: Optional[str] = None) -> "queue.Queue[Event]":
@@ -112,7 +113,7 @@ class KubeCore:
         with self._lock:
             for obj in self._objects.values():
                 if kind is None or obj.kind == kind:
-                    q.put(Event("ADDED", copy.deepcopy(obj)))
+                    q.put(Event("ADDED", deep_copy(obj)))
             self._watchers.append((kind, q))
         return q
 
@@ -126,7 +127,7 @@ class KubeCore:
             k = _key(obj)
             if k in self._objects:
                 raise AlreadyExists(f"{k} already exists")
-            obj = copy.deepcopy(obj)
+            obj = deep_copy(obj)
             obj.metadata.resource_version = self._next_rv()
             obj.metadata.uid = obj.metadata.uid or f"uid-{next(self._uid)}"
             if obj.metadata.creation_timestamp is None:
@@ -134,14 +135,34 @@ class KubeCore:
             self._objects[k] = obj
             self._reindex(k, None, obj)
             self._notify("ADDED", obj)
-            return copy.deepcopy(obj)
+            return deep_copy(obj)
 
     def get(self, kind: str, name: str, namespace: str = "default"):
         with self._lock:
             obj = self._objects.get((kind, namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
-            return copy.deepcopy(obj)
+            return deep_copy(obj)
+
+    def scan(self, kind: str, fn) -> List:
+        """Apply ``fn`` to every live object of ``kind`` under the store
+        lock, WITHOUT copying, and return the results. The informer-cache
+        read analog (controller-runtime reads list from the shared cache):
+        ``fn`` must treat the object as read-only and must not retain it.
+        Exists because deep-copying a 10k-pod list per poll costs seconds —
+        three orders more than extracting one field from each."""
+        with self._lock:
+            return [fn(obj) for (k, _, _), obj in self._objects.items()
+                    if k == kind]
+
+    def read(self, kind: str, name: str, namespace: str, fn):
+        """Apply ``fn`` to one live object under the lock (no copy); raises
+        NotFound. Same read-only contract as :meth:`scan`."""
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return fn(obj)
 
     def list(
         self,
@@ -173,7 +194,7 @@ class KubeCore:
                     continue
                 if label_selector is not None and not label_selector.matches(obj.metadata.labels):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(deep_copy(obj))
             return out
 
     def update(self, obj):
@@ -188,7 +209,7 @@ class KubeCore:
                 raise Conflict(
                     f"{k}: stale resourceVersion "
                     f"{obj.metadata.resource_version} != {stored.metadata.resource_version}")
-            obj = copy.deepcopy(obj)
+            obj = deep_copy(obj)
             # deletionTimestamp is immutable via update
             obj.metadata.deletion_timestamp = stored.metadata.deletion_timestamp
             obj.metadata.resource_version = self._next_rv()
@@ -196,11 +217,11 @@ class KubeCore:
                 del self._objects[k]
                 self._reindex(k, stored, None)
                 self._notify("DELETED", obj)
-                return copy.deepcopy(obj)
+                return deep_copy(obj)
             self._objects[k] = obj
             self._reindex(k, stored, obj)
             self._notify("MODIFIED", obj)
-            return copy.deepcopy(obj)
+            return deep_copy(obj)
 
     def patch(self, kind: str, name: str, namespace: str, fn: Callable[[object], None]):
         """Read-modify-write with retry-free server-side apply semantics:
@@ -209,7 +230,7 @@ class KubeCore:
             stored = self._objects.get((kind, namespace, name))
             if stored is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
-            obj = copy.deepcopy(stored)
+            obj = deep_copy(stored)
             fn(obj)
             obj.metadata.deletion_timestamp = stored.metadata.deletion_timestamp
             obj.metadata.resource_version = self._next_rv()
@@ -217,11 +238,11 @@ class KubeCore:
                 del self._objects[(kind, namespace, name)]
                 self._reindex((kind, namespace, name), stored, None)
                 self._notify("DELETED", obj)
-                return copy.deepcopy(obj)
+                return deep_copy(obj)
             self._objects[(kind, namespace, name)] = obj
             self._reindex((kind, namespace, name), stored, obj)
             self._notify("MODIFIED", obj)
-            return copy.deepcopy(obj)
+            return deep_copy(obj)
 
     def delete(self, kind: str, name: str, namespace: str = "default"):
         """Delete; with finalizers present, only stamps deletionTimestamp."""
@@ -240,11 +261,11 @@ class KubeCore:
                     stored.metadata.deletion_timestamp = clock.now() + grace
                     stored.metadata.resource_version = self._next_rv()
                     self._notify("MODIFIED", stored)
-                return copy.deepcopy(stored)
+                return deep_copy(stored)
             del self._objects[k]
             self._reindex(k, stored, None)
             self._notify("DELETED", stored)
-            return copy.deepcopy(stored)
+            return deep_copy(stored)
 
     # -- subresources -------------------------------------------------------
     def bind_pod(self, pod: Pod, node_name: str) -> None:

@@ -14,7 +14,7 @@ import logging
 import queue
 import threading
 import time
-from typing import Dict, List, Optional, Protocol, Set, Tuple
+from typing import List, Optional, Protocol, Set, Tuple
 
 from karpenter_tpu.runtime.kubecore import KubeCore
 

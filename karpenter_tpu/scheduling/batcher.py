@@ -15,7 +15,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Any, List, Optional, Tuple
+from typing import Any, List, Tuple
 
 
 class Batcher:
@@ -32,12 +32,17 @@ class Batcher:
         self._lock = threading.Lock()
         self._gate = threading.Event()
         self._running = True
+        # monotonic add counter: lets synchronizers (tests/expectations.py)
+        # tell which batchers actually received work — a gate on an empty
+        # batcher never flushes (wait() blocks on the first item)
+        self.added_total = 0
 
     def add(self, item: Any) -> threading.Event:
         """Enqueue an item; returns the gate event the caller may wait on
         (batcher.go:61-69)."""
         self._queue.put(item)
         with self._lock:
+            self.added_total += 1
             return self._gate
 
     def flush(self) -> None:

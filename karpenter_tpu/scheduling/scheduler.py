@@ -10,7 +10,7 @@ device solver (parallel/sharded_pack.py) embarrassingly parallel.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
+from typing import Dict, List
 
 from karpenter_tpu.api.constraints import Constraints
 from karpenter_tpu.api.core import Pod

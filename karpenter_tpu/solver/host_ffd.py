@@ -30,7 +30,7 @@ Quirks preserved (with reference cites):
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
 # Fixed resource vector layout. EXOTIC is a synthetic dimension: 1 if the pod

@@ -31,6 +31,7 @@ def solve_ffd_native(
     max_instance_types: int = MAX_INSTANCE_TYPES,
     prices=None,                 # per-packable effective $/h (cost mode)
     cost_tiebreak: bool = False,
+    enc=None,                    # precomputed encoding (unpadded or padded)
 ) -> Optional[HostSolveResult]:
     """None when the native library or an exact encoding is unavailable."""
     lib = native.load()
@@ -38,7 +39,9 @@ def solve_ffd_native(
         return None
     if not packables:
         return HostSolveResult(packings=[], unschedulable=list(pod_ids))
-    enc = encode(pod_vecs, pod_ids, packables)
+    if enc is None:
+        # pad=False: host kernels take exact-size arrays, no cardinality limit
+        enc = encode(pod_vecs, pod_ids, packables, pad=False)
     if enc is None:
         return None
 
@@ -48,7 +51,14 @@ def solve_ffd_native(
     totals = np.ascontiguousarray(enc.totals[:T], np.int64)
     reserved0 = np.ascontiguousarray(enc.reserved0[:T], np.int64)
 
-    max_records = _MAX_RECORDS_FACTOR * S * max(T, 1) + 16
+    # every record commits ≥1 pod, so pods+S bounds records; the S×T term
+    # is the old generous bound, kept for tiny problems
+    max_records = min(_MAX_RECORDS_FACTOR * S * max(T, 1),
+                      len(pod_vecs) + S) + 16
+    if max_records * S * 8 > 512 * 1024 * 1024:
+        # dense (records × S) output would not fit; the per-pod kernel's
+        # sparse ABI is the right executor at this cardinality
+        return None
     out_chosen = np.zeros(max_records, np.int64)
     out_qty = np.zeros(max_records, np.int64)
     out_packed = np.zeros((max_records, S), np.int64)
@@ -82,11 +92,54 @@ def solve_ffd_native(
     return _decode(enc, records, out_dropped, packables, max_instance_types)
 
 
+# Above this many distinct shapes the shape-level greedy (dense S×T pass per
+# node, fast-forward rarely collapsing anything) loses to the per-pod
+# kernel's is_full_for early exit + active-shape skip list. The device path
+# caps at the 8192-shape bucket (ops/encode.py); beyond the crossover the
+# per-pod kernel carries arbitrary cardinality at the Go packer's speed.
+PER_POD_SHAPE_CROSSOVER = 2048
+
+
+def solve_ffd_native_auto(
+    pod_vecs: Sequence[Vec],
+    pod_ids: Sequence[int],
+    packables: Sequence[Packable],
+    max_instance_types: int = MAX_INSTANCE_TYPES,
+    prices=None,
+    cost_tiebreak: bool = False,
+    enc=None,                    # precomputed UNPADDED encoding
+) -> Optional[HostSolveResult]:
+    """Route to the C++ executor suited to the problem's shape cardinality.
+    The per-pod kernel has no cost-tie-break mode (the cost model rides the
+    shape-level executors), so cost solves always take the shape-level
+    kernel. If the shape-level kernel declines (its dense record output has
+    a memory guard), the per-pod kernel's sparse ABI answers instead —
+    mid-cardinality problems must never fall through to the pure-Python
+    oracle."""
+    per_pod_tried = False
+    if not cost_tiebreak:
+        distinct = enc.num_shapes if enc is not None else len(set(pod_vecs))
+        if distinct > PER_POD_SHAPE_CROSSOVER:
+            per_pod_tried = True
+            result = solve_ffd_per_pod_native(
+                pod_vecs, pod_ids, packables, max_instance_types, enc=enc)
+            if result is not None:
+                return result
+    result = solve_ffd_native(pod_vecs, pod_ids, packables, max_instance_types,
+                              prices=prices, cost_tiebreak=cost_tiebreak,
+                              enc=enc)
+    if result is None and not cost_tiebreak and not per_pod_tried:
+        result = solve_ffd_per_pod_native(
+            pod_vecs, pod_ids, packables, max_instance_types, enc=enc)
+    return result
+
+
 def solve_ffd_per_pod_native(
     pod_vecs: Sequence[Vec],
     pod_ids: Sequence[int],
     packables: Sequence[Packable],
     max_instance_types: int = MAX_INSTANCE_TYPES,
+    enc=None,                    # precomputed encoding (unpadded or padded)
 ) -> Optional[HostSolveResult]:
     """The per-POD Go-semantics oracle on the C++ kernel
     (kt_ffd_pack_per_pod) — the same algorithm as host_ffd.pack
@@ -98,7 +151,10 @@ def solve_ffd_per_pod_native(
         return None
     if not packables:
         return HostSolveResult(packings=[], unschedulable=list(pod_ids))
-    enc = encode(pod_vecs, pod_ids, packables)
+    if enc is None:
+        # pad=False: no shape-cardinality limit (the skip-listed C++ kernel
+        # handles tens of thousands of distinct shapes at Go speed)
+        enc = encode(pod_vecs, pod_ids, packables, pad=False)
     if enc is None:
         return None
 
@@ -109,9 +165,11 @@ def solve_ffd_per_pod_native(
     reserved0 = np.ascontiguousarray(enc.reserved0[:T], np.int64)
 
     max_records = len(pod_vecs) + 1  # one record per node; nodes ≤ pods
+    max_pairs = len(pod_vecs) + S + 1  # Σ pods-per-node ≤ pods (sparse ABI)
     out_chosen = np.zeros(max_records, np.int64)
-    out_qty = np.zeros(max_records, np.int64)
-    out_packed = np.zeros((max_records, S), np.int64)
+    out_offsets = np.zeros(max_records + 1, np.int64)
+    out_pair_shape = np.zeros(max_pairs, np.int64)
+    out_pair_count = np.zeros(max_pairs, np.int64)
     out_dropped = np.zeros(S, np.int64)
 
     import ctypes
@@ -122,13 +180,15 @@ def solve_ffd_per_pod_native(
     n = lib.kt_ffd_pack_per_pod(
         ptr(shapes), ptr(counts), ptr(totals), ptr(reserved0),
         S, T, shapes.shape[1], int(enc.pods_unit), R_PODS,
-        ptr(out_chosen), ptr(out_qty), ptr(out_packed), ptr(out_dropped),
-        max_records)
+        ptr(out_chosen), ptr(out_offsets), ptr(out_pair_shape),
+        ptr(out_pair_count), ptr(out_dropped), max_records, max_pairs)
     if n < 0:
         return None
 
     records = [
-        (int(out_chosen[i]), int(out_qty[i]), out_packed[i])
+        (int(out_chosen[i]), 1,
+         [(int(out_pair_shape[j]), int(out_pair_count[j]))
+          for j in range(int(out_offsets[i]), int(out_offsets[i + 1]))])
         for i in range(n)
     ]
     return _decode(enc, records, out_dropped, packables, max_instance_types)

@@ -37,6 +37,13 @@ class SolverConfig:
     # (tens of ms over the transport vs sub-ms native solve); the native/
     # host executors answer instead — same result, differential-tested
     device_min_pods: int = 512
+    # above this many DISTINCT pod shapes the device path declines: the
+    # fast-forward rarely collapses nodes at high cardinality, so a solve
+    # needs many record chunks — each a tunnel round trip — while the
+    # per-pod C++ kernel (skip list + cpu-jump) answers in one host pass.
+    # The kernel itself supports up to the 8192-shape bucket; raise this on
+    # local-TPU deployments where the round trip is cheap.
+    device_max_shapes: int = 4096
     # prefer the C++ kernel over the per-pod Python oracle for host solves
     use_native: bool = True
     # order each node's instance-type options cheapest-first when the
@@ -114,8 +121,18 @@ def solve_with_packables(
             for p in packables
         ]
 
+    # ONE exact encoding feeds every ring: the device path pads it to the
+    # static buckets, the native C++ path uses it as-is — the O(pods)
+    # dedupe + GCD scaling is never repeated across fallbacks
+    enc = None
+    if config.use_device or config.use_native:
+        from karpenter_tpu.ops.encode import encode
+
+        enc = encode(pod_vecs, pod_ids, packables, pad=False)
+
     result = None
-    if config.use_device and len(pods) >= config.device_min_pods:
+    if config.use_device and len(pods) >= config.device_min_pods and \
+            enc is not None:
         try:
             with trace("karpenter.solve.device"):
                 result = solve_ffd_device(
@@ -123,18 +140,19 @@ def solve_with_packables(
                     max_instance_types=config.max_instance_types,
                     chunk_iters=config.chunk_iters,
                     kernel=config.device_kernel,
-                    prices=prices, cost_tiebreak=prices is not None)
+                    prices=prices, cost_tiebreak=prices is not None,
+                    max_shapes=config.device_max_shapes, enc=enc)
         except Exception:  # device failure ring: never drop a provisioning loop
             log.exception("device solve failed; falling back to host FFD")
             result = None
     if result is None and config.use_native:
-        from karpenter_tpu.solver.native_ffd import solve_ffd_native
+        from karpenter_tpu.solver.native_ffd import solve_ffd_native_auto
 
         try:
-            result = solve_ffd_native(
+            result = solve_ffd_native_auto(
                 pod_vecs, pod_ids, packables,
                 max_instance_types=config.max_instance_types,
-                prices=prices, cost_tiebreak=prices is not None)
+                prices=prices, cost_tiebreak=prices is not None, enc=enc)
         except Exception:  # same failure posture as the device ring
             log.exception("native solve failed; falling back to host FFD")
             result = None

@@ -7,7 +7,7 @@ this module so tests can travel in time deterministically.
 from __future__ import annotations
 
 import time as _time
-from typing import Callable, Optional
+from typing import Optional
 
 
 class Clock:

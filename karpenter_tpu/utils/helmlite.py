@@ -1,0 +1,91 @@
+"""Minimal Helm-compatible chart renderer.
+
+The chart (charts/karpenter-tpu) deliberately restricts its templates to
+plain ``{{ .Values.path.to.key }}`` substitutions — no pipes, conditionals,
+or sprig functions — so that `helm template` (CI, operators) and this
+renderer (golden tests, environments without helm) produce byte-identical
+output. Reference chart being mirrored: charts/karpenter/{values.yaml,
+templates/}.
+
+CLI: ``python -m karpenter_tpu.utils.helmlite charts/karpenter-tpu
+[--set a.b.c=v ...]`` prints the rendered multi-document YAML.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Any, Dict, List
+
+_SUBST = re.compile(r"\{\{\s*\.Values\.([A-Za-z0-9_.]+)\s*\}\}")
+
+
+def _lookup(values: Dict[str, Any], dotted: str):
+    cur: Any = values
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            raise KeyError(f"values key not found: .Values.{dotted}")
+        cur = cur[part]
+    return cur
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"  # Go/Helm bool rendering
+    return str(v)
+
+
+def render_text(template: str, values: Dict[str, Any]) -> str:
+    return _SUBST.sub(lambda m: _fmt(_lookup(values, m.group(1))), template)
+
+
+def load_values(chart_dir: str, overrides: Dict[str, Any] = None) -> Dict[str, Any]:
+    import yaml
+
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    for dotted, v in (overrides or {}).items():
+        cur = values
+        parts = dotted.split(".")
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = v
+    return values
+
+
+def render_chart(chart_dir: str, overrides: Dict[str, Any] = None) -> str:
+    """All templates/*.yaml rendered and joined with '---' separators, in
+    sorted filename order (helm renders alphabetically too)."""
+    values = load_values(chart_dir, overrides)
+    tdir = os.path.join(chart_dir, "templates")
+    docs: List[str] = []
+    for fname in sorted(os.listdir(tdir)):
+        if not fname.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(tdir, fname)) as f:
+            docs.append(render_text(f.read(), values).strip())
+    return "\n---\n".join(docs) + "\n"
+
+
+def main(argv: List[str]) -> int:
+    if not argv or argv[0].startswith("-"):
+        print("usage: helmlite <chart-dir> [--set a.b=c ...]", file=sys.stderr)
+        return 2
+    chart_dir = argv[0]
+    overrides: Dict[str, Any] = {}
+    args = argv[1:]
+    while args:
+        if args[0] == "--set" and len(args) >= 2:
+            k, _, v = args[1].partition("=")
+            overrides[k] = v
+            args = args[2:]
+        else:
+            print(f"unknown argument {args[0]}", file=sys.stderr)
+            return 2
+    sys.stdout.write(render_chart(chart_dir, overrides))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

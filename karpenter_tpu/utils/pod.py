@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from karpenter_tpu.api.core import Pod, Toleration
+from karpenter_tpu.api.core import Pod
 
 
 def failed_to_schedule(pod: Pod) -> bool:

@@ -15,7 +15,7 @@ hostile to vectorization, so this framework splits the concern:
 from __future__ import annotations
 
 import re
-from typing import Dict, Iterable, Mapping, Optional, Union
+from typing import Dict, Mapping, Optional, Union
 
 NANO = 10**9
 

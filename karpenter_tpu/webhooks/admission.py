@@ -11,7 +11,6 @@ expose). Cloud providers hook in via spi.CloudProvider.default/validate
 from __future__ import annotations
 
 import re
-import string
 from typing import List, Optional
 
 from karpenter_tpu.api import wellknown

@@ -65,10 +65,20 @@ def make_provisioner(name: str = "default", constraints: Optional[Constraints] =
 def expect_provisioned(kube: KubeCore, selection, provisioning, pods: List[Pod],
                        timeout: float = 15.0) -> List[Pod]:
     """ExpectProvisioned (expectations.go): create pods, drive selection
-    reconciles concurrently (each blocks on the batch gate), wait for the
-    provisioning worker to bind, return the stored pods."""
+    reconciles concurrently, then wait for the provisioning worker's batch
+    gate to flush (selection is non-blocking by default — the gate wait
+    moved HERE, where the reference's expectation helper also synchronizes
+    on the provisioning pass)."""
     for pod in pods:
         kube.create(pod)
+    # capture each worker's CURRENT window gate (and add counter) before
+    # enqueueing: the provisioning pass that consumes this window sets
+    # exactly this gate (Batcher.flush), giving the same post-batch
+    # synchronization the old blocking selection path provided
+    before = {
+        name: (worker.batcher._gate, worker.batcher.added_total)
+        for name, worker in provisioning.workers.items()
+    }
     with ThreadPoolExecutor(max_workers=max(1, len(pods))) as pool:
         futures = [
             pool.submit(selection.reconcile, p.metadata.name, p.metadata.namespace)
@@ -76,6 +86,13 @@ def expect_provisioned(kube: KubeCore, selection, provisioning, pods: List[Pod],
         ]
         for f in futures:
             f.result(timeout=timeout)
+    # wait only on workers that actually RECEIVED pods (a gate on an empty
+    # batcher never flushes — wait() blocks on the first item), and fail
+    # loudly if a receiving window never got provisioned
+    for name, (gate, added0) in before.items():
+        if provisioning.workers[name].batcher.added_total > added0:
+            assert gate.wait(timeout=timeout), (
+                f"provisioner {name} batch window never flushed")
     return [kube.get("Pod", p.metadata.name, p.metadata.namespace) for p in pods]
 
 

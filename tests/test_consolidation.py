@@ -265,7 +265,7 @@ class TestConsolidationController:
         provisioning = ProvisioningController(
             kube, provider,
             batcher_factory=lambda: Batcher(idle_seconds=0.05, max_seconds=2.0))
-        selection = SelectionController(kube, provisioning)
+        selection = SelectionController(kube, provisioning, gate_timeout=30.0)
         termination = TerminationController(kube, provider)
         consolidation = ConsolidationController(kube)
         yield kube, catalog, provider, provisioning, selection, termination, consolidation

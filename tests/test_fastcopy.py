@@ -1,0 +1,85 @@
+"""fastcopy.deep_copy ≡ copy.deepcopy on the core object model — the
+in-memory apiserver's isolation guarantee rides on this equivalence."""
+
+import copy
+
+from karpenter_tpu.api.core import (
+    Affinity, Container, Node, NodeAffinity, NodeSelectorRequirement,
+    NodeSelectorTerm, NodeSpec, NodeStatus, ObjectMeta, Pod, PodCondition,
+    PodSpec, PodStatus, ResourceRequirements, Taint, Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.utils.fastcopy import deep_copy
+from karpenter_tpu.utils.resources import parse_resource_list
+
+
+def full_pod() -> Pod:
+    return Pod(
+        metadata=ObjectMeta(
+            name="p", namespace="ns", labels={"a": "b"},
+            annotations={"k": "v"}, finalizers=["f1"], resource_version=7),
+        spec=PodSpec(
+            node_name="n1",
+            node_selector={"zone": "us-west-2a"},
+            containers=[Container(resources=ResourceRequirements.make(
+                requests={"cpu": "250m", "memory": "1Gi",
+                          "nvidia.com/gpu": "1"},
+                limits={"cpu": "1"}))],
+            tolerations=[Toleration(key="t", operator="Exists")],
+            affinity=Affinity(node_affinity=NodeAffinity(required=[
+                NodeSelectorTerm(match_expressions=[
+                    NodeSelectorRequirement(key="k", operator="In",
+                                            values=["v1", "v2"])])])),
+            topology_spread_constraints=[TopologySpreadConstraint(
+                max_skew=2, topology_key="zone")],
+        ),
+        status=PodStatus(phase="Pending", conditions=[
+            PodCondition(type="PodScheduled", status="False",
+                         reason="Unschedulable")]),
+    )
+
+
+class TestDeepCopy:
+    def test_pod_equivalent_and_isolated(self):
+        pod = full_pod()
+        got = deep_copy(pod)
+        assert got == pod
+        assert got is not pod
+        got.spec.containers[0].resources.requests["cpu"].nano += 1
+        got.metadata.labels["a"] = "mutated"
+        got.spec.tolerations.append(Toleration(key="x"))
+        assert pod != got
+        assert pod.metadata.labels["a"] == "b"
+        assert len(pod.spec.tolerations) == 1
+
+    def test_matches_copy_deepcopy(self):
+        pod = full_pod()
+        assert deep_copy(pod) == copy.deepcopy(pod)
+
+    def test_node(self):
+        node = Node(
+            metadata=ObjectMeta(name="n", namespace="",
+                                labels={"type": "m5.large"}),
+            spec=NodeSpec(taints=[Taint(key="k", value="v")],
+                          unschedulable=True, provider_id="aws:///i-1"),
+            status=NodeStatus(allocatable=parse_resource_list(
+                {"cpu": "4", "memory": "16Gi"})))
+        got = deep_copy(node)
+        assert got == node
+        got.status.allocatable["cpu"].nano = 0
+        assert node.status.allocatable["cpu"].nano == 4 * 10**9
+
+    def test_marshal_cache_carried(self):
+        from karpenter_tpu.solver.adapter import pod_vector
+
+        pod = full_pod()
+        vec = pod_vector(pod)
+        clone = deep_copy(pod)
+        assert clone.__dict__["_marshal"][0] == vec
+
+    def test_atomics_and_containers(self):
+        src = {"a": [1, "x", (2.5, None)], "b": {"c"}, "d": frozenset({"e"})}
+        got = deep_copy(src)
+        assert got == src
+        got["a"].append("y")
+        assert len(src["a"]) == 3

@@ -31,7 +31,7 @@ def env():
     provisioning = ProvisioningController(
         kube, provider,
         batcher_factory=lambda: Batcher(idle_seconds=0.05, max_seconds=2.0))
-    selection = SelectionController(kube, provisioning)
+    selection = SelectionController(kube, provisioning, gate_timeout=30.0)
     yield kube, provider, provisioning, selection
     for w in provisioning.workers.values():
         w.stop()
