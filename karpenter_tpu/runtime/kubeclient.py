@@ -33,6 +33,7 @@ from urllib.parse import quote, urlencode, urlsplit
 
 from karpenter_tpu.api import codec, codec_core
 from karpenter_tpu.api.core import LabelSelector, Pod
+from karpenter_tpu.utils.fastcopy import deep_copy
 from karpenter_tpu.runtime.kubecore import (
     AlreadyExists, ApiError, Conflict, Event, NotFound,
 )
@@ -142,6 +143,33 @@ class KubeApiClient:
         # it and unblock the thread's read immediately (not after the 300 s
         # socket timeout)
         self._watch_conns: Dict[int, http.client.HTTPConnection] = {}
+        # one persistent keep-alive connection PER THREAD: the controller
+        # plane issues thousands of small requests per provisioning pass,
+        # and a connection per request both costs a TCP handshake each and
+        # overruns the apiserver's accept backlog under the 64-worker
+        # selection plane (observed as ECONNRESET at 1k-pod wire load)
+        self._local = threading.local()
+        # informer read cache (the controller-runtime cached-client analog,
+        # SURVEY.md L1 "client cache/indexer"): kinds with an active watch
+        # serve get/list/scan/read from watch-fed local state instead of
+        # the wire. The Go reference reads its informer cache for free —
+        # without this, the selection plane's requeue re-verification GETs
+        # alone saturate the 200 QPS budget at the 10k-pod regime. Writes
+        # (update/patch/delete/create) always go to the server; staleness
+        # semantics match controller-runtime (optimistic concurrency
+        # conflicts catch stale writes; patch re-reads LIVE).
+        self._cache_lock = threading.Lock()
+        self._read_cache: Dict[Tuple[str, str, str], object] = {}
+        # SINGLE-WRITER cache: exactly one watch per kind (the "feeder",
+        # the first watch opened for it) writes the cache — its LIST and
+        # stream run sequentially in one thread, so snapshot replaces can
+        # never race a concurrent stream's deletes (the classic informer
+        # resync hazard). Other watches of the same kind are read-only
+        # passengers. A kind serves reads only after its feeder's first
+        # LIST lands (_cached_kinds).
+        self._cache_feeder: Dict[str, int] = {}   # kind → id(feeder queue)
+        self._cached_kinds: set = set()           # kinds safe to serve
+        self._watch_kind_by_queue: Dict[int, str] = {}
 
     @classmethod
     def in_cluster(cls, qps: float = 200.0, burst: int = 300) -> "KubeApiClient":
@@ -173,18 +201,55 @@ class KubeApiClient:
             h["Content-Type"] = content_type
         return h
 
+    def _drop_conn(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        self._local.conn = None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
     def _request(self, method: str, path: str, body: Optional[Dict] = None,
                  content_type: str = "application/json",
                  _throttle_retries: int = 2) -> Dict:
         self._limiter.acquire()
-        conn = self._conn()
+        payload = json.dumps(body) if body is not None else None
+        headers = self._headers(content_type if body is not None else None)
+        # transport ring: a stale keep-alive (server closed it idle) or a
+        # reset mid-flight gets ONE retry on a fresh connection — client-go
+        # does the same; a connection blip must not fail a reconcile.
+        # Non-idempotent POSTs are only retried when the failure happened
+        # BEFORE the request was fully sent (send-phase errors) — and to
+        # keep POSTs off stale sockets in the first place, a connection
+        # idle past the typical server keep-alive window is proactively
+        # replaced (a small request body writes "successfully" into a
+        # half-closed socket, so the send-phase guard alone can't see it).
+        import time as _time
+
+        now = _time.monotonic()
+        if getattr(self._local, "conn", None) is not None and \
+                now - getattr(self._local, "last_used", 0.0) > 30.0:
+            self._drop_conn()
+        self._local.last_used = now
+        for attempt in (0, 1):
+            conn = getattr(self._local, "conn", None)
+            if conn is None:
+                conn = self._local.conn = self._conn()
+            sent = False
+            try:
+                conn.request(method, path, body=payload, headers=headers)
+                sent = True
+                resp = conn.getresponse()
+                data = resp.read()
+                break
+            except (ConnectionError, http.client.HTTPException, OSError) as e:
+                self._drop_conn()
+                retriable = method in ("GET", "PUT", "DELETE") or not sent
+                if attempt == 0 and retriable:
+                    continue
+                raise ApiError(f"{method} {path}: transport failure: {e}")
         try:
-            conn.request(method, path,
-                         body=json.dumps(body) if body is not None else None,
-                         headers=self._headers(content_type if body is not None
-                                               else None))
-            resp = conn.getresponse()
-            data = resp.read()
             if resp.status == 404:
                 raise NotFound(f"{method} {path}: not found")
             if resp.status == 409:
@@ -208,7 +273,6 @@ class KubeApiClient:
                         delay = max(0.0, min(float(retry_after), 5.0))
                     except (TypeError, ValueError):
                         delay = 1.0
-                    conn.close()
                     _time.sleep(delay)
                     return self._request(method, path, body, content_type,
                                          _throttle_retries - 1)
@@ -217,8 +281,11 @@ class KubeApiClient:
                 raise ApiError(
                     f"{method} {path}: HTTP {resp.status}: {data[:300]!r}")
             return json.loads(data) if data else {}
-        finally:
-            conn.close()
+        except http.client.HTTPException:
+            # response-state confusion on the shared connection: drop it so
+            # the next request starts clean
+            self._drop_conn()
+            raise
 
     # -- paths ---------------------------------------------------------------
     def _collection(self, kind: str, namespace: Optional[str]) -> str:
@@ -234,21 +301,116 @@ class KubeApiClient:
         return f"{prefix}/namespaces/{quote(namespace or 'default')}/{plural}/{quote(name)}"
 
     # -- CRUD ----------------------------------------------------------------
+    def _cache_list(self, kind: str, namespace, label_selector, field):
+        """List served from the watch-fed cache when the kind is watched
+        (controller-runtime cached-client List semantics); None = go live."""
+        with self._cache_lock:
+            if kind not in self._cached_kinds:
+                return None
+            objs = [obj for (k, _, _), obj in self._read_cache.items()
+                    if k == kind]
+            out = []
+            for obj in objs:
+                if namespace is not None and obj.metadata.namespace != namespace:
+                    continue
+                if label_selector is not None and not label_selector.matches(
+                        obj.metadata.labels):
+                    continue
+                if field is not None:
+                    fname, fval = field
+                    if fname != "spec.nodeName":
+                        return None  # unsupported locally: go live
+                    if getattr(obj.spec, "node_name", None) != fval:
+                        continue
+                out.append(deep_copy(obj))
+            return out
+
     def scan(self, kind: str, fn):
-        """KubeCore.scan analog: over the wire there is no zero-copy read,
-        so this is list + map (same contract for callers)."""
+        """KubeCore.scan analog. Cache-served kinds snapshot the object
+        references under the lock, then map OUTSIDE it — ``fn`` may call
+        back into the client (get/list take the same non-reentrant lock),
+        and entries are replaced wholesale, never mutated in place, so the
+        read-only contract holds without holding the lock."""
+        with self._cache_lock:
+            if kind in self._cached_kinds:
+                objs = [obj for (k, _, _), obj in
+                        self._read_cache.items() if k == kind]
+            else:
+                objs = None
+        if objs is not None:
+            return [fn(obj) for obj in objs]
         return [fn(obj) for obj in self.list(kind)]
 
     def read(self, kind: str, name: str, namespace: str, fn):
-        """KubeCore.read analog (a GET is unavoidable remotely)."""
-        return fn(self.get(kind, name, namespace))
+        """KubeCore.read analog: cache-served when watched; a miss falls
+        through live (a just-created object may not have reached the watch
+        yet). ``fn`` runs outside the lock (see scan)."""
+        with self._cache_lock:
+            obj = (self._read_cache.get(self._cache_key(kind, name, namespace))
+                   if kind in self._cached_kinds else None)
+        if obj is not None:
+            return fn(obj)
+        return fn(self._get_live(kind, name, namespace))
+
+    def _cache_key(self, kind: str, name: str,
+                   namespace: Optional[str]) -> Tuple[str, str, str]:
+        cluster = ROUTES[kind][2]
+        return (kind, "" if cluster else (namespace or "default"), name)
+
+    def _cache_lookup(self, kind: str, name: str, namespace: Optional[str]):
+        with self._cache_lock:
+            if kind not in self._cached_kinds:
+                return None
+            obj = self._read_cache.get(self._cache_key(kind, name, namespace))
+            return deep_copy(obj) if obj is not None else None
+
+    def _cache_store(self, kind: str, obj, qid: int) -> None:
+        with self._cache_lock:
+            if self._cache_feeder.get(kind) != qid:
+                return  # not the feeder: read-only passenger
+            self._read_cache[self._cache_key(
+                kind, obj.metadata.name, obj.metadata.namespace)] = deep_copy(obj)
+
+    def _cache_delete(self, kind: str, obj, qid: int) -> None:
+        with self._cache_lock:
+            if self._cache_feeder.get(kind) != qid:
+                return
+            self._read_cache.pop(self._cache_key(
+                kind, obj.metadata.name, obj.metadata.namespace), None)
+
+    def _cache_replace_kind(self, kind: str, objs, qid: int) -> None:
+        """Swap in the feeder's fresh LIST snapshot (purges objects deleted
+        during a watch gap) and mark the kind cache-served. A non-feeder or
+        already-unwatched queue (stop_watches raced the LIST) writes
+        nothing — stale threads can never re-seed a purged cache."""
+        with self._cache_lock:
+            if self._cache_feeder.get(kind) != qid:
+                return
+            for key in [k for k in self._read_cache if k[0] == kind]:
+                del self._read_cache[key]
+            for obj in objs:
+                self._read_cache[self._cache_key(
+                    kind, obj.metadata.name, obj.metadata.namespace)] = (
+                    deep_copy(obj))
+            self._cached_kinds.add(kind)
 
     def get(self, kind: str, name: str, namespace: str = "default"):
+        cached = self._cache_lookup(kind, name, namespace)
+        if cached is not None:
+            return cached
+        # miss falls through LIVE (an object created moments ago may not
+        # have reached the watch yet — strictly fresher than an informer)
+        return self._get_live(kind, name, namespace)
+
+    def _get_live(self, kind: str, name: str, namespace: str = "default"):
         return _decode(kind, self._request("GET", self._item(kind, name, namespace)))
 
     def list(self, kind: str, namespace: Optional[str] = None,
              label_selector: Optional[LabelSelector] = None,
              field: Optional[Tuple[str, str]] = None) -> List:
+        cached = self._cache_list(kind, namespace, label_selector, field)
+        if cached is not None:
+            return cached
         params = {}
         if label_selector is not None:
             parts = [f"{k}={v}" for k, v in label_selector.match_labels.items()]
@@ -309,7 +471,9 @@ class KubeApiClient:
         (KubeCore.patch holds a lock; a real server needs the retry loop)."""
         last: Optional[Conflict] = None
         for _ in range(retries):
-            obj = self.get(kind, name, namespace)
+            # LIVE read: a cached (stale) object would re-conflict until
+            # the watch catches up — the write path never reads the cache
+            obj = self._get_live(kind, name, namespace)
             fn(obj)
             try:
                 return self.update(obj)
@@ -360,6 +524,10 @@ class KubeApiClient:
         assert kind is not None, "the API client watches one kind at a time"
         q: "queue.Queue[Event]" = queue.Queue()
         self._watch_queues.append(q)
+        self._watch_kind_by_queue[id(q)] = kind
+        with self._cache_lock:
+            # first watch for the kind becomes the cache feeder
+            self._cache_feeder.setdefault(kind, id(q))
         t = threading.Thread(target=self._watch_loop, args=(kind, q),
                              daemon=True, name=f"watch-{kind}")
         t.start()
@@ -387,12 +555,28 @@ class KubeApiClient:
         dropping the queue stops delivery; severing the live connection
         unblocks the thread from its streaming read so it exits now."""
         self._watch_queues = [w for w in self._watch_queues if w is not q]
+        kind = self._watch_kind_by_queue.pop(id(q), None)
+        if kind is not None:
+            with self._cache_lock:
+                if self._cache_feeder.get(kind) == id(q):
+                    # the feeder is gone: stop serving and purge — remaining
+                    # watches (if any) stay read-only passengers, so reads
+                    # simply go live again for this kind
+                    self._cache_feeder.pop(kind, None)
+                    self._cached_kinds.discard(kind)
+                    for key in [k for k in self._read_cache if k[0] == kind]:
+                        del self._read_cache[key]
         conn = self._watch_conns.pop(id(q), None)
         if conn is not None:
             self._sever(conn)
 
     def stop_watches(self) -> None:
         self._watch_stop.set()
+        with self._cache_lock:
+            self._cache_feeder.clear()
+            self._cached_kinds.clear()
+            self._read_cache.clear()
+        self._watch_kind_by_queue.clear()
         for key in list(self._watch_conns):
             conn = self._watch_conns.pop(key, None)
             if conn is not None:
@@ -408,8 +592,14 @@ class KubeApiClient:
             try:
                 body = self._request("GET", path)
                 rv = (body.get("metadata") or {}).get("resourceVersion", "")
-                for item in body.get("items", []):
-                    q.put(Event("ADDED", _decode(kind, item)))
+                objs = [_decode(kind, item) for item in body.get("items", [])]
+                # feeder only: seed/refresh the read cache from the LIST
+                # snapshot and mark the kind cache-served (readers never
+                # see a partial snapshot); a re-list after a watch gap
+                # purges deletions
+                self._cache_replace_kind(kind, objs, id(q))
+                for obj in objs:
+                    q.put(Event("ADDED", obj))
                 self._stream(kind, path, rv, q)
             except ResourceExpired as e:
                 # 410/Expired means our resourceVersion aged out of the
@@ -469,7 +659,12 @@ class KubeApiClient:
                                 or obj.get("reason") in ("Expired", "Gone")):
                             raise ResourceExpired(f"watch {kind}: {obj}")
                         raise ApiError(f"watch {kind}: {obj}")
-                    q.put(Event(etype, _decode(kind, event.get("object") or {})))
+                    obj = _decode(kind, event.get("object") or {})
+                    if etype == "DELETED":
+                        self._cache_delete(kind, obj, id(q))
+                    elif etype in ("ADDED", "MODIFIED"):
+                        self._cache_store(kind, obj, id(q))
+                    q.put(Event(etype, obj))
         finally:
             self._watch_conns.pop(id(q), None)
             conn.close()
