@@ -422,11 +422,22 @@ def config_6_high_cardinality():
     # records ≈ nodes and each extra chunk is a device round trip
     dev = solve_ffd_device(vecs, ids, packables, chunk_iters=512)  # warm-up
     if dev is not None:
+        import jax
+
         oracle, oracle_label = oracle_node_count(constraints, pods, catalog)
         assert dev.node_count == oracle, (
             f"high-cardinality mismatch: device={dev.node_count} oracle={oracle}")
-        times = run_timed(lambda: solve_ffd_device(
-            vecs, ids, packables, chunk_iters=512), max_iters=25, budget_s=60.0)
+        if jax.default_backend() == "cpu":
+            # degraded path: the XLA-on-CPU scan takes minutes per call at
+            # this bucket; one timed call records the honest (meaningless
+            # for TPU) number without eating the child deadline
+            t0 = time.perf_counter()
+            solve_ffd_device(vecs, ids, packables, chunk_iters=512)
+            times = [time.perf_counter() - t0]
+        else:
+            times = run_timed(lambda: solve_ffd_device(
+                vecs, ids, packables, chunk_iters=512),
+                max_iters=25, budget_s=60.0)
         st = _stats(times)
         out["device_8k_shapes"] = {
             "pods": 50_000, "distinct_shapes": 8_000, "types": 400, **st,
@@ -617,6 +628,10 @@ def _run_child(mode: str, deadline_s: float, probe_note: str):
     stderr passes through for debugging; stdout is parsed for the LAST
     line that decodes to the bench dict."""
     env = {**os.environ, _MODE_ENV: mode, "KARPENTER_BENCH_NOTE": probe_note}
+    # persistent XLA compilation cache: the large shape buckets (config 6)
+    # compile once per bucket pair; caching them across runs keeps repeat
+    # benches inside the child deadline
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/karpenter_jax_cache")
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
