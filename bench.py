@@ -420,7 +420,10 @@ def config_6_high_cardinality():
     vecs, ids = pod_vectors(pods), list(range(len(pods)))
     # larger chunks: at high cardinality fast-forward rarely collapses, so
     # records ≈ nodes and each extra chunk is a device round trip
-    dev = solve_ffd_device(vecs, ids, packables, chunk_iters=512)  # warm-up
+    # kernel="xla" explicitly: the block-tiled scan is the executor built
+    # for this bucket; the pallas kernel is validated to 4096 shapes
+    dev = solve_ffd_device(vecs, ids, packables, chunk_iters=512,
+                           kernel="xla")  # warm-up
     if dev is not None:
         import jax
 
@@ -432,11 +435,12 @@ def config_6_high_cardinality():
             # this bucket; one timed call records the honest (meaningless
             # for TPU) number without eating the child deadline
             t0 = time.perf_counter()
-            solve_ffd_device(vecs, ids, packables, chunk_iters=512)
+            solve_ffd_device(vecs, ids, packables, chunk_iters=512,
+                             kernel="xla")
             times = [time.perf_counter() - t0]
         else:
             times = run_timed(lambda: solve_ffd_device(
-                vecs, ids, packables, chunk_iters=512),
+                vecs, ids, packables, chunk_iters=512, kernel="xla"),
                 max_iters=25, budget_s=60.0)
         st = _stats(times)
         out["device_8k_shapes"] = {
