@@ -1,0 +1,174 @@
+"""Type-axis SPMD packing: ONE problem solved across the whole mesh.
+
+The batch-sharded path (parallel/sharded_pack.py) scales the number of
+concurrent schedules with zero collectives — each device owns whole
+problems. This module scales a SINGLE problem: the instance-type axis is
+sharded across the mesh, every device simulates the greedy fill for its
+type shard, and the per-node packing decision is reached with XLA
+collectives INSIDE the jitted solve (SURVEY.md §5.8: "ICI collectives
+within a slice — psum/all-gather inside the pjit-ed solver"):
+
+- ``pmax``  — the fast-forward bound (max feasible fit over all types);
+- ``psum``  of a one-hot mask — reads the globally-last-valid type's fill
+  (the packer's upper-bound probe, packer.go:167-170) and broadcasts the
+  chosen type's per-shape pack vector from its owner device;
+- ``pmin``  — the FIRST type (globally smallest index) achieving the
+  upper bound, the Go packer's first-tie rule (packer.go:174-183).
+
+Collectives happen once per NODE decision (3–4 per iteration), not per
+shape step — the inner shape scan is purely local — so on ICI the
+collective latency amortizes over the (S × T_local × R) fill simulation.
+
+Semantics are bit-identical to ops.pack.pack_chunk; enforced by
+tests/test_type_sharded.py on the virtual 8-device CPU mesh against the
+single-device kernel and the host oracle.
+
+When this path wins: very large catalogs (T in the thousands) or
+few-schedule windows where the batch axis can't fill the mesh. The
+provisioning default remains batch-sharding; this is the complementary
+axis, selectable via ``pack_chunk_type_sharded``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from karpenter_tpu.ops.pack import INT32_MAX, flatten_chunk_outputs
+from karpenter_tpu.solver.host_ffd import R_PODS
+
+AXIS = "types"
+
+
+def type_mesh(devices=None) -> Mesh:
+    import numpy as np
+
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), axis_names=(AXIS,))
+
+
+def _local_pack(shapes, counts, dropped, totals_l, reserved0_l, valid_l,
+                last_valid, pods_unit, num_iters: int):
+    """Per-device body under shard_map: totals/reserved0/valid carry this
+    device's type shard; everything else is replicated. Every cross-type
+    decision goes through a collective, after which all devices hold
+    identical replicated values — so control flow stays in lockstep."""
+    S, R = shapes.shape
+    T_l = totals_l.shape[0]
+    idx = jax.lax.axis_index(AXIS)
+    offset = (idx * T_l).astype(jnp.int32)
+    pods_one = jnp.zeros((R,), jnp.int32).at[R_PODS].set(pods_unit)
+
+    # fast-forward bound: local max fit per shape, then pmax over the mesh
+    avail0 = totals_l - reserved0_l
+    kfit0 = jnp.full((S, T_l), INT32_MAX, jnp.int32)
+    for r in range(R):
+        col = shapes[:, r][:, None]
+        kr_r = jnp.where(col > 0, avail0[None, :, r] // jnp.maximum(col, 1),
+                         INT32_MAX)
+        kfit0 = jnp.minimum(kfit0, kr_r)
+    maxfit_l = jnp.max(jnp.where(valid_l[None, :], kfit0, -1), axis=1)
+    maxfit = jax.lax.pmax(maxfit_l, AXIS)                    # (S,) replicated
+
+    def node_iter(carry, _):
+        counts, dropped, done = carry
+        has = counts > 0
+        largest_idx = jnp.argmax(has)
+        smallest_idx = S - 1 - jnp.argmax(has[::-1])
+        smallest_fits = jnp.maximum(shapes[smallest_idx] - pods_one, 0)
+
+        def shape_step(c2, s):
+            reserved, stopped, npacked = c2
+            shape = shapes[s]
+            count = counts[s]
+            active = (count > 0) & (~stopped)
+            avail = totals_l - reserved
+            kr = jnp.where(shape[None, :] > 0,
+                           avail // jnp.maximum(shape[None, :], 1), INT32_MAX)
+            kfit = jnp.min(kr, axis=1)
+            k = jnp.where(active, jnp.clip(kfit, 0, count), 0)
+            failure = active & (k < count)
+            reserved = reserved + k[:, None] * shape[None, :]
+            full = jnp.any((totals_l > 0) &
+                           (reserved + smallest_fits[None, :] >= totals_l),
+                           axis=1)
+            npacked = npacked + k
+            stopped = stopped | (failure & (full | (npacked == 0)))
+            return (reserved, stopped, npacked), k
+
+        init = (reserved0_l, ~valid_l, jnp.zeros_like(totals_l[:, 0]))
+        (_, _, npacked), k_all = jax.lax.scan(shape_step, init, jnp.arange(S))
+        # k_all (S, T_l): this device's simulated fills
+
+        # -- collective decisions (identical on all devices afterwards) -----
+        # upper bound = the globally-LAST valid type's fill (packer.go:170):
+        # its owner contributes, everyone else zero, psum broadcasts
+        owner_local = last_valid - offset
+        mine = (owner_local >= 0) & (owner_local < T_l)
+        probe = jnp.where(
+            mine, npacked[jnp.clip(owner_local, 0, T_l - 1)], 0)
+        max_pods = jax.lax.psum(probe, AXIS)
+
+        # first (globally smallest-index) type achieving the bound — pmin
+        # over per-device first-tie global indices (packer.go:174-183)
+        tie = valid_l & (npacked == max_pods)
+        local_first = jnp.where(
+            jnp.any(tie), offset + jnp.argmax(tie).astype(jnp.int32),
+            INT32_MAX)
+        chosen = jax.lax.pmin(local_first, AXIS)
+
+        # broadcast the chosen type's per-shape pack vector from its owner
+        c_local = chosen - offset
+        c_mine = (c_local >= 0) & (c_local < T_l)
+        col = k_all[:, jnp.clip(c_local, 0, T_l - 1)]
+        packedv = jax.lax.psum(jnp.where(c_mine, col, 0), AXIS)   # (S,)
+
+        nothing = max_pods == 0
+        terms = jnp.where(packedv > 0,
+                          (counts - maxfit - 1) // jnp.maximum(packedv, 1),
+                          INT32_MAX)
+        q = jnp.maximum(1, 1 + jnp.min(terms))
+        q = jnp.where(nothing | done, 0, q)
+
+        drop_here = nothing & ~done
+        drop_vec = jnp.where((jnp.arange(S) == largest_idx) & drop_here,
+                             counts, 0)
+        new_counts = jnp.where(done, counts, counts - q * packedv - drop_vec)
+        new_dropped = dropped + drop_vec
+        new_done = ~jnp.any(new_counts > 0)
+        rec = (jnp.where(q > 0, chosen, -1), q, packedv)
+        return (new_counts, new_dropped, new_done), rec
+
+    (counts_f, dropped_f, done_f), (chosen_seq, q_seq, packed_seq) = (
+        jax.lax.scan(node_iter, (counts, dropped, ~jnp.any(counts > 0)),
+                     None, length=num_iters))
+    return flatten_chunk_outputs(counts_f, dropped_f, done_f,
+                                 chosen_seq, q_seq, packed_seq)
+
+
+@functools.partial(jax.jit, static_argnames=("num_iters", "mesh"))
+def pack_chunk_type_sharded(
+    shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
+    *,
+    num_iters: int,
+    mesh: Mesh,
+):
+    """pack_chunk with the TYPE axis sharded over the mesh; returns the
+    same flat buffer as pack_chunk_flat (replicated — one fetch). T must be
+    a multiple of the mesh size (the TYPE_BUCKETS are powers of two, so any
+    power-of-two mesh divides them)."""
+    T = totals.shape[0]
+    n = mesh.devices.size
+    assert T % n == 0, f"type axis {T} not divisible by mesh size {n}"
+    body = functools.partial(_local_pack, num_iters=num_iters)
+    spec_t = P(AXIS)
+    rep = P()
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(rep, rep, rep, spec_t, spec_t, spec_t, rep, rep),
+        out_specs=rep,
+    )(shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit)

@@ -1,0 +1,92 @@
+"""Type-axis SPMD kernel ≡ single-device kernel ≡ host oracle.
+
+The type-sharded path makes its per-node decisions through pmax/psum/pmin
+collectives (parallel/type_sharded.py); these tests pin bit-identical
+behavior on the virtual 8-device CPU mesh, including the record stream
+(chosen/q/packed), not just node counts.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.cloudprovider.fake.provider import instance_types
+from karpenter_tpu.controllers.provisioning import universe_constraints
+from karpenter_tpu.models.ffd import device_args
+from karpenter_tpu.ops.encode import encode
+from karpenter_tpu.ops.pack import pack_chunk_flat, unpack_flat
+from karpenter_tpu.parallel.type_sharded import (
+    pack_chunk_type_sharded, type_mesh,
+)
+from karpenter_tpu.solver import host_ffd
+from karpenter_tpu.solver.adapter import build_packables, pod_vectors
+from tests.conftest import cpu_mesh_devices
+from tests.expectations import unschedulable_pod
+
+L = 32
+
+
+def _encoded(pods, catalog):
+    constraints = universe_constraints(catalog)
+    packables, _ = build_packables(catalog, constraints, pods, [])
+    vecs = pod_vectors(pods)
+    ids = list(range(len(pods)))
+    enc = encode(vecs, ids, packables)
+    assert enc is not None
+    return enc, vecs, ids, packables
+
+
+def _run_both(enc, n_devices=8):
+    mesh = type_mesh(cpu_mesh_devices(n_devices))
+    args = device_args(enc)
+    sharded = np.asarray(pack_chunk_type_sharded(*args, num_iters=L, mesh=mesh))
+    single = np.asarray(pack_chunk_flat(*args, num_iters=L))
+    S = enc.shapes.shape[0]
+    return unpack_flat(sharded, S, L), unpack_flat(single, S, L)
+
+
+class TestTypeShardedParity:
+    @pytest.mark.parametrize("n_types,n_pods", [(8, 60), (16, 250), (24, 400)])
+    def test_record_stream_identical(self, n_types, n_pods):
+        catalog = instance_types(n_types)
+        pods = [unschedulable_pod(requests={
+            "cpu": f"{(i % 7 + 1) * 250}m",
+            "memory": f"{(i % 5 + 1) * 256}Mi"}) for i in range(n_pods)]
+        enc, _, _, _ = _encoded(pods, catalog)
+        (c_s, d_s, done_s, ch_s, q_s, p_s), (c_1, d_1, done_1, ch_1, q_1, p_1) = (
+            _run_both(enc))
+        assert done_s == done_1
+        np.testing.assert_array_equal(c_s, c_1)
+        np.testing.assert_array_equal(d_s, d_1)
+        np.testing.assert_array_equal(ch_s, ch_1)
+        np.testing.assert_array_equal(q_s, q_1)
+        np.testing.assert_array_equal(p_s, p_1)
+
+    def test_node_count_matches_oracle(self):
+        catalog = instance_types(16)
+        pods = [unschedulable_pod(requests={
+            "cpu": f"{(i % 4 + 1) * 500}m",
+            "memory": f"{(i % 3 + 1) * 512}Mi"}) for i in range(300)]
+        enc, vecs, ids, packables = _encoded(pods, catalog)
+        (_, _, done, _, q, _), _ = _run_both(enc)
+        assert done
+        oracle = host_ffd.pack(vecs, ids, packables)
+        assert int(q[q > 0].sum()) == oracle.node_count
+
+    def test_unschedulable_drops_match(self):
+        # one pod too large for every type: the sharded drop path must agree
+        catalog = instance_types(8)
+        pods = [unschedulable_pod(requests={"cpu": "500", "memory": "1Ti"}),
+                unschedulable_pod(requests={"cpu": "1", "memory": "512Mi"})]
+        enc, _, _, _ = _encoded(pods, catalog)
+        (_, d_s, done_s, _, _, _), (_, d_1, done_1, _, _, _) = _run_both(enc)
+        assert done_s == done_1
+        np.testing.assert_array_equal(d_s, d_1)
+        assert d_s.sum() == 1
+
+    def test_mesh_size_must_divide_types(self):
+        catalog = instance_types(8)  # pads to an 8-bucket; 8 % 3 != 0
+        pods = [unschedulable_pod()]
+        enc, _, _, _ = _encoded(pods, catalog)
+        mesh = type_mesh(cpu_mesh_devices(3))
+        with pytest.raises(AssertionError):
+            pack_chunk_type_sharded(*device_args(enc), num_iters=4, mesh=mesh)
