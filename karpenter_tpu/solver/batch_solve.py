@@ -25,7 +25,7 @@ from karpenter_tpu.api.core import Pod
 from karpenter_tpu.cloudprovider.spi import InstanceType
 from karpenter_tpu.models.ffd import MAX_CHUNKS, _decode, default_kernel
 from karpenter_tpu.ops.encode import encode
-from karpenter_tpu.solver.adapter import build_packables_cached, pod_vectors
+from karpenter_tpu.solver.adapter import build_packables_cached, marshal_pods
 from karpenter_tpu.solver.solve import (
     SolveResult, SolverConfig, materialize, solve_with_packables,
 )
@@ -50,9 +50,11 @@ def solve_batch(problems: Sequence[Problem],
     config = config or SolverConfig()
     prepared = []
     for prob in problems:
+        vecs, required = marshal_pods(prob.pods)
         packables, sorted_types = build_packables_cached(
-            prob.instance_types, prob.constraints, prob.pods, prob.daemons)
-        prepared.append((packables, sorted_types, pod_vectors(prob.pods)))
+            prob.instance_types, prob.constraints, prob.pods, prob.daemons,
+            required=required)
+        prepared.append((packables, sorted_types, vecs))
 
     # gate on the cheap signals BEFORE paying for encoding: a batch of tiny
     # problems is faster on the native/host executors than a device trip

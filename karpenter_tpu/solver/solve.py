@@ -20,7 +20,7 @@ from karpenter_tpu.models.cost import (
 )
 from karpenter_tpu.models.ffd import solve_ffd_device
 from karpenter_tpu.solver import host_ffd
-from karpenter_tpu.solver.adapter import build_packables_cached, pod_vectors
+from karpenter_tpu.solver.adapter import build_packables_cached, marshal_pods
 from karpenter_tpu.utils.profiling import trace
 
 log = logging.getLogger("karpenter.solver")
@@ -85,9 +85,9 @@ def solve(
     config: Optional[SolverConfig] = None,
 ) -> SolveResult:
     config = config or SolverConfig()
+    pod_vecs, required = marshal_pods(pods)  # one pass: vecs + special mask
     packables, sorted_types = build_packables_cached(
-        instance_types, constraints, pods, daemons)
-    pod_vecs = pod_vectors(pods)
+        instance_types, constraints, pods, daemons, required=required)
     return solve_with_packables(constraints, pods, packables, sorted_types,
                                 pod_vecs, config)
 

@@ -136,3 +136,15 @@ class TestPackablesCache:
         w1, _ = build_packables(catalog, constraints, pods, [daemon])
         assert [(p.reserved) for p in p1] == [(p.reserved) for p in w1]
         assert [(p.reserved) for p in p0] != [(p.reserved) for p in p1]
+
+
+class TestMarshalPods:
+    def test_one_pass_matches_two(self):
+        from karpenter_tpu.solver.adapter import marshal_pods
+
+        pods = [make_pod({"cpu": "1"}) for _ in range(20)]
+        pods.append(make_pod({"cpu": "1"}, limits={"nvidia.com/gpu": "1"}))
+        vecs, required = marshal_pods(pods)
+        assert vecs == pod_vectors(pods)
+        assert required == _required_resources(pods)
+        assert required == frozenset({"nvidia.com/gpu"})
