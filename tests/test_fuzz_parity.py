@@ -42,6 +42,36 @@ from karpenter_tpu.solver.solve import SolverConfig, solve
 
 N_CASES = int(os.environ.get("KARPENTER_FUZZ_CASES", "150"))
 PALLAS_EVERY = 25          # pallas interpret is debug-speed; sample cases
+TYPE_SHARDED_EVERY = 20    # SPMD path recompiles per bucket pair; sample
+
+
+def _type_sharded_signature(vecs, ids, packables):
+    """Full result signature from the type-axis SPMD kernel on the 8-device
+    CPU mesh, or None when the case doesn't fit one chunk (skip)."""
+    import numpy as np
+
+    from karpenter_tpu.models.ffd import _decode, device_args
+    from karpenter_tpu.ops.pack import unpack_flat
+    from karpenter_tpu.parallel.type_sharded import (
+        pack_chunk_type_sharded, type_mesh,
+    )
+    from tests.conftest import cpu_mesh_devices
+
+    enc = encode(vecs, ids, packables)
+    if enc is None or enc.totals.shape[0] % 8 != 0:
+        return None
+    L = 128
+    mesh = type_mesh(cpu_mesh_devices(8))
+    buf = np.asarray(pack_chunk_type_sharded(
+        *device_args(enc), num_iters=L, mesh=mesh))
+    _, dropped_f, done, chosen, q, packed = unpack_flat(
+        buf, enc.shapes.shape[0], L)
+    if not done:
+        return None
+    records = [(int(chosen[i]), int(q[i]), packed[i])
+               for i in range(L) if q[i] > 0]
+    result = _decode(enc, records, dropped_f, packables, 20)
+    return result
 
 REALISTIC_CPU = ["50m", "100m", "250m", "500m", "1", "1500m", "2", "4"]
 REALISTIC_MEM = ["64Mi", "128Mi", "256Mi", "512Mi", "1Gi", "3Gi", "8Gi"]
@@ -136,6 +166,7 @@ class TestExecutorQuartetFuzz:
         encode_fallbacks = 0
         compared = 0
         pallas_checked = 0
+        type_sharded_checked = 0
         for case in range(N_CASES):
             catalog = _random_catalog(rng)
             pods = _random_pods(rng)
@@ -177,9 +208,17 @@ class TestExecutorQuartetFuzz:
                 assert _signature(result, vecs) == oracle_sig, f"{ctx}: pallas"
                 pallas_checked += 1
 
+            if type_sharded_checked < compared // TYPE_SHARDED_EVERY + 3:
+                ts_result = _type_sharded_signature(vecs, ids, packables)
+                if ts_result is not None:
+                    assert _signature(ts_result, vecs) == oracle_sig, \
+                        f"{ctx}: type-sharded SPMD"
+                    type_sharded_checked += 1
+
         rate = encode_fallbacks / N_CASES
         print(f"\nfuzz summary: {N_CASES} cases, {compared} quartet-compared, "
               f"{pallas_checked} pallas-checked, "
+              f"{type_sharded_checked} type-sharded-checked, "
               f"encode-fallback rate {rate:.1%}")
         # the adversarial mix is tuned to exercise BOTH paths: most cases
         # must reach the device executors, and the boundary cases must
@@ -189,6 +228,7 @@ class TestExecutorQuartetFuzz:
             "boundary quantities no longer trigger encode fallback — "
             "adversarial pools need retuning")
         assert pallas_checked >= 3
+        assert type_sharded_checked >= 3
 
 
 class TestEncodeBoundaryPinned:
