@@ -26,6 +26,7 @@ from karpenter_tpu.cloudprovider.spi import InstanceType
 from karpenter_tpu.models.ffd import MAX_CHUNKS, _decode, default_kernel
 from karpenter_tpu.ops.encode import encode
 from karpenter_tpu.solver.adapter import build_packables_cached, marshal_pods
+from karpenter_tpu.solver import solve as solve_module
 from karpenter_tpu.solver.solve import (
     SolveResult, SolverConfig, materialize, solve_with_packables,
 )
@@ -72,11 +73,21 @@ def solve_batch(problems: Sequence[Problem],
                 encs.append(enc)
 
     results: List[Optional[SolveResult]] = [None] * len(problems)
-    if len(batch_idx) >= 2:
+    if len(batch_idx) >= 2 and not solve_module._WATCHDOG.tripped():
         try:
             with trace("karpenter.solve.batch_device"):
-                host_results = _device_batch(
-                    encs, [prepared[i][0] for i in batch_idx], config)
+                # same hang watchdog + circuit breaker as the solo device
+                # ring (solver/solve.py): a sick transport must not stall
+                # the provisioning hot loop
+                if config.device_timeout_s > 0:
+                    host_results = solve_module._WATCHDOG.run(
+                        lambda: _device_batch(
+                            encs, [prepared[i][0] for i in batch_idx], config),
+                        config.device_timeout_s,
+                        config.device_breaker_seconds)
+                else:
+                    host_results = _device_batch(
+                        encs, [prepared[i][0] for i in batch_idx], config)
         except Exception:  # device ring: never drop a provisioning loop
             log.exception("batched device solve failed; falling back per problem")
             host_results = None

@@ -9,6 +9,8 @@ the differential-test reference.
 from __future__ import annotations
 
 import logging
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
@@ -26,9 +28,74 @@ from karpenter_tpu.utils.profiling import trace
 log = logging.getLogger("karpenter.solver")
 
 
+class _DeviceWatchdog:
+    """Serializes device solves onto ONE worker thread with a deadline and
+    a circuit breaker. A timed-out call leaves its thread blocked (a hung
+    transport cannot be interrupted from Python) — the pool then spawns a
+    replacement worker for the half-open probe, and the breaker keeps the
+    hot loop off the device until the probe succeeds."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pool = None
+        self._open_until = 0.0
+
+    def _executor(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="device-solve")
+            return self._pool
+
+    def tripped(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._open_until
+
+    def run(self, fn, timeout_s: float, breaker_s: float):
+        """fn() under the deadline; TimeoutError opens the breaker and is
+        re-raised (callers fall through their failure rings)."""
+        from concurrent.futures import TimeoutError as FutureTimeout
+
+        future = self._executor().submit(fn)
+        try:
+            result = future.result(timeout=timeout_s)
+        except FutureTimeout:
+            with self._lock:
+                self._open_until = time.monotonic() + breaker_s
+                # the worker is wedged on the dead transport; drop the pool
+                # so the next (half-open) probe gets a fresh thread
+                self._pool = None
+            log.error(
+                "device solve exceeded %.0fs — transport presumed hung; "
+                "circuit open for %.0fs (host executors answer meanwhile)",
+                timeout_s, breaker_s)
+            raise TimeoutError("device solve watchdog expired")
+        with self._lock:
+            self._open_until = 0.0  # success closes the breaker
+        return result
+
+
+_WATCHDOG = _DeviceWatchdog()
+
+
 @dataclass
 class SolverConfig:
     use_device: bool = True
+    # watchdog for the device ring: a SICK accelerator transport (the axon
+    # tunnel in this environment) can HANG a device call rather than raise,
+    # and a hang in the hot loop stalls provisioning forever — strictly
+    # worse than a failure the rings can catch. Device solves run on a
+    # dedicated worker thread with this deadline; a timeout opens the
+    # circuit breaker (device ring skipped) for device_breaker_seconds,
+    # after which one probe solve is allowed through (half-open). 0 = no
+    # watchdog (device calls run inline). The default leaves room for a
+    # cold XLA compile (20-40 s on real TPU; more at the largest shape
+    # buckets) — a genuine hang still resolves within two minutes instead
+    # of stalling provisioning forever.
+    device_timeout_s: float = 120.0
+    device_breaker_seconds: float = 120.0
     max_instance_types: int = host_ffd.MAX_INSTANCE_TYPES
     chunk_iters: int = 64
     # device kernel: "xla" | "pallas" | None = auto (pallas on real TPU)
@@ -132,16 +199,24 @@ def solve_with_packables(
 
     result = None
     if config.use_device and len(pods) >= config.device_min_pods and \
-            enc is not None:
+            enc is not None and not _WATCHDOG.tripped():
+        def _device_solve():
+            return solve_ffd_device(
+                pod_vecs, pod_ids, packables,
+                max_instance_types=config.max_instance_types,
+                chunk_iters=config.chunk_iters,
+                kernel=config.device_kernel,
+                prices=prices, cost_tiebreak=prices is not None,
+                max_shapes=config.device_max_shapes, enc=enc)
+
         try:
             with trace("karpenter.solve.device"):
-                result = solve_ffd_device(
-                    pod_vecs, pod_ids, packables,
-                    max_instance_types=config.max_instance_types,
-                    chunk_iters=config.chunk_iters,
-                    kernel=config.device_kernel,
-                    prices=prices, cost_tiebreak=prices is not None,
-                    max_shapes=config.device_max_shapes, enc=enc)
+                if config.device_timeout_s > 0:
+                    result = _WATCHDOG.run(
+                        _device_solve, config.device_timeout_s,
+                        config.device_breaker_seconds)
+                else:
+                    result = _device_solve()
         except Exception:  # device failure ring: never drop a provisioning loop
             log.exception("device solve failed; falling back to host FFD")
             result = None

@@ -1,0 +1,145 @@
+"""Device-solve watchdog + circuit breaker (solver/solve.py).
+
+Motivated by observed behavior of this environment's TPU transport: a sick
+tunnel HANGS device calls rather than raising, and the exception-based
+failure rings cannot catch a hang — provisioning would stall forever. The
+watchdog bounds the device ring; a timeout opens the breaker so subsequent
+solves go straight to the host executors, and a later success closes it.
+"""
+
+import time
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake.provider import instance_types
+from karpenter_tpu.controllers.provisioning import universe_constraints
+from karpenter_tpu.solver import solve as solve_mod
+from karpenter_tpu.solver.solve import SolverConfig, _DeviceWatchdog, solve
+from tests.expectations import unschedulable_pod
+
+
+@pytest.fixture()
+def fresh_watchdog(monkeypatch):
+    wd = _DeviceWatchdog()
+    monkeypatch.setattr(solve_mod, "_WATCHDOG", wd)
+    return wd
+
+
+def make_problem(n=40):
+    catalog = instance_types(6)
+    constraints = universe_constraints(catalog)
+    pods = [unschedulable_pod(requests={"cpu": "500m", "memory": "256Mi"})
+            for _ in range(n)]
+    return constraints, pods, catalog
+
+
+class TestWatchdog:
+    def test_timeout_opens_breaker_and_recovers(self, fresh_watchdog):
+        wd = fresh_watchdog
+        with pytest.raises(TimeoutError):
+            wd.run(lambda: time.sleep(5.0), timeout_s=0.05, breaker_s=0.2)
+        assert wd.tripped()
+        time.sleep(0.25)
+        assert not wd.tripped()  # half-open: next call may probe
+        # a successful probe closes the breaker (fresh worker thread,
+        # despite the previous one still sleeping)
+        assert wd.run(lambda: 42, timeout_s=1.0, breaker_s=0.2) == 42
+        assert not wd.tripped()
+
+    def test_success_closes_open_breaker(self, fresh_watchdog):
+        wd = fresh_watchdog
+        with pytest.raises(TimeoutError):
+            wd.run(lambda: time.sleep(5.0), timeout_s=0.05, breaker_s=60.0)
+        assert wd.tripped()
+        # operators can force a probe by calling run() directly; success
+        # must clear the open state
+        wd._open_until = 0.0
+        assert wd.run(lambda: "ok", timeout_s=1.0, breaker_s=60.0) == "ok"
+        assert not wd.tripped()
+
+
+class TestSolveWithWatchdog:
+    def test_hung_device_solve_answers_via_host(self, fresh_watchdog,
+                                                monkeypatch):
+        """A hanging device ring must neither stall nor change the answer."""
+        constraints, pods, catalog = make_problem()
+        want = solve(constraints, pods, catalog,
+                     config=SolverConfig(use_device=False))
+
+        def hang(*a, **kw):
+            time.sleep(10.0)
+
+        monkeypatch.setattr(solve_mod, "solve_ffd_device", hang)
+        t0 = time.monotonic()
+        got = solve(constraints, pods, catalog, config=SolverConfig(
+            device_min_pods=1, device_timeout_s=0.1,
+            device_breaker_seconds=30.0))
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, "solve stalled behind a hung device call"
+        assert got.node_count == want.node_count
+        assert solve_mod._WATCHDOG.tripped()
+
+    def test_open_breaker_skips_device_entirely(self, fresh_watchdog,
+                                                monkeypatch):
+        constraints, pods, catalog = make_problem()
+        calls = {"n": 0}
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            raise RuntimeError("should not be called while breaker is open")
+
+        monkeypatch.setattr(solve_mod, "solve_ffd_device", counting)
+        fresh_watchdog._open_until = time.monotonic() + 60.0
+        got = solve(constraints, pods, catalog,
+                    config=SolverConfig(device_min_pods=1))
+        assert calls["n"] == 0
+        want = solve(constraints, pods, catalog,
+                     config=SolverConfig(use_device=False))
+        assert got.node_count == want.node_count
+
+    def test_watchdog_disabled_runs_inline(self, fresh_watchdog, monkeypatch):
+        constraints, pods, catalog = make_problem()
+        seen = {"thread": None}
+
+        def record(*a, **kw):
+            import threading
+
+            seen["thread"] = threading.current_thread().name
+            return None  # fall through to host executors
+
+        monkeypatch.setattr(solve_mod, "solve_ffd_device", record)
+        solve(constraints, pods, catalog, config=SolverConfig(
+            device_min_pods=1, device_timeout_s=0.0))
+        assert seen["thread"] is not None
+        assert not seen["thread"].startswith("device-solve")
+
+
+class TestBatchSolveWithWatchdog:
+    def test_hung_batch_device_answers_via_fallback(self, fresh_watchdog,
+                                                    monkeypatch):
+        from karpenter_tpu.solver import batch_solve as bs
+        from karpenter_tpu.solver.batch_solve import Problem, solve_batch
+
+        catalog = instance_types(6)
+        constraints = universe_constraints(catalog)
+        problems = [
+            Problem(constraints=constraints,
+                    pods=[unschedulable_pod(requests={"cpu": "500m"})
+                          for _ in range(30)],
+                    instance_types=catalog)
+            for _ in range(3)
+        ]
+        want = solve_batch(problems, config=SolverConfig(use_device=False))
+
+        def hang(*a, **kw):
+            time.sleep(10.0)
+
+        monkeypatch.setattr(bs, "_device_batch", hang)
+        t0 = time.monotonic()
+        got = solve_batch(problems, config=SolverConfig(
+            device_min_pods=1, device_timeout_s=0.1,
+            device_breaker_seconds=30.0, use_native=False))
+        assert time.monotonic() - t0 < 5.0
+        assert [r.node_count for r in got] == [r.node_count for r in want]
+        # and the breaker now routes the SOLO device ring away too
+        assert bs.solve_module._WATCHDOG.tripped()
