@@ -1,0 +1,72 @@
+"""Coarse performance regression guards.
+
+SURVEY.md §4 notes the reference ships no load/perf regression tests; these
+exist to catch order-of-magnitude regressions (an accidentally quadratic
+loop, a lost cache) in CI — NOT to measure real performance (bench.py does
+that on real hardware). Bounds are ~50-100× looser than measured costs so
+slow shared CI runners never flake them.
+"""
+
+import time
+
+from karpenter_tpu.api.core import Container, Pod, PodSpec, ResourceRequirements
+from karpenter_tpu.controllers.provisioning import universe_constraints
+from karpenter_tpu.cloudprovider.fake.provider import instance_types
+from karpenter_tpu.solver.adapter import marshal_pods
+from karpenter_tpu.solver.solve import SolverConfig, solve
+from karpenter_tpu.utils.fastcopy import deep_copy
+
+MIXED = [(c, m) for c in (100, 500, 1000, 4000) for m in (128, 1024, 4096)]
+
+
+def mkpods(n):
+    return [Pod(spec=PodSpec(containers=[Container(
+        resources=ResourceRequirements.make(requests={
+            "cpu": f"{c}m", "memory": f"{m}Mi"}))]))
+        for i in range(n) for c, m in (MIXED[i % len(MIXED)],)]
+
+
+class TestPerfSmoke:
+    def test_warm_marshal_is_cached_gather(self):
+        # cold ≈ 5 ms/1k pods; warm must be an attribute gather. Bound: the
+        # warm pass must be at least 3× faster than the cold pass (ratio,
+        # not wall clock — immune to slow runners).
+        pods = mkpods(20_000)
+        t0 = time.perf_counter()
+        marshal_pods(pods)
+        cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        marshal_pods(pods)
+        warm = time.perf_counter() - t0
+        assert warm < cold / 3, (
+            f"marshal cache ineffective: cold={cold * 1e3:.0f}ms "
+            f"warm={warm * 1e3:.0f}ms")
+
+    def test_warm_solve_50k_under_loose_bound(self):
+        catalog = instance_types(40)
+        constraints = universe_constraints(catalog)
+        pods = mkpods(50_000)
+        config = SolverConfig(use_device=False)  # host executors: CI-stable
+        solve(constraints, pods, catalog, config=config)  # warm caches
+        t0 = time.perf_counter()
+        result = solve(constraints, pods, catalog, config=config)
+        elapsed = time.perf_counter() - t0
+        assert result.node_count > 0
+        # measured ~60 ms; 5 s catches accidental O(pods²) / lost caches
+        assert elapsed < 5.0, f"50k-pod warm solve took {elapsed:.1f}s"
+
+    def test_fastcopy_beats_stdlib(self):
+        import copy
+
+        pod = mkpods(1)[0]
+        n = 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            copy.deepcopy(pod)
+        std = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            deep_copy(pod)
+        fast = time.perf_counter() - t0
+        assert fast < std, (
+            f"fastcopy regressed below copy.deepcopy: {fast:.3f}s vs {std:.3f}s")
