@@ -1,0 +1,158 @@
+"""Cross-controller chaos: the -race/battletest analog (SURVEY.md §5.2).
+
+Per-controller suites verify each reconciler in isolation; this drives the
+FULL manager stack (all controllers, real watch pumps and workqueues)
+while a chaos thread mutates the cluster — pods created and deleted
+mid-provisioning, nodes deleted under running pods, readiness flapping —
+then asserts global invariants rather than specific outcomes:
+
+- the control plane stays healthy (no dead reconcile workers);
+- every surviving provisionable pod is eventually bound;
+- every bound pod points at a node that exists;
+- the spec.nodeName index agrees with the objects (kubecore internal
+  consistency under concurrent mutation);
+- no pod is bound twice / no duplicate node names.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.provisioner import Provisioner
+from karpenter_tpu.cloudprovider.fake.provider import FakeCloudProvider, instance_types
+from karpenter_tpu.cloudprovider.metrics import decorate
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
+from karpenter_tpu.runtime.manager import Manager
+from karpenter_tpu.scheduling.batcher import Batcher
+from tests.expectations import unschedulable_pod
+
+CHAOS_SECONDS = 6.0
+
+
+@pytest.fixture()
+def stack():
+    import functools
+
+    kube = KubeCore()
+    provider = decorate(FakeCloudProvider(catalog=instance_types(8)))
+    provisioning = ProvisioningController(
+        kube, provider,
+        batcher_factory=functools.partial(
+            Batcher, idle_seconds=0.05, max_seconds=0.5))
+    manager = Manager(kube)
+    manager.register(provisioning, workers=2)
+    manager.register(SelectionController(kube, provisioning), workers=16)
+    from karpenter_tpu.controllers.counter import CounterController
+    from karpenter_tpu.controllers.node import NodeController
+    from karpenter_tpu.controllers.termination import TerminationController
+
+    manager.register(NodeController(kube), workers=4)
+    manager.register(TerminationController(kube, provider), workers=4)
+    manager.register(CounterController(kube))
+    prov = Provisioner()
+    prov.metadata.name = "chaos"
+    kube.create(prov)
+    manager.start()
+    yield kube, manager, provisioning
+    manager.stop()
+
+
+class TestChaos:
+    def test_invariants_under_concurrent_mutation(self, stack):
+        kube, manager, provisioning = stack
+        rng = random.Random(20260730)
+        created, deleted = [], set()
+        stop = threading.Event()
+        errors = []
+
+        def chaos():
+            i = 0
+            while not stop.is_set():
+                try:
+                    op = rng.random()
+                    if op < 0.55 or not created:
+                        pod = unschedulable_pod(
+                            requests={"cpu": f"{rng.choice([100, 500, 1500])}m",
+                                      "memory": f"{rng.choice([64, 512])}Mi"},
+                            name=f"chaos-{i}")
+                        i += 1
+                        kube.create(pod)
+                        created.append(pod.metadata.name)
+                    elif op < 0.8:
+                        name = rng.choice(created)
+                        if name not in deleted:
+                            deleted.add(name)
+                            try:
+                                kube.delete("Pod", name)
+                            except NotFound:
+                                pass
+                    else:
+                        nodes = kube.scan("Node", lambda n: n.metadata.name)
+                        if nodes:
+                            try:
+                                kube.delete("Node", rng.choice(nodes), "")
+                            except NotFound:
+                                pass
+                    time.sleep(rng.uniform(0.001, 0.01))
+                except Exception as e:  # invariant: API ops never explode
+                    errors.append(repr(e))
+                    return
+
+        t = threading.Thread(target=chaos, daemon=True)
+        t.start()
+        time.sleep(CHAOS_SECONDS)
+        stop.set()
+        t.join(timeout=5.0)
+        assert not errors, f"chaos thread died: {errors[0]}"
+
+        # settle: surviving provisionable pods must eventually bind
+        survivors = [n for n in created if n not in deleted]
+        deadline = time.monotonic() + 45.0
+        unbound = survivors
+        while time.monotonic() < deadline:
+            unbound = []
+            for name in survivors:
+                try:
+                    node_name = kube.read("Pod", name, "default",
+                                          lambda p: p.spec.node_name)
+                except NotFound:
+                    continue  # deleted by a controller (eviction) — fine
+                if not node_name:
+                    unbound.append(name)
+            if not unbound:
+                break
+            time.sleep(0.25)
+        assert not unbound, (
+            f"{len(unbound)}/{len(survivors)} surviving pods never bound "
+            f"(e.g. {unbound[:5]})")
+
+        # the control plane is still alive
+        assert manager.healthz(), "a reconcile worker died during chaos"
+
+        # referential integrity: bound pods point at live nodes
+        node_names = set(kube.scan("Node", lambda n: n.metadata.name))
+        bound_to = kube.scan(
+            "Pod", lambda p: (p.metadata.name, p.spec.node_name))
+        for pod_name, node in bound_to:
+            if node:
+                assert node in node_names, (
+                    f"pod {pod_name} bound to nonexistent node {node}")
+
+        # kubecore's spec.nodeName index agrees with the objects
+        for node in node_names:
+            indexed = {p.metadata.name for p in kube.pods_on_node(node)}
+            direct = {name for name, n in bound_to if n == node}
+            assert indexed == direct, f"index drift on node {node}"
+
+        # nodes carry the provisioner label and unique names
+        labels = kube.scan(
+            "Node", lambda n: n.metadata.labels.get(
+                wellknown.PROVISIONER_NAME_LABEL))
+        assert all(lb == "chaos" for lb in labels)
+        names = kube.scan("Node", lambda n: n.metadata.name)
+        assert len(names) == len(set(names))
