@@ -24,7 +24,6 @@ from karpenter_tpu.cloudprovider.aws.vendor import (
     AWSProvider,
     CAPACITY_TYPE_ON_DEMAND,
     CAPACITY_TYPE_SPOT,
-    AWS_TO_KUBE_ARCHITECTURES,
     merge_tags,
 )
 from karpenter_tpu.cloudprovider.spi import InstanceType
