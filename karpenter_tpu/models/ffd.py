@@ -114,6 +114,10 @@ def solve_ffd_device(
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown device kernel {kernel!r}: "
                          "expected None, 'xla' or 'pallas'")
+    if kernel == "pallas" and enc.num_shapes > 4096:
+        # the fused VMEM kernel is validated to the 4096-shape bucket; the
+        # block-tiled XLA scan is the executor built for the 8192 bucket
+        kernel = "xla"
     use_cost = cost_tiebreak and prices is not None
     if kernel == "pallas" and not use_cost:
         import functools
