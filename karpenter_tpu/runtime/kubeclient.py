@@ -107,6 +107,20 @@ def _encode(obj) -> Dict:
     return codec_core.encode_obj(obj)
 
 
+class _WatchStream:
+    """Severable handle on one live watch stream. Holds BOTH the
+    HTTPConnection and the raw socket captured at request time: for a
+    close-delimited response http.client detaches the socket inside
+    getresponse() (conn.sock → None while the response keeps the fd via
+    makefile), so conn alone is not enough to interrupt a blocked read."""
+
+    __slots__ = ("conn", "sock")
+
+    def __init__(self, conn: http.client.HTTPConnection):
+        self.conn = conn
+        self.sock = None  # filled in right after conn.request()
+
+
 class KubeApiClient:
     def __init__(
         self,
@@ -142,7 +156,7 @@ class KubeApiClient:
         # live streaming connection per watch queue, so unwatch() can close
         # it and unblock the thread's read immediately (not after the 300 s
         # socket timeout)
-        self._watch_conns: Dict[int, http.client.HTTPConnection] = {}
+        self._watch_conns: Dict[int, "_WatchStream"] = {}
         # one persistent keep-alive connection PER THREAD: the controller
         # plane issues thousands of small requests per provisioning pass,
         # and a connection per request both costs a TCP handshake each and
@@ -535,18 +549,26 @@ class KubeApiClient:
         return q
 
     @staticmethod
-    def _sever(conn) -> None:
-        """Force-unblock any thread reading this connection: close() alone
-        does not reliably interrupt a concurrent recv(); shutdown() does."""
+    def _sever(entry) -> None:
+        """Force-unblock any thread reading this stream: close() alone does
+        not reliably interrupt a concurrent recv(); shutdown() does. The
+        shutdown must target the RAW socket captured at request time
+        (entry.sock), not conn.sock — a close-delimited watch response
+        (no Content-Length, no chunking) makes http.client detach the
+        socket from the connection inside getresponse() (conn.sock becomes
+        None, the response keeps the fd via makefile), so a conn-level
+        shutdown silently misses the fd the stream thread is blocked on."""
         import socket as _socket
 
+        for sock in (entry.sock, entry.conn.sock):
+            if sock is None:
+                continue
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
         try:
-            if conn.sock is not None:
-                conn.sock.shutdown(_socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            conn.close()
+            entry.conn.close()
         except OSError:
             pass
 
@@ -630,10 +652,19 @@ class KubeApiClient:
         if rv:
             params["resourceVersion"] = rv
         conn = self._conn(timeout=300.0)
-        self._watch_conns[id(q)] = conn
+        entry = _WatchStream(conn)
+        self._watch_conns[id(q)] = entry
         try:
+            if not self._watch_active(q):
+                return  # unwatch raced the re-list; never open the stream
             conn.request("GET", path + "?" + urlencode(params),
                          headers=self._headers())
+            # capture the raw socket NOW: getresponse() may detach it from
+            # the connection (close-delimited response), after which only
+            # this reference lets unwatch() interrupt the blocking read
+            entry.sock = conn.sock
+            if not self._watch_active(q):
+                return  # unwatch raced between registration and connect
             resp = conn.getresponse()
             if resp.status == 410:
                 raise ResourceExpired(f"watch {kind}: gone (410)")
@@ -666,5 +697,8 @@ class KubeApiClient:
                         self._cache_store(kind, obj, id(q))
                     q.put(Event(etype, obj))
         finally:
+            # sever the entry itself (not just whatever is still in the
+            # dict): if unwatch already popped it, the pop here is a no-op
+            # but the socket still needs closing from this side
             self._watch_conns.pop(id(q), None)
-            conn.close()
+            self._sever(entry)
