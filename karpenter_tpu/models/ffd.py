@@ -76,6 +76,7 @@ def solve_ffd_device(
     cost_tiebreak: bool = False,
     max_shapes: Optional[int] = None,  # decline above this cardinality
     enc: Optional[EncodedProblem] = None,  # precomputed (possibly unpadded)
+    pallas_max_shapes: int = 8192,  # pallas-validated bucket ceiling
 ) -> Optional[HostSolveResult]:
     """Solve on device; None when the problem is not device-encodable
     (caller falls back to the host oracle). Pods may arrive unsorted; the
@@ -114,9 +115,10 @@ def solve_ffd_device(
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown device kernel {kernel!r}: "
                          "expected None, 'xla' or 'pallas'")
-    if kernel == "pallas" and enc.num_shapes > 4096:
-        # the fused VMEM kernel is validated to the 4096-shape bucket; the
-        # block-tiled XLA scan is the executor built for the 8192 bucket
+    if kernel == "pallas" and enc.num_shapes > pallas_max_shapes:
+        # the fused VMEM kernel is routed only to its hardware-validated
+        # buckets (SolverConfig.pallas_max_shapes); the block-tiled XLA
+        # scan is the executor built for anything above
         kernel = "xla"
     use_cost = cost_tiebreak and prices is not None
     if kernel == "pallas" and not use_cost:

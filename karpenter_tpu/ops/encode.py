@@ -67,11 +67,39 @@ def _gcd_scale(columns: List[List[int]]) -> Optional[Tuple[int, ...]]:
     return tuple(scales)
 
 
+def _dedupe_interned(sids: np.ndarray, gen: int, pod_ids: Sequence[int]):
+    """Vectorized pod→shape dedupe over interned shape ids. Returns
+    (vecs descending, counts, pod-id groups) with the exact semantics of
+    the dict path — shapes ordered descending by full resource vector, pod
+    ids within a shape in original batch order — or None when the intern
+    table rolled over under the caller (generation mismatch: fall back)."""
+    from karpenter_tpu.solver.adapter import interned_vecs_snapshot
+
+    sids = np.asarray(sids, dtype=np.int64)
+    uniq, inverse, cnts = np.unique(
+        sids, return_inverse=True, return_counts=True)
+    uniq_vecs = interned_vecs_snapshot(uniq, gen)
+    if uniq_vecs is None:
+        return None
+    order = sorted(range(len(uniq)),
+                   key=lambda i: tuple(-v for v in uniq_vecs[i]))
+    pos = np.empty(len(uniq), np.int64)
+    pos[np.asarray(order, np.int64)] = np.arange(len(uniq), dtype=np.int64)
+    shape_of_pod = pos[inverse]
+    sort_order = np.argsort(shape_of_pod, kind="stable")
+    pid_sorted = np.asarray(pod_ids, dtype=np.int64)[sort_order]
+    counts_ord = cnts[np.asarray(order, np.int64)]
+    bounds = np.cumsum(counts_ord)[:-1]
+    groups = [seg.tolist() for seg in np.split(pid_sorted, bounds)]
+    return ([uniq_vecs[i] for i in order], counts_ord.tolist(), groups)
+
+
 def encode(
     pod_vecs: Sequence[Vec],
     pod_ids: Sequence[int],
     packables: Sequence[Packable],
     pad: bool = True,
+    sids: Optional[Tuple[np.ndarray, int]] = None,
 ) -> Optional[EncodedProblem]:
     """Returns None when the problem can't be encoded exactly (host fallback).
 
@@ -93,20 +121,34 @@ def encode(
         return None
 
     # -- dedupe pods into shapes ------------------------------------------
-    by_vec: Dict[Vec, List[int]] = {}
-    for vec, pid in zip(pod_vecs, pod_ids):
-        by_vec.setdefault(vec, []).append(pid)
-    # descending by full resource vector: the same total order the host
-    # oracle sorts pods with (host_ffd.pack), so tie-breaking agrees
-    ordered = sorted(by_vec.items(), key=lambda kv: tuple(-v for v in kv[0]))
+    deduped = None
+    if sids is not None and len(sids[0]) == len(pod_vecs):
+        # vectorized: interned shape ids (adapter._intern_vec, assigned at
+        # marshal/ingest time) dedupe via np.unique — no Python loop over
+        # the pod axis. Ordering/grouping semantics are identical to the
+        # dict path below (differentially tested in tests/test_encode_limits);
+        # an intern-table rollover mid-flight returns None → dict fallback
+        deduped = _dedupe_interned(sids[0], sids[1], pod_ids)
+    if deduped is not None:
+        ordered, counts_list, groups = deduped
+    else:
+        by_vec: Dict[Vec, List[int]] = {}
+        for vec, pid in zip(pod_vecs, pod_ids):
+            by_vec.setdefault(vec, []).append(pid)
+        # descending by full resource vector: the same total order the host
+        # oracle sorts pods with (host_ffd.pack), so tie-breaking agrees
+        items = sorted(by_vec.items(), key=lambda kv: tuple(-v for v in kv[0]))
+        ordered = [vec for vec, _ in items]
+        counts_list = [len(pids) for _, pids in items]
+        groups = [pids for _, pids in items]
     shape_vecs: List[List[int]] = []
     counts: List[int] = []
     shape_pods: List[List[int]] = []
-    for vec, pids in ordered:
+    for vec, n, pids in zip(ordered, counts_list, groups):
         reserve_vec = list(vec)
         reserve_vec[R_PODS] += 10**9  # implicit pods:1 in nano units
         shape_vecs.append(reserve_vec)
-        counts.append(len(pids))
+        counts.append(n)
         shape_pods.append(pids)
 
     S, T = len(shape_vecs), len(packables)

@@ -83,10 +83,16 @@ def pack_batch_sharded_flat(
         one = functools.partial(pack_chunk_flat, num_iters=num_iters)
     vmapped = jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0, 0, 0))
     spec = P("batch")
+    # check_vma=False: problems are independent per shard (no collectives,
+    # nothing replicated), and the pallas kernel's out_shape carries no vma
+    # annotation — with checking on, real-TPU pallas-under-shard_map fails
+    # to trace (observed r4) and silently demoted every batched solve to
+    # the xla kernel via the retry ring
     return shard_map(
         vmapped, mesh=mesh,
         in_specs=(spec,) * 8,
         out_specs=spec,
+        check_vma=False,
     )(shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit)
 
 

@@ -62,12 +62,65 @@ def _compute_pod_marshal(pod: Pod) -> Tuple[Vec, int]:
     return tuple(v), special
 
 
-def _marshal(pod: Pod) -> Tuple[Vec, int]:
-    """The (vector, special-resource bitmask) pair for a pod, cached on the
-    Pod object. Single point of truth for the cache attribute and layout."""
+# -- shape interning --------------------------------------------------------
+# Every distinct resource vector gets a stable small integer id at marshal
+# (watch-ingest) time. The encoder's pod→shape dedupe then runs as numpy
+# np.unique over int64 ids instead of a 50k-iteration Python dict loop
+# (~18 ms → ~2 ms at the headline config). Nano-unit vectors themselves
+# can exceed int64 (memory beyond ~9 Gi), so the ids — not the vectors —
+# are what the vectorized path carries.
+_INTERN_LOCK = threading.Lock()
+_VEC_INTERN: dict = {}
+_VEC_BY_ID: List[Vec] = []
+# bounded: a cluster churning high-cardinality request vectors for the
+# process lifetime must not grow the table forever. Crossing the cap bumps
+# the generation and clears the table; cached pod entries and in-flight
+# sid batches carry their generation, and any generation mismatch makes
+# the consumer fall back to the (always-correct) dict dedupe — a stale sid
+# can never index the wrong vector.
+_INTERN_MAX = 1 << 20
+_INTERN_GEN = 0
+
+
+def _intern_vec(vec: Vec) -> Tuple[int, int]:
+    """Intern under the lock; returns (sid, generation) consistently."""
+    global _INTERN_GEN
+    with _INTERN_LOCK:
+        sid = _VEC_INTERN.get(vec)
+        if sid is None:
+            if len(_VEC_BY_ID) >= _INTERN_MAX:
+                _VEC_INTERN.clear()
+                _VEC_BY_ID.clear()
+                _INTERN_GEN += 1
+            sid = len(_VEC_BY_ID)
+            _VEC_BY_ID.append(vec)
+            _VEC_INTERN[vec] = sid
+        return sid, _INTERN_GEN
+
+
+def interned_vecs_snapshot(sids, gen: int) -> Optional[List[Vec]]:
+    """Map interned ids back to vectors, verifying the table is still the
+    generation the ids were minted in; None = caller must fall back."""
+    with _INTERN_LOCK:
+        if gen != _INTERN_GEN:
+            return None
+        try:
+            return [_VEC_BY_ID[int(s)] for s in sids]
+        except IndexError:
+            return None
+
+
+def _marshal(pod: Pod) -> Tuple[Vec, int, int, int]:
+    """The (vector, special-bitmask, interned shape id, intern generation)
+    tuple for a pod, cached on the Pod object. Single point of truth for
+    the cache attribute and layout. A cached entry from an older intern
+    generation re-interns on next touch (vector and mask are reused)."""
     cached = pod.__dict__.get("_marshal")
-    if cached is None:
-        cached = pod.__dict__["_marshal"] = _compute_pod_marshal(pod)
+    if cached is None or cached[3] != _INTERN_GEN:
+        vec, special = (_compute_pod_marshal(pod) if cached is None
+                        else (cached[0], cached[1]))
+        sid, gen = _intern_vec(vec)
+        cached = pod.__dict__["_marshal"] = (vec, special, sid, gen)
     return cached
 
 
@@ -107,17 +160,38 @@ def marshal_pods(pods: Sequence[Pod]) -> Tuple[List[Vec], frozenset]:
     resources). The solve path needs both; two separate passes over 50k
     pods cost ~2× the attribute-gather time (measured ~40 ms/solve), which
     is real money against the 200 ms budget."""
+    vecs, required, _ = marshal_pods_interned(pods)
+    return vecs, required
+
+
+def marshal_pods_interned(pods: Sequence[Pod]):
+    """marshal_pods + the interned shape ids — the encoder's vectorized
+    dedupe input. One pass, same cache. The third element is
+    ``(int64 array, generation)`` or None when the batch spans an intern
+    table reset (consumers fall back to the dict dedupe)."""
+    import numpy as np
+
     m = _marshal
     vecs: List[Vec] = []
     append = vecs.append
+    sid_list: List[int] = []
+    sid_append = sid_list.append
     mask = 0
+    gen_seen = -1
+    mixed = False
     for pod in pods:
-        vec, bits = m(pod)
+        vec, bits, sid, gen = m(pod)
         append(vec)
+        sid_append(sid)
         mask |= bits
+        if gen != gen_seen:
+            mixed = gen_seen != -1
+            gen_seen = gen
     required = frozenset(
         name for bit, name in enumerate(_SPECIAL_RESOURCES) if mask & (1 << bit))
-    return vecs, required
+    sids = (None if mixed or gen_seen < 0
+            else (np.array(sid_list, dtype=np.int64), gen_seen))
+    return vecs, required, sids
 
 
 def resource_list_vector(rl: res.ResourceList) -> Vec:

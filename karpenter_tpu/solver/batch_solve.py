@@ -25,11 +25,14 @@ from karpenter_tpu.api.core import Pod
 from karpenter_tpu.cloudprovider.spi import InstanceType
 from karpenter_tpu.models.ffd import MAX_CHUNKS, _decode, default_kernel
 from karpenter_tpu.ops.encode import encode
-from karpenter_tpu.solver.adapter import build_packables_cached, marshal_pods
+from karpenter_tpu.solver.adapter import (
+    build_packables_cached, marshal_pods_interned,
+)
 from karpenter_tpu.solver import solve as solve_module
 from karpenter_tpu.solver.solve import (
     SolveResult, SolverConfig, materialize, solve_with_packables,
 )
+from karpenter_tpu.utils.gcguard import gc_deferred
 from karpenter_tpu.utils.profiling import trace
 
 log = logging.getLogger("karpenter.solver.batch")
@@ -49,28 +52,48 @@ def solve_batch(problems: Sequence[Problem],
     Every problem is prepared (packables + pod vectors) exactly once; the
     fallback paths reuse the preparation instead of recomputing it."""
     config = config or SolverConfig()
+    with gc_deferred():
+        return _solve_batch(problems, config)
+
+
+def _solve_batch(problems: Sequence[Problem],
+                 config: SolverConfig) -> List[SolveResult]:
     prepared = []
     for prob in problems:
-        vecs, required = marshal_pods(prob.pods)
+        vecs, required, sids = marshal_pods_interned(prob.pods)
         packables, sorted_types = build_packables_cached(
             prob.instance_types, prob.constraints, prob.pods, prob.daemons,
             required=required)
-        prepared.append((packables, sorted_types, vecs))
+        prepared.append((packables, sorted_types, vecs, sids))
 
     # gate on the cheap signals BEFORE paying for encoding: a batch of tiny
     # problems is faster on the native/host executors than a device trip
     total_pods = sum(len(p.pods) for p in problems)
     batch_idx: List[int] = []
     encs = []
+    raw_encs: List[Optional[object]] = [None] * len(problems)
     if config.use_device and len(problems) >= 2 and \
             total_pods >= config.device_min_pods:
+        from karpenter_tpu.ops.encode import pad_encoding
+
         for i, prob in enumerate(problems):
-            packables, _, vecs = prepared[i]
-            enc = encode(vecs, list(range(len(prob.pods))), packables) \
+            packables, _, vecs, sids = prepared[i]
+            # exact-size encode once; problems excluded from the batch
+            # hand it to the solo path unchanged (the O(pods) dedupe +
+            # GCD scaling is never repeated), batch members pad to the
+            # static device buckets
+            enc = encode(vecs, list(range(len(prob.pods))), packables,
+                         pad=False, sids=sids) \
                 if packables else None
-            if enc is not None:
-                batch_idx.append(i)
-                encs.append(enc)
+            raw_encs[i] = enc
+            # same cardinality routing as the solo path (models/ffd.py:106):
+            # beyond the largest device bucket the per-pod native kernel is
+            # the built-for-it executor — keep such problems out of the batch
+            if enc is not None and enc.num_shapes <= config.device_max_shapes:
+                penc = pad_encoding(enc)
+                if penc is not None:
+                    batch_idx.append(i)
+                    encs.append(penc)
 
     results: List[Optional[SolveResult]] = [None] * len(problems)
     if len(batch_idx) >= 2 and not solve_module._WATCHDOG.tripped():
@@ -92,6 +115,7 @@ def solve_batch(problems: Sequence[Problem],
             log.exception("batched device solve failed; falling back per problem")
             host_results = None
         if host_results is not None:
+            solve_module.record_executor("device-batch")
             for j, i in enumerate(batch_idx):
                 results[i] = materialize(
                     host_results[j], problems[i].pods, prepared[i][1],
@@ -99,10 +123,10 @@ def solve_batch(problems: Sequence[Problem],
 
     for i, prob in enumerate(problems):
         if results[i] is None:  # not batched (or batch failed): solo path
-            packables, sorted_types, vecs = prepared[i]
+            packables, sorted_types, vecs, sids = prepared[i]
             results[i] = solve_with_packables(
                 prob.constraints, prob.pods, packables, sorted_types, vecs,
-                config)
+                config, sids=sids, enc=raw_encs[i])
     return results
 
 
@@ -126,6 +150,10 @@ def _device_batch(encs, packables_list, config: SolverConfig):
     (shapes, counts, dropped, totals, reserved0, valid,
      last_valid, pods_unit, B) = batch
     S = shapes.shape[1]
+    if kernel == "pallas" and S > config.pallas_max_shapes:
+        # padded batch landed above the pallas-validated bucket — the
+        # block-tiled XLA scan is the executor for it (models/ffd.py:117)
+        kernel = "xla"
     # one transfer for the invariants (tunnel-latency bound, models/ffd.py)
     shapes, totals, reserved0, valid, last_valid, pods_unit = jax.device_put(
         (shapes, totals, reserved0, valid, last_valid, pods_unit))

@@ -22,7 +22,10 @@ from karpenter_tpu.models.cost import (
 )
 from karpenter_tpu.models.ffd import solve_ffd_device
 from karpenter_tpu.solver import host_ffd
-from karpenter_tpu.solver.adapter import build_packables_cached, marshal_pods
+from karpenter_tpu.solver.adapter import (
+    build_packables_cached, marshal_pods_interned,
+)
+from karpenter_tpu.utils.gcguard import gc_deferred
 from karpenter_tpu.utils.profiling import trace
 
 log = logging.getLogger("karpenter.solver")
@@ -55,10 +58,48 @@ class _DeviceWatchdog:
 
     def run(self, fn, timeout_s: float, breaker_s: float):
         """fn() under the deadline; TimeoutError opens the breaker and is
-        re-raised (callers fall through their failure rings)."""
+        re-raised (callers fall through their failure rings).
+
+        The deadline is armed from when fn actually STARTS executing, not
+        from submit: the single serialized worker means queue-wait includes
+        any in-flight solve (two overlapping cold compiles from the
+        provisioning and consolidation threads are legitimate), and counting
+        that wait against this call's deadline would spuriously open the
+        breaker with the transport healthy. Queue-wait gets its own equal
+        budget — a worker wedged on a hung transport never starts the next
+        call, and that genuinely is breaker-worthy."""
         from concurrent.futures import TimeoutError as FutureTimeout
 
-        future = self._executor().submit(fn)
+        started = threading.Event()
+
+        def wrapped():
+            started.set()
+            return fn()
+
+        future = self._executor().submit(wrapped)
+        if not started.wait(timeout=timeout_s):
+            # never started: the worker is occupied past a full deadline —
+            # either wedged on a dead transport or backed up beyond use.
+            # cancel() returning False means fn began in the wait/cancel
+            # race window: the worker is healthy after all — fall through
+            # and arm the run deadline normally instead of tripping the
+            # breaker (and abandoning a pool with a LIVE solve on it)
+            if future.cancel():
+                with self._lock:
+                    self._open_until = time.monotonic() + breaker_s
+                    if self._pool is not None:
+                        # cancelled before start: the worker is idle or
+                        # finishing someone else's call — let it exit
+                        # instead of leaking one thread per trip (the
+                        # FutureTimeout path below cannot do this: its
+                        # thread is genuinely wedged)
+                        self._pool.shutdown(wait=False)
+                    self._pool = None
+                log.error(
+                    "device solve never started within %.0fs (worker "
+                    "occupied) — circuit open for %.0fs (host executors "
+                    "answer meanwhile)", timeout_s, breaker_s)
+                raise TimeoutError("device solve watchdog expired in queue")
         try:
             result = future.result(timeout=timeout_s)
         except FutureTimeout:
@@ -78,6 +119,34 @@ class _DeviceWatchdog:
 
 
 _WATCHDOG = _DeviceWatchdog()
+
+# -- solver health introspection -------------------------------------------
+# Which executor ring answered the most recent solve, and when. Surfaced as
+# a Provisioner status condition (controllers/provisioning.py) so operators
+# can see a degraded hot loop (`kubectl get provisioner`) — the reference
+# has no equivalent signal; this framework has more rings to report.
+_HEALTH_LOCK = threading.Lock()
+_HEALTH = {
+    "last_executor": None,      # "device" | "device-batch" | "native" | "host"
+    "last_solve_unix": None,
+    "last_solve_ms": None,
+}
+
+
+def record_executor(executor: str, elapsed_s: Optional[float] = None) -> None:
+    with _HEALTH_LOCK:
+        _HEALTH["last_executor"] = executor
+        _HEALTH["last_solve_unix"] = time.time()
+        _HEALTH["last_solve_ms"] = (
+            round(elapsed_s * 1000.0, 3) if elapsed_s is not None else None)
+
+
+def solver_health() -> dict:
+    """Snapshot: breaker state + last executor ring + last solve stats."""
+    with _HEALTH_LOCK:
+        h = dict(_HEALTH)
+    h["breaker_open"] = _WATCHDOG.tripped()
+    return h
 
 
 @dataclass
@@ -111,6 +180,12 @@ class SolverConfig:
     # The kernel itself supports up to the 8192-shape bucket; raise this on
     # local-TPU deployments where the round trip is cheap.
     device_max_shapes: int = 4096
+    # largest shape bucket the fused pallas VMEM kernel is routed to;
+    # requests above it take the block-tiled XLA scan. 8192 validated on
+    # hardware r4: exact vs the per-pod C++ oracle at 5k and 8k distinct
+    # shapes (50k pods × 400 types), and ~4× the XLA scan's speed there
+    # (9.5 s vs 37 s warm) — see BASELINE.md config 6
+    pallas_max_shapes: int = 8192
     # prefer the C++ kernel over the per-pod Python oracle for host solves
     use_native: bool = True
     # order each node's instance-type options cheapest-first when the
@@ -152,11 +227,17 @@ def solve(
     config: Optional[SolverConfig] = None,
 ) -> SolveResult:
     config = config or SolverConfig()
-    pod_vecs, required = marshal_pods(pods)  # one pass: vecs + special mask
-    packables, sorted_types = build_packables_cached(
-        instance_types, constraints, pods, daemons, required=required)
-    return solve_with_packables(constraints, pods, packables, sorted_types,
-                                pod_vecs, config)
+    # GC deferred across the whole public path: a generational collection
+    # landing mid-solve costs 20+ ms of tail (utils/gcguard.py); it runs
+    # between provisioning passes instead
+    with gc_deferred():
+        # one pass: vecs + special mask + interned shape ids
+        pod_vecs, required, sids = marshal_pods_interned(pods)
+        packables, sorted_types = build_packables_cached(
+            instance_types, constraints, pods, daemons, required=required)
+        return solve_with_packables(constraints, pods, packables,
+                                    sorted_types, pod_vecs, config,
+                                    sids=sids)
 
 
 def solve_with_packables(
@@ -166,9 +247,12 @@ def solve_with_packables(
     sorted_types,
     pod_vecs,
     config: SolverConfig,
+    sids=None,
+    enc=None,
 ) -> SolveResult:
     """solve() after problem preparation — entry for callers (batch_solve)
-    that already built packables/vectors and must not pay for them twice."""
+    that already built packables/vectors (and possibly the exact-size
+    encoding) and must not pay for them twice."""
     if not packables:
         # same contract as host_ffd.pack: no viable types → every pod is
         # reported unschedulable (the reference only logs, packer.go:119-121,
@@ -191,13 +275,14 @@ def solve_with_packables(
     # ONE exact encoding feeds every ring: the device path pads it to the
     # static buckets, the native C++ path uses it as-is — the O(pods)
     # dedupe + GCD scaling is never repeated across fallbacks
-    enc = None
-    if config.use_device or config.use_native:
+    if enc is None and (config.use_device or config.use_native):
         from karpenter_tpu.ops.encode import encode
 
-        enc = encode(pod_vecs, pod_ids, packables, pad=False)
+        enc = encode(pod_vecs, pod_ids, packables, pad=False, sids=sids)
 
     result = None
+    executor = None
+    t_ring = time.perf_counter()
     if config.use_device and len(pods) >= config.device_min_pods and \
             enc is not None and not _WATCHDOG.tripped():
         def _device_solve():
@@ -207,7 +292,8 @@ def solve_with_packables(
                 chunk_iters=config.chunk_iters,
                 kernel=config.device_kernel,
                 prices=prices, cost_tiebreak=prices is not None,
-                max_shapes=config.device_max_shapes, enc=enc)
+                max_shapes=config.device_max_shapes, enc=enc,
+                pallas_max_shapes=config.pallas_max_shapes)
 
         try:
             with trace("karpenter.solve.device"):
@@ -220,6 +306,8 @@ def solve_with_packables(
         except Exception:  # device failure ring: never drop a provisioning loop
             log.exception("device solve failed; falling back to host FFD")
             result = None
+        if result is not None:
+            executor = "device"
     if result is None and config.use_native:
         from karpenter_tpu.solver.native_ffd import solve_ffd_native_auto
 
@@ -231,11 +319,15 @@ def solve_with_packables(
         except Exception:  # same failure posture as the device ring
             log.exception("native solve failed; falling back to host FFD")
             result = None
+        if result is not None and executor is None:
+            executor = "native"
     if result is None:
         result = host_ffd.pack(pod_vecs, pod_ids, packables,
                                max_instance_types=config.max_instance_types,
                                prices=prices,
                                cost_tiebreak=prices is not None)
+        executor = "host"
+    record_executor(executor, time.perf_counter() - t_ring)
 
     return materialize(result, pods, sorted_types, constraints, config)
 

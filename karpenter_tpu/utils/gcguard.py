@@ -1,0 +1,46 @@
+"""Defer Python GC out of latency-critical sections.
+
+The solve hot path allocates heavily (50k-pod marshal gathers, shape
+groups, packing records); a generational collection landing mid-solve adds
+20+ ms to the tail (measured: config-4 p99 187.5 → 164.9 ms with GC
+deferred). The reference's Go runtime GC is concurrent so its packer never
+sees this — the Python-native equivalent is to hold collection during the
+solve and let it run between provisioning passes, where it costs latency
+nobody is waiting on.
+
+Reentrant and thread-safe: a depth counter tracks nested/concurrent
+sections; GC re-enables only when the last one exits. If GC was already
+disabled by the application, the guard leaves it alone.
+"""
+
+from __future__ import annotations
+
+import gc
+import threading
+
+_lock = threading.Lock()
+_depth = 0
+_we_disabled = False
+
+
+class gc_deferred:
+    """Context manager: GC off inside, restored (and counters left to
+    amortize naturally) when the outermost section exits."""
+
+    def __enter__(self):
+        global _depth, _we_disabled
+        with _lock:
+            if _depth == 0 and gc.isenabled():
+                gc.disable()
+                _we_disabled = True
+            _depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _depth, _we_disabled
+        with _lock:
+            _depth -= 1
+            if _depth == 0 and _we_disabled:
+                gc.enable()
+                _we_disabled = False
+        return False

@@ -116,6 +116,41 @@ class TestBatchEquivalence:
             assert not got.unschedulable
 
 
+class TestBatchRouting:
+    def test_high_cardinality_problem_excluded_from_batch(self, monkeypatch):
+        """A problem above device_max_shapes must not ride the batched
+        device call (advisor finding: the batch path bypassed the solo
+        path's cardinality routing at models/ffd.py:106) — it takes the
+        per-pod native ring solo, and results still match sequential."""
+        import karpenter_tpu.solver.batch_solve as bs
+
+        problems = mixed_problems(seed=5, n=2)
+        many = [make_pod({"cpu": f"{100 + i}m", "memory": "64Mi"})
+                for i in range(40)]
+        for j, p in enumerate(many):
+            p.metadata.name = f"hc-{j}"
+        problems.append(Problem(constraints=problems[0].constraints,
+                                pods=many,
+                                instance_types=problems[0].instance_types))
+
+        seen_batches = []
+        real = bs._device_batch
+
+        def spying(encs, packables_list, config):
+            seen_batches.append([e.num_shapes for e in encs])
+            return real(encs, packables_list, config)
+
+        monkeypatch.setattr(bs, "_device_batch", spying)
+        config = SolverConfig(device_min_pods=1, device_max_shapes=32)
+        out = solve_batch(problems, config=config)
+        for batch in seen_batches:
+            assert all(s <= 32 for s in batch)
+        for prob, got in zip(problems, out):
+            want = solve(prob.constraints, prob.pods, prob.instance_types,
+                         config=config)
+            assert result_key(got) == result_key(want)
+
+
 class TestBatchKernels:
     def test_pallas_kernel_batch_matches(self):
         """vmapped pallas kernel (interpret off-TPU) in the batched path."""

@@ -46,6 +46,52 @@ class TestWatchdog:
         assert wd.run(lambda: 42, timeout_s=1.0, breaker_s=0.2) == 42
         assert not wd.tripped()
 
+    def test_queue_wait_does_not_count_against_deadline(self, fresh_watchdog):
+        """Two overlapping LEGITIMATE slow solves (e.g. cold compiles from
+        the provisioning and consolidation threads): the second call queues
+        behind the first on the serialized worker; its deadline must arm
+        from when it starts, not from submit (advisor finding r3)."""
+        import threading
+
+        wd = fresh_watchdog
+        results = {}
+
+        def first():
+            results["first"] = wd.run(
+                lambda: time.sleep(0.3) or "a", timeout_s=0.5, breaker_s=60.0)
+
+        t = threading.Thread(target=first)
+        t.start()
+        time.sleep(0.05)  # let the first call occupy the worker
+        # second call: 0.25s queue wait + 0.15s run > 0.3s deadline if
+        # measured from submit; must pass when measured from start
+        results["second"] = wd.run(
+            lambda: time.sleep(0.15) or "b", timeout_s=0.3, breaker_s=60.0)
+        t.join()
+        assert results == {"first": "a", "second": "b"}
+        assert not wd.tripped()
+
+    def test_worker_wedged_past_full_deadline_opens_breaker(
+            self, fresh_watchdog):
+        """A worker that never frees up (hung transport) still opens the
+        breaker: queue-wait gets its own equal budget."""
+        import threading
+
+        wd = fresh_watchdog
+
+        def hog():
+            try:
+                wd.run(lambda: time.sleep(5.0), timeout_s=10.0, breaker_s=60.0)
+            except TimeoutError:
+                pass
+
+        t = threading.Thread(target=hog, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        with pytest.raises(TimeoutError):
+            wd.run(lambda: "never", timeout_s=0.1, breaker_s=0.2)
+        assert wd.tripped()
+
     def test_success_closes_open_breaker(self, fresh_watchdog):
         wd = fresh_watchdog
         with pytest.raises(TimeoutError):

@@ -159,3 +159,111 @@ class TestHighCardinality:
         assert result.node_count == oracle.node_count
         covered = sum(len(node) for p in result.packings for node in p.pods)
         assert covered + len(result.unschedulable) == len(pods)
+
+
+class TestInternedDedupe:
+    """encode(sids=...) — the vectorized pod→shape dedupe over interned
+    shape ids — must be bit-identical to the dict path: same shape order,
+    same counts, same pod-id groups, same arrays."""
+
+    def _enc_pair(self, pods, catalog):
+        from karpenter_tpu.solver.adapter import (
+            build_packables, marshal_pods_interned,
+        )
+
+        constraints = universe_constraints(catalog)
+        vecs, required, sids = marshal_pods_interned(pods)
+        packables, _ = build_packables(catalog, constraints, pods, [])
+        ids = list(range(len(pods)))
+        return (encode(vecs, ids, packables, pad=False),
+                encode(vecs, ids, packables, pad=False, sids=sids))
+
+    def assert_identical(self, a, b):
+        assert a is not None and b is not None
+        np.testing.assert_array_equal(a.shapes, b.shapes)
+        np.testing.assert_array_equal(a.counts, b.counts)
+        np.testing.assert_array_equal(a.totals, b.totals)
+        np.testing.assert_array_equal(a.reserved0, b.reserved0)
+        assert a.shape_pods == b.shape_pods
+        assert a.scales == b.scales
+        assert (a.num_shapes, a.num_types) == (b.num_shapes, b.num_types)
+
+    def test_interned_matches_dict_path(self):
+        import random
+
+        rng = random.Random(42)
+        catalog = instance_types(10)
+        pods = []
+        for i in range(500):
+            pods.append(make_pod({
+                "cpu": f"{rng.choice([100, 250, 500, 1000])}m",
+                "memory": f"{rng.choice([64, 256, 512, 1024])}Mi"}))
+        self.assert_identical(*self._enc_pair(pods, catalog))
+
+    def test_interned_matches_with_duplicate_and_unique_shapes(self):
+        import random
+
+        rng = random.Random(7)
+        catalog = instance_types(8)
+        pods = [make_pod({"cpu": f"{100 + i}m", "memory": "64Mi"})
+                for i in range(60)]  # all distinct
+        pods += [make_pod({"cpu": "500m", "memory": "128Mi"})
+                 for _ in range(40)]  # one heavy duplicate group
+        rng.shuffle(pods)
+        a, b = self._enc_pair(pods, catalog)
+        self.assert_identical(a, b)
+
+    def test_interned_through_public_solve(self):
+        """The public solve() now routes through the interned path; result
+        must match a solve with interning disabled (sids=None fallback)."""
+        import random
+
+        from karpenter_tpu.solver import host_ffd
+        from karpenter_tpu.solver.adapter import build_packables, pod_vectors
+
+        rng = random.Random(3)
+        catalog = instance_types(10)
+        constraints = universe_constraints(catalog)
+        pods = [make_pod({
+            "cpu": f"{rng.choice([100, 300, 700, 1500])}m",
+            "memory": f"{rng.choice([128, 512, 2048])}Mi"})
+            for _ in range(300)]
+        got = solve(constraints, pods, catalog,
+                    config=SolverConfig(device_min_pods=1))
+        packables, _ = build_packables(catalog, constraints, pods, [])
+        want = host_ffd.pack(pod_vectors(pods), list(range(len(pods))),
+                             packables)
+        assert got.node_count == want.node_count
+
+    def test_intern_table_rollover_stays_correct(self, monkeypatch):
+        """Crossing the intern cap clears the table and bumps the
+        generation; marshaled batches spanning the rollover must still
+        encode correctly (via the dict fallback or re-interning) — and the
+        table size stays bounded."""
+        from karpenter_tpu.solver import adapter
+
+        monkeypatch.setattr(adapter, "_INTERN_MAX", 8)
+        # isolate from vecs interned by earlier tests: fresh table, a
+        # generation no cached pod entry can carry
+        monkeypatch.setattr(adapter, "_VEC_INTERN", {})
+        monkeypatch.setattr(adapter, "_VEC_BY_ID", [])
+        monkeypatch.setattr(adapter, "_INTERN_GEN", 10_000)
+        catalog = instance_types(6)
+        # 20 distinct shapes: crosses the 8-entry cap twice
+        pods = [make_pod({"cpu": f"{100 + i}m", "memory": "64Mi"})
+                for i in range(20)]
+        for p in pods:
+            adapter.invalidate_pod_marshal(p)
+        vecs, required, sids = adapter.marshal_pods_interned(pods)
+        packables, _ = build_packables(
+            catalog, universe_constraints(catalog), pods, [])
+        ids = list(range(len(pods)))
+        a = encode(vecs, ids, packables, pad=False)  # dict path, truth
+        b = encode(vecs, ids, packables, pad=False, sids=sids)
+        self.assert_identical(a, b) if sids is not None else None
+        assert len(adapter._VEC_BY_ID) <= 8
+        # a second marshal re-interns the (now current-generation) pods
+        vecs2, _, sids2 = adapter.marshal_pods_interned(pods)
+        c = encode(vecs2, ids, packables, pad=False, sids=sids2)
+        if c is not None and a is not None:
+            np.testing.assert_array_equal(a.shapes, c.shapes)

@@ -180,3 +180,55 @@ class TestPallasSolvePath:
         with pytest.raises(ValueError, match="unknown device kernel"):
             solve_ffd_device([pod_vector(p) for p in pods], [0], packables,
                              kernel="palas")
+
+
+class TestPallasRouting:
+    """Cardinality routing for the pallas kernel reflects hardware
+    measurement (r4): the 8192 bucket is pallas-validated (exact vs the
+    per-pod C++ oracle at 5k/8k distinct shapes on TPU), so requests up to
+    pallas_max_shapes=8192 keep the pallas kernel; above, the XLA scan."""
+
+    def _spy_problem(self, n_shapes):
+        catalog = instance_types(4)
+        pods = [make_pod({"cpu": f"{100 + i}m", "memory": "64Mi"})
+                for i in range(n_shapes)]
+        packables, _ = build_packables(
+            catalog, allow_all_constraints(catalog), pods, [])
+        vecs = [pod_vector(p) for p in pods]
+        return vecs, list(range(len(pods))), packables
+
+    def test_admits_pallas_to_8192(self, monkeypatch):
+        import karpenter_tpu.ops.pack_pallas as pp
+        from karpenter_tpu.models.ffd import solve_ffd_device
+        from karpenter_tpu.ops.pack import pack_chunk_flat
+
+        calls = {"pallas": 0}
+
+        def spy(*args, interpret=False, **kw):
+            calls["pallas"] += 1
+            kw.pop("prices", None)
+            kw.pop("cost_tiebreak", None)
+            return pack_chunk_flat(*args, **kw)
+
+        monkeypatch.setattr(pp, "pack_chunk_pallas_flat", spy)
+        # 4100 distinct shapes pads to the 8192 bucket — above the OLD cap,
+        # within the validated one
+        vecs, ids, packables = self._spy_problem(4100)
+        result = solve_ffd_device(vecs, ids, packables, kernel="pallas")
+        assert result is not None
+        assert calls["pallas"] >= 1, (
+            "pallas request in the validated 8192 bucket was demoted to xla")
+
+    def test_demotes_above_validated_bucket(self, monkeypatch):
+        import karpenter_tpu.ops.pack_pallas as pp
+        from karpenter_tpu.models.ffd import solve_ffd_device
+
+        def must_not_run(*a, **kw):
+            raise AssertionError("pallas kernel run above its validated cap")
+
+        monkeypatch.setattr(pp, "pack_chunk_pallas_flat", must_not_run)
+        vecs, ids, packables = self._spy_problem(600)
+        # force a low cap to exercise the demotion branch cheaply
+        result = solve_ffd_device(vecs, ids, packables, kernel="pallas",
+                                  pallas_max_shapes=512)
+        assert result is not None  # solved by the xla kernel instead

@@ -70,3 +70,46 @@ class TestPerfSmoke:
         fast = time.perf_counter() - t0
         assert fast < std, (
             f"fastcopy regressed below copy.deepcopy: {fast:.3f}s vs {std:.3f}s")
+
+
+class TestGcGuard:
+    def test_defers_and_restores(self):
+        import gc
+
+        from karpenter_tpu.utils.gcguard import gc_deferred
+
+        assert gc.isenabled()
+        with gc_deferred():
+            assert not gc.isenabled()
+            with gc_deferred():  # reentrant
+                assert not gc.isenabled()
+            assert not gc.isenabled()  # inner exit must not re-enable
+        assert gc.isenabled()
+
+    def test_respects_externally_disabled_gc(self):
+        import gc
+
+        from karpenter_tpu.utils.gcguard import gc_deferred
+
+        gc.disable()
+        try:
+            with gc_deferred():
+                assert not gc.isenabled()
+            assert not gc.isenabled()  # the guard didn't own the disable
+        finally:
+            gc.enable()
+
+    def test_solve_path_runs_under_guard(self):
+        """solve() must not leave GC disabled after returning."""
+        import gc
+
+        from karpenter_tpu.cloudprovider.fake.provider import instance_types
+        from karpenter_tpu.controllers.provisioning import universe_constraints
+        from karpenter_tpu.solver.solve import solve
+        from tests.expectations import unschedulable_pod
+
+        catalog = instance_types(6)
+        constraints = universe_constraints(catalog)
+        pods = [unschedulable_pod(requests={"cpu": "500m"}) for _ in range(20)]
+        solve(constraints, pods, catalog)
+        assert gc.isenabled()

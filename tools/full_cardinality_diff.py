@@ -1,0 +1,116 @@
+"""One-off full-size high-cardinality differential (VERDICT r3 item 5).
+
+Runs the per-pod C++ kernel (native/ffd.cc kt_ffd_pack_per_pod) against the
+Python per-pod oracle (solver/host_ffd.py) at the FULL bench config-6b
+scale — 50k pods, 25k distinct shapes, 400 types — asserting exact result
+keys (per-node pod sets, instance-type options, node count, unschedulable
+set). This is the regime where the C++ kernel's skip-list/cpu-jump
+optimizations matter most and where the in-bench check was previously
+subsampled to 1.5k shapes. Hours are acceptable; the result is recorded in
+CARDINALITY_DIFF.json and cited by BASELINE.md.
+
+Usage: python tools/full_cardinality_diff.py [--pods N] [--shapes N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def mkpods(n, distinct, seed):
+    from karpenter_tpu.api.core import (
+        Container, Pod, PodSpec, ResourceRequirements,
+    )
+
+    rng = random.Random(seed)
+    shapes = set()
+    while len(shapes) < distinct:
+        shapes.add((rng.randint(50, 4000), rng.randint(64, 4096)))
+    shapes = sorted(shapes)
+    return [
+        Pod(spec=PodSpec(containers=[Container(
+            resources=ResourceRequirements.make(requests={
+                "cpu": f"{c}m", "memory": f"{m}Mi"}))]))
+        for i in range(n) for c, m in (shapes[i % len(shapes)],)
+    ]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=50_000)
+    ap.add_argument("--shapes", type=int, default=25_000)
+    ap.add_argument("--seed", type=int, default=13)
+    ap.add_argument("--out", default="CARDINALITY_DIFF.json")
+    args = ap.parse_args()
+
+    from bench import make_catalog
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.solver import host_ffd
+    from karpenter_tpu.solver.adapter import build_packables_cached, pod_vectors
+    from karpenter_tpu.solver.native_ffd import solve_ffd_per_pod_native
+
+    catalog = make_catalog(400)
+    constraints = universe_constraints(catalog)
+    print(f"building {args.pods} pods / {args.shapes} shapes", flush=True)
+    pods = mkpods(args.pods, args.shapes, seed=args.seed)
+    for i, p in enumerate(pods):
+        p.metadata.name = f"hc-{i}"
+    packables, _ = build_packables_cached(catalog, constraints, pods, [])
+    vecs, ids = pod_vectors(pods), list(range(len(pods)))
+
+    t0 = time.perf_counter()
+    native = solve_ffd_per_pod_native(vecs, ids, packables)
+    t_native = time.perf_counter() - t0
+    if native is None:
+        print("no C++ toolchain; aborting", file=sys.stderr)
+        return 1
+    print(f"native: {native.node_count} nodes in {t_native:.1f}s", flush=True)
+
+    t0 = time.perf_counter()
+    oracle = host_ffd.pack(vecs, ids, packables)
+    t_oracle = time.perf_counter() - t0
+    print(f"python oracle: {oracle.node_count} nodes in {t_oracle:.1f}s",
+          flush=True)
+
+    # exact comparison: node count, unschedulable set, and the full
+    # node-by-node packing structure (type options + pod-id sets)
+    def key(res):
+        return (
+            res.node_count,
+            sorted(res.unschedulable),
+            sorted(
+                (tuple(pk.instance_type_indices), pk.node_quantity,
+                 tuple(sorted(tuple(sorted(n)) for n in pk.pod_ids)))
+                for pk in res.packings),
+        )
+
+    k_native, k_oracle = key(native), key(oracle)
+    exact = k_native == k_oracle
+    out = {
+        "pods": args.pods, "distinct_shapes": args.shapes,
+        "types": 400, "seed": args.seed,
+        "native_node_count": native.node_count,
+        "oracle_node_count": oracle.node_count,
+        "native_s": round(t_native, 2), "oracle_s": round(t_oracle, 2),
+        "exact_full_size": exact,
+    }
+    if not exact:
+        out["divergence"] = {
+            "node_count": [native.node_count, oracle.node_count],
+            "unschedulable_delta": len(set(k_native[1]) ^ set(k_oracle[1])),
+        }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out), flush=True)
+    return 0 if exact else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
