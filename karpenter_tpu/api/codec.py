@@ -11,14 +11,28 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
+from karpenter_tpu.api.codec_core import (
+    ts_from as codec_core_ts_from, ts_to as codec_core_ts_to,
+)
 from karpenter_tpu.api.constraints import Constraints, KubeletConfiguration, Limits, Taints
 from karpenter_tpu.api.core import NodeSelectorRequirement, ObjectMeta, Taint
-from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+from karpenter_tpu.api.provisioner import (
+    Condition, Provisioner, ProvisionerSpec, ProvisionerStatus,
+)
 from karpenter_tpu.api.requirements import Requirements
 from karpenter_tpu.utils.resources import parse_resource_list
 
 API_VERSION = "karpenter.sh/v1alpha5"
 KIND = "Provisioner"
+
+
+def _ts_from_lenient(s):
+    """codec_core.ts_from, but a malformed timestamp in a user-supplied
+    manifest must not 500 the admission webhook — decode to None instead."""
+    try:
+        return codec_core_ts_from(s)
+    except (ValueError, TypeError, AttributeError):
+        return None
 
 
 def provisioner_from_manifest(manifest: Dict[str, Any]) -> Provisioner:
@@ -44,7 +58,22 @@ def provisioner_from_manifest(manifest: Dict[str, Any]) -> Provisioner:
         provider=spec.get("provider"),
     )
     limits_res = (spec.get("limits") or {}).get("resources")
+    status = manifest.get("status") or {}
+    status_res = status.get("resources") or {}
     return Provisioner(
+        status=ProvisionerStatus(
+            conditions=[
+                Condition(type=c.get("type", ""),
+                          status=c.get("status", "Unknown"),
+                          reason=c.get("reason", ""),
+                          message=c.get("message", ""),
+                          last_transition_time=_ts_from_lenient(
+                              c.get("lastTransitionTime")))
+                for c in (status.get("conditions") or [])
+            ],
+            resources=parse_resource_list(
+                {k: str(v) for k, v in status_res.items()}),
+        ),
         metadata=ObjectMeta(
             name=meta.get("name", ""),
             namespace=meta.get("namespace", "default"),
@@ -105,6 +134,22 @@ def provisioner_to_manifest(p: Provisioner) -> Dict[str, Any]:
         "metadata": {"name": p.metadata.name},
         "spec": spec,
     }
+    if p.status.conditions or p.status.resources:
+        st: Dict[str, Any] = {}
+        if p.status.conditions:
+            st["conditions"] = [
+                {"type": c.type, "status": c.status,
+                 **({"reason": c.reason} if c.reason else {}),
+                 **({"message": c.message} if c.message else {}),
+                 **({"lastTransitionTime": codec_core_ts_to(
+                     c.last_transition_time)}
+                    if c.last_transition_time is not None else {})}
+                for c in p.status.conditions
+            ]
+        if p.status.resources:
+            st["resources"] = {
+                k: str(q) for k, q in p.status.resources.items()}
+        manifest["status"] = st
     meta = manifest["metadata"]
     if p.metadata.namespace and p.metadata.namespace != "default":
         meta["namespace"] = p.metadata.namespace

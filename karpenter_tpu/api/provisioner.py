@@ -31,9 +31,40 @@ class ProvisionerSpec:
 
 @dataclass
 class Condition:
+    """Status condition (provisioner_status.go:25-36; the reference keeps a
+    living `Active` condition set via register.go:51-54)."""
+
     type: str = ""
     status: str = "Unknown"
     reason: str = ""
+    message: str = ""
+    last_transition_time: Optional[float] = None
+
+
+def set_condition(conditions: List[Condition], type: str, status: str,
+                  reason: str = "", message: str = "",
+                  now: Optional[float] = None) -> bool:
+    """Upsert a condition in place; returns True when anything (other than
+    the transition timestamp) changed — callers skip the status write when
+    nothing did, so a condition refresh can't create a watch-event loop."""
+    for c in conditions:
+        if c.type == type:
+            if (c.status, c.reason, c.message) == (status, reason, message):
+                return False
+            if c.status != status:
+                c.last_transition_time = now
+            c.status, c.reason, c.message = status, reason, message
+            return True
+    conditions.append(Condition(type=type, status=status, reason=reason,
+                                message=message, last_transition_time=now))
+    return True
+
+
+def get_condition(conditions: List[Condition], type: str) -> Optional[Condition]:
+    for c in conditions:
+        if c.type == type:
+            return c
+    return None
 
 
 @dataclass

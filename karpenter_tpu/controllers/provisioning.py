@@ -17,7 +17,7 @@ from typing import Callable, Dict, List, Optional
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.constraints import Constraints
 from karpenter_tpu.api.core import Node, NodeSelectorRequirement as Req, Pod, Taint
-from karpenter_tpu.api.provisioner import Provisioner
+from karpenter_tpu.api.provisioner import Provisioner, set_condition
 from karpenter_tpu.api.requirements import Requirements
 from karpenter_tpu.cloudprovider.spi import CloudProvider, InstanceType
 from karpenter_tpu.metrics.registry import HISTOGRAMS
@@ -29,6 +29,12 @@ from karpenter_tpu.solver.solve import SolveResult, SolverConfig
 from karpenter_tpu.utils import pod as podutil
 
 log = logging.getLogger("karpenter.provisioning")
+
+
+class _NoChange(Exception):
+    """Raised inside a patch fn to abort a no-op status write (kubecore.patch
+    applies fn under the store lock; an exception leaves the store untouched,
+    so no MODIFIED event fires and condition refreshes cannot self-loop)."""
 
 
 def global_requirements(instance_types: List[InstanceType]) -> Requirements:
@@ -258,18 +264,65 @@ class ProvisioningController:
 
         key = _spec_hash(provisioner)
         with self._lock:
-            if self._hashes.get(name) == key:
-                return float(self.REQUEUE_SECONDS)
-            old = self.workers.get(name)
-            if old:
-                old.stop()
-            worker = ProvisionerWorker(
-                provisioner, self.kube, self.cloud_provider,
-                solver_config=self.solver_config, batcher=self.batcher_factory())
-            worker.start()
-            self.workers[name] = worker
-            self._hashes[name] = key
+            if self._hashes.get(name) != key:
+                old = self.workers.get(name)
+                if old:
+                    old.stop()
+                worker = ProvisionerWorker(
+                    provisioner, self.kube, self.cloud_provider,
+                    solver_config=self.solver_config,
+                    batcher=self.batcher_factory())
+                worker.start()
+                self.workers[name] = worker
+                self._hashes[name] = key
+        # conditions refresh EVERY reconcile, including the unchanged-spec
+        # steady state: solver health moves between spec changes, and a
+        # breaker trip must surface on the 5-min requeue, not only on
+        # worker restart
+        self._update_conditions(name, namespace)
         return float(self.REQUEUE_SECONDS)
+
+    def _update_conditions(self, name: str, namespace: str) -> None:
+        """Maintain the living status conditions (provisioner_status.go:38-49,
+        register.go:51-54 wire an `Active` condition set; this framework adds
+        SolverHealthy: which executor ring answered last and whether the
+        device circuit breaker is open). The status write is skipped when
+        nothing changed, so the refresh cannot loop on its own watch event."""
+        import time as _time
+
+        from karpenter_tpu.solver.solve import solver_health
+
+        health = solver_health()
+        executor = health["last_executor"]
+        breaker = health["breaker_open"]
+        if breaker:
+            solver = ("False", "DeviceCircuitOpen",
+                      "device transport watchdog tripped; host executors "
+                      "answering (docs/TROUBLESHOOTING.md)")
+        else:
+            # executor name only — no volatile fields (latency, timestamps):
+            # the condition must compare EQUAL between real state changes,
+            # or every reconcile writes status and the MODIFIED event fans
+            # out through the node controller's provisioner→nodes mapping
+            # (solve latency lives in the binpacking histogram instead)
+            solver = ("True", "ExecutorRingsNominal",
+                      f"last solve: executor={executor}" if executor
+                      else "no solves yet")
+
+        def apply(p):
+            now = _time.time()
+            changed = set_condition(
+                p.status.conditions, "Active", "True", "WorkerRunning",
+                "provisioner worker running", now=now)
+            changed |= set_condition(
+                p.status.conditions, "SolverHealthy", *solver, now=now)
+            if not changed:
+                raise _NoChange
+
+        try:
+            self.kube.patch("Provisioner", name, namespace, apply)
+        except (_NoChange, NotFound):
+            pass
 
     def stop_all(self) -> None:
         """Stop every worker thread (called by Manager.stop)."""

@@ -28,6 +28,7 @@ import logging
 import queue
 import ssl
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import quote, urlencode, urlsplit
 
@@ -98,11 +99,10 @@ def _encode(obj) -> Dict:
         if obj.metadata.resource_version:
             manifest["metadata"]["resourceVersion"] = str(
                 obj.metadata.resource_version)
-        if obj.status.resources:
-            # status.resources feeds the limits check (counter controller →
-            # provisioner.go:139-144); it must survive the wire
-            manifest["status"] = {"resources": {
-                k: str(q) for k, q in obj.status.resources.items()}}
+        # status (resources for the limits check, conditions for health)
+        # is emitted by provisioner_to_manifest itself — overriding it
+        # here would drop conditions on every real-client write and turn
+        # the condition refresh into a self-sustaining watch loop
         return manifest
     return codec_core.encode_obj(obj)
 
@@ -184,6 +184,13 @@ class KubeApiClient:
         self._cache_feeder: Dict[str, int] = {}   # kind → id(feeder queue)
         self._cached_kinds: set = set()           # kinds safe to serve
         self._watch_kind_by_queue: Dict[int, str] = {}
+        # staleness bound (controller-runtime informers resync; this client
+        # instead stops SERVING a kind whose feeder stream has been down
+        # longer than this — reads fall through live until the reconnect
+        # re-list lands, so a partitioned watch cannot serve ever-staler
+        # pods/nodes to the selection/provisioning planes indefinitely)
+        self._cache_down_since: Dict[str, float] = {}
+        self.cache_staleness_s: float = 30.0
 
     @classmethod
     def in_cluster(cls, qps: float = 200.0, burst: int = 300) -> "KubeApiClient":
@@ -315,11 +322,20 @@ class KubeApiClient:
         return f"{prefix}/namespaces/{quote(namespace or 'default')}/{plural}/{quote(name)}"
 
     # -- CRUD ----------------------------------------------------------------
+    def _cache_is_serving(self, kind: str) -> bool:
+        """Call under _cache_lock: a kind serves reads only while its feeder
+        stream is connected or down for less than the staleness bound."""
+        if kind not in self._cached_kinds:
+            return False
+        down = self._cache_down_since.get(kind)
+        return down is None or (
+            time.monotonic() - down < self.cache_staleness_s)
+
     def _cache_list(self, kind: str, namespace, label_selector, field):
         """List served from the watch-fed cache when the kind is watched
         (controller-runtime cached-client List semantics); None = go live."""
         with self._cache_lock:
-            if kind not in self._cached_kinds:
+            if not self._cache_is_serving(kind):
                 return None
             objs = [obj for (k, _, _), obj in self._read_cache.items()
                     if k == kind]
@@ -346,7 +362,7 @@ class KubeApiClient:
         and entries are replaced wholesale, never mutated in place, so the
         read-only contract holds without holding the lock."""
         with self._cache_lock:
-            if kind in self._cached_kinds:
+            if self._cache_is_serving(kind):
                 objs = [obj for (k, _, _), obj in
                         self._read_cache.items() if k == kind]
             else:
@@ -361,7 +377,7 @@ class KubeApiClient:
         yet). ``fn`` runs outside the lock (see scan)."""
         with self._cache_lock:
             obj = (self._read_cache.get(self._cache_key(kind, name, namespace))
-                   if kind in self._cached_kinds else None)
+                   if self._cache_is_serving(kind) else None)
         if obj is not None:
             return fn(obj)
         return fn(self._get_live(kind, name, namespace))
@@ -373,7 +389,7 @@ class KubeApiClient:
 
     def _cache_lookup(self, kind: str, name: str, namespace: Optional[str]):
         with self._cache_lock:
-            if kind not in self._cached_kinds:
+            if not self._cache_is_serving(kind):
                 return None
             obj = self._read_cache.get(self._cache_key(kind, name, namespace))
             return deep_copy(obj) if obj is not None else None
@@ -407,6 +423,7 @@ class KubeApiClient:
                     kind, obj.metadata.name, obj.metadata.namespace)] = (
                     deep_copy(obj))
             self._cached_kinds.add(kind)
+            self._cache_down_since.pop(kind, None)  # fresh snapshot landed
 
     def get(self, kind: str, name: str, namespace: str = "default"):
         cached = self._cache_lookup(kind, name, namespace)
@@ -586,6 +603,7 @@ class KubeApiClient:
                     # simply go live again for this kind
                     self._cache_feeder.pop(kind, None)
                     self._cached_kinds.discard(kind)
+                    self._cache_down_since.pop(kind, None)
                     for key in [k for k in self._read_cache if k[0] == kind]:
                         del self._read_cache[key]
         conn = self._watch_conns.pop(id(q), None)
@@ -597,12 +615,18 @@ class KubeApiClient:
         with self._cache_lock:
             self._cache_feeder.clear()
             self._cached_kinds.clear()
+            self._cache_down_since.clear()
             self._read_cache.clear()
         self._watch_kind_by_queue.clear()
         for key in list(self._watch_conns):
             conn = self._watch_conns.pop(key, None)
             if conn is not None:
                 self._sever(conn)
+
+    def _mark_feeder_down(self, kind: str, qid: int) -> None:
+        with self._cache_lock:
+            if self._cache_feeder.get(kind) == qid:
+                self._cache_down_since.setdefault(kind, time.monotonic())
 
     def _watch_active(self, q) -> bool:
         return not self._watch_stop.is_set() and any(
@@ -622,7 +646,13 @@ class KubeApiClient:
                 self._cache_replace_kind(kind, objs, id(q))
                 for obj in objs:
                     q.put(Event("ADDED", obj))
-                self._stream(kind, path, rv, q)
+                try:
+                    self._stream(kind, path, rv, q)
+                finally:
+                    # stream ended (server close, outage, unwatch): start
+                    # the staleness clock — reads go live once it exceeds
+                    # cache_staleness_s, until the reconnect re-list lands
+                    self._mark_feeder_down(kind, id(q))
             except ResourceExpired as e:
                 # 410/Expired means our resourceVersion aged out of the
                 # watch cache — a full re-list is REQUIRED and sufficient.

@@ -146,16 +146,57 @@ class Manager:
                 mapped_q = self.kube.watch(kind)
 
                 def mapped_pump(mapped_q=mapped_q, wq=wq, map_fn=map_fn):
+                    # a mapping can fail transiently (map_fns do live reads —
+                    # e.g. node.py resolves a pod's node over the transport);
+                    # dropping the event would lose the mapped reconcile until
+                    # some unrelated later event. Workqueue semantics instead:
+                    # retry the event with capped exponential backoff.
+                    retries: List[Tuple[float, int, object, int]] = []
+                    seq = 0
+                    max_attempts = 10  # ~30 s of capped backoff, then drop
                     while not self._stop.is_set():
+                        now = time.monotonic()
+                        while retries and retries[0][0] <= now:
+                            _, _, ev, attempt = heapq.heappop(retries)
+                            try:
+                                for item in map_fn(ev.obj):
+                                    wq.add(item)
+                            except Exception:
+                                if attempt >= max_attempts:
+                                    # poisoned event (deterministic map_fn
+                                    # failure): drop it — level-triggered
+                                    # reconciles recover on the next event
+                                    log.exception(
+                                        "watch mapping failed %d times; "
+                                        "dropping event", attempt)
+                                    continue
+                                delay = min(5.0, 0.1 * (2 ** attempt))
+                                log.warning(
+                                    "watch mapping retry %d failed; next in "
+                                    "%.1fs", attempt, delay, exc_info=True)
+                                seq += 1
+                                heapq.heappush(
+                                    retries,
+                                    (now + delay, seq, ev, attempt + 1))
+                        timeout = 0.2
+                        if retries:
+                            timeout = max(
+                                0.01,
+                                min(0.2, retries[0][0] - time.monotonic()))
                         try:
-                            event = mapped_q.get(timeout=0.2)
+                            event = mapped_q.get(timeout=timeout)
                         except queue.Empty:
                             continue
                         try:
                             for item in map_fn(event.obj):
                                 wq.add(item)
                         except Exception:
-                            log.exception("watch mapping failed")
+                            log.exception(
+                                "watch mapping failed; retrying with backoff")
+                            seq += 1
+                            heapq.heappush(
+                                retries,
+                                (time.monotonic() + 0.1, seq, event, 1))
 
                 t = threading.Thread(target=mapped_pump, daemon=True,
                                      name=f"map-{kind}-{controller.kind()}")

@@ -32,10 +32,16 @@ class Batcher:
         self._lock = threading.Lock()
         self._gate = threading.Event()
         self._running = True
-        # monotonic add counter: lets synchronizers (tests/expectations.py)
-        # tell which batchers actually received work — a gate on an empty
-        # batcher never flushes (wait() blocks on the first item)
+        # monotonic counters for synchronizers (tests/expectations.py):
+        # added_total — items enqueued; consumed_total — items a wait()
+        # window has picked up; processed_total — items whose window has
+        # been FLUSHED (provisioning pass complete). A pod is fully
+        # processed once processed_total passes its add position — exact
+        # even when the pod lands in the window after the one in flight
+        # (the pre-captured-gate race, advisor finding r3).
         self.added_total = 0
+        self.consumed_total = 0
+        self.processed_total = 0
 
     def add(self, item: Any) -> threading.Event:
         """Enqueue an item; returns the gate event the caller may wait on
@@ -48,6 +54,9 @@ class Batcher:
     def flush(self) -> None:
         """Release all waiters and open a new gate (batcher.go:72-77)."""
         with self._lock:
+            # wait() → provision → flush() run sequentially in the worker
+            # thread, so everything consumed so far has now been processed
+            self.processed_total = self.consumed_total
             self._gate.set()
             self._gate = threading.Event()
 
@@ -77,4 +86,6 @@ class Batcher:
             if item is None:
                 break
             items.append(item)
+        with self._lock:
+            self.consumed_total += len(items)
         return items, time.monotonic() - start

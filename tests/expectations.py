@@ -71,14 +71,6 @@ def expect_provisioned(kube: KubeCore, selection, provisioning, pods: List[Pod],
     on the provisioning pass)."""
     for pod in pods:
         kube.create(pod)
-    # capture each worker's CURRENT window gate (and add counter) before
-    # enqueueing: the provisioning pass that consumes this window sets
-    # exactly this gate (Batcher.flush), giving the same post-batch
-    # synchronization the old blocking selection path provided
-    before = {
-        name: (worker.batcher._gate, worker.batcher.added_total)
-        for name, worker in provisioning.workers.items()
-    }
     with ThreadPoolExecutor(max_workers=max(1, len(pods))) as pool:
         futures = [
             pool.submit(selection.reconcile, p.metadata.name, p.metadata.namespace)
@@ -86,13 +78,28 @@ def expect_provisioned(kube: KubeCore, selection, provisioning, pods: List[Pod],
         ]
         for f in futures:
             f.result(timeout=timeout)
-    # wait only on workers that actually RECEIVED pods (a gate on an empty
-    # batcher never flushes — wait() blocks on the first item), and fail
-    # loudly if a receiving window never got provisioned
-    for name, (gate, added0) in before.items():
-        if provisioning.workers[name].batcher.added_total > added0:
-            assert gate.wait(timeout=timeout), (
-                f"provisioner {name} batch window never flushed")
+    # synchronize on PROCESSED counts, not a pre-captured window gate: if a
+    # previous window was already in flight, its flush sets the old gate
+    # while our pods land in the NEXT window (advisor finding r3) — instead
+    # wait, per worker that received work, until the batcher has flushed
+    # every item added so far (processed_total catches up to added_total),
+    # re-waiting on each successive gate
+    deadline = time.monotonic() + timeout
+    for name, worker in provisioning.workers.items():
+        b = worker.batcher
+        target = b.added_total
+        if target == b.processed_total:
+            continue  # this worker received nothing (or already finished)
+        while b.processed_total < target:
+            remaining = deadline - time.monotonic()
+            assert remaining > 0, (
+                f"provisioner {name}: {target - b.processed_total} batched "
+                f"pod(s) never processed within {timeout}s")
+            with b._lock:
+                gate = b._gate
+                if b.processed_total >= target:
+                    break
+            gate.wait(timeout=min(remaining, 0.5))
     return [kube.get("Pod", p.metadata.name, p.metadata.namespace) for p in pods]
 
 

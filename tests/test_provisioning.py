@@ -156,3 +156,82 @@ class TestProvisioning:
         expect_provisioned(kube, selection, provisioning, pods)
         node = kube.get("Node", expect_scheduled(kube, pods[0]), "")
         assert node.metadata.labels[wellknown.PROVISIONER_NAME_LABEL] == "open"
+
+
+class TestStatusConditions:
+    """The living condition set (provisioner_status.go:38-49,
+    register.go:51-54): kubectl get provisioner shows readiness, plus this
+    framework's solver-health signal (executor ring + breaker state)."""
+
+    def test_active_and_solver_conditions_set(self, env):
+        from karpenter_tpu.api.provisioner import get_condition
+
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        p = kube.get("Provisioner", "default")
+        active = get_condition(p.status.conditions, "Active")
+        assert active is not None and active.status == "True"
+        assert active.reason == "WorkerRunning"
+        solver = get_condition(p.status.conditions, "SolverHealthy")
+        assert solver is not None and solver.status == "True"
+
+    def test_solver_condition_names_executor_after_solve(self, env):
+        from karpenter_tpu.api.provisioner import get_condition
+
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        pods = [unschedulable_pod() for _ in range(3)]
+        expect_provisioned(kube, selection, provisioning, pods)
+        provisioning.reconcile("default")  # refresh conditions post-solve
+        p = kube.get("Provisioner", "default")
+        solver = get_condition(p.status.conditions, "SolverHealthy")
+        assert solver.status == "True"
+        assert "executor=" in solver.message
+
+    def test_breaker_open_flips_solver_condition(self, env, monkeypatch):
+        from karpenter_tpu.api.provisioner import get_condition
+        from karpenter_tpu.solver import solve as solve_module
+
+        kube, provider, provisioning, selection = env
+        monkeypatch.setattr(solve_module._WATCHDOG, "tripped", lambda: True)
+        setup_provisioner(kube, provisioning)
+        p = kube.get("Provisioner", "default")
+        solver = get_condition(p.status.conditions, "SolverHealthy")
+        assert solver.status == "False"
+        assert solver.reason == "DeviceCircuitOpen"
+
+    def test_condition_refresh_does_not_loop(self, env):
+        """An unchanged condition set must not write (and so not emit a
+        MODIFIED watch event the controller would chase forever) — even
+        between solves, whose volatile stats must stay OUT of the message
+        (each one fans out through the node controller's provisioner→nodes
+        mapping otherwise)."""
+        kube, provider, provisioning, selection = env
+        setup_provisioner(kube, provisioning)
+        rv1 = kube.get("Provisioner", "default").metadata.resource_version
+        provisioning.reconcile("default")
+        rv2 = kube.get("Provisioner", "default").metadata.resource_version
+        assert rv1 == rv2
+        # a solve happened; executor unchanged → still no status write
+        pods = [unschedulable_pod() for _ in range(2)]
+        expect_provisioned(kube, selection, provisioning, pods)
+        provisioning.reconcile("default")
+        rv3 = kube.get("Provisioner", "default").metadata.resource_version
+        provisioning.reconcile("default")
+        rv4 = kube.get("Provisioner", "default").metadata.resource_version
+        assert rv3 == rv4
+
+    def test_status_conditions_round_trip_codec(self):
+        from karpenter_tpu.api.codec import (
+            provisioner_from_manifest, provisioner_to_manifest,
+        )
+        from karpenter_tpu.api.provisioner import get_condition, set_condition
+
+        p = make_provisioner()
+        set_condition(p.status.conditions, "Active", "True", "WorkerRunning",
+                      "provisioner worker running")
+        manifest = provisioner_to_manifest(p)
+        assert manifest["status"]["conditions"][0]["type"] == "Active"
+        back = provisioner_from_manifest(manifest)
+        cond = get_condition(back.status.conditions, "Active")
+        assert cond.status == "True" and cond.reason == "WorkerRunning"
