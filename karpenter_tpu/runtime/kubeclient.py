@@ -678,7 +678,13 @@ class KubeApiClient:
 
     def _stream(self, kind: str, path: str, rv: str,
                 q: "queue.Queue[Event]") -> None:
-        params = {"watch": "true"}
+        # bookmarks are requested as keepalive traffic only: this client
+        # DELIBERATELY does not resume from a bookmark rv — every reconnect
+        # re-lists (watch loop above), which doubles as the informer-cache
+        # resync (purges deletions missed in the gap). rv-resume would need
+        # the reflector's gap-replay machinery (and a 410 fallback) for a
+        # benefit the 5-min catalog cadence doesn't demand.
+        params = {"watch": "true", "allowWatchBookmarks": "true"}
         if rv:
             params["resourceVersion"] = rv
         conn = self._conn(timeout=300.0)
@@ -720,6 +726,13 @@ class KubeApiClient:
                                 or obj.get("reason") in ("Expired", "Gone")):
                             raise ResourceExpired(f"watch {kind}: {obj}")
                         raise ApiError(f"watch {kind}: {obj}")
+                    if etype == "BOOKMARK":
+                        # periodic resourceVersion checkpoint (sent when
+                        # allowWatchBookmarks is requested): not an object
+                        # event — it must neither touch the cache nor
+                        # enqueue a reconcile (the decoded object is an
+                        # empty shell whose "" name would reconcile junk)
+                        continue
                     obj = _decode(kind, event.get("object") or {})
                     if etype == "DELETED":
                         self._cache_delete(kind, obj, id(q))

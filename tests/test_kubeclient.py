@@ -113,6 +113,14 @@ class StubHandler(BaseHTTPRequestHandler):
                 }).encode() + b"\n"
                 self.wfile.write(line)
                 self.wfile.flush()
+                if self.behavior and self.behavior.pop("bookmark_next", None):
+                    bm = json.dumps({
+                        "type": "BOOKMARK",
+                        "object": {"kind": "Pod", "metadata": {
+                            "resourceVersion": "9999"}},
+                    }).encode() + b"\n"
+                    self.wfile.write(bm)
+                    self.wfile.flush()
                 if self.behavior and self.behavior.pop("watch_410_next", None):
                     err = json.dumps({
                         "type": "ERROR",
@@ -160,9 +168,23 @@ class StubHandler(BaseHTTPRequestHandler):
         self._send(201, json.dumps(wire_encode(created)).encode())
 
     def do_PUT(self):
-        kind, namespace, name, _, _ = self._parse()
+        kind, namespace, name, sub, _ = self._parse()
         obj = wire_decode(kind, self._body())
         try:
+            if kind == "Provisioner":
+                # real-apiserver contract for a CRD with the status
+                # subresource (deploy/crds/…yaml:20-21): the main PUT
+                # IGNORES status changes; PUT …/status ignores everything
+                # BUT status
+                stored = self.core.get(kind, name, namespace or "default")
+                if sub == "status":
+                    incoming_status = obj.status
+                    incoming_rv = obj.metadata.resource_version
+                    obj = wire_decode(kind, wire_encode(stored))
+                    obj.metadata.resource_version = incoming_rv
+                    obj.status = incoming_status
+                else:
+                    obj.status = stored.status
             updated = self.core.update(obj)
         except Conflict:
             return self._send(409, b"{}")
@@ -714,6 +736,108 @@ class TestInformerReadCache:
                      lambda p: p.metadata.annotations.update({"x": "y"}))
         stored = core.get("Pod", "patched")
         assert stored.metadata.annotations["x"] == "y"
+        client.unwatch(q)
+
+
+class TestStatusSubresourceAndBookmarks:
+    """Real-apiserver contracts the in-memory plane can't see: the CRD's
+    status subresource (main PUT drops status; /status PUT drops the rest)
+    and BOOKMARK watch events."""
+
+    def test_status_subresource_contract_over_the_wire(self, api):
+        """The CRD declares the status subresource (deploy/crds/…:20-21),
+        so against a REAL apiserver a main-resource PUT silently drops
+        status changes — the client must write status via …/status or the
+        counter/conditions writes never persist (they would re-write every
+        reconcile, a status-write/watch-event loop)."""
+        from karpenter_tpu.api.provisioner import (
+            Provisioner, get_condition, set_condition,
+        )
+
+        core, client, _ = api
+        prov = Provisioner()
+        prov.metadata.name = "sub"
+        core.create(prov)
+
+        # client.patch mutating ONLY status → persists via the subresource
+        def add_cond(p):
+            set_condition(p.status.conditions, "Active", "True",
+                          "WorkerRunning", now=1_700_000_000.0)
+
+        client.patch("Provisioner", "sub", "default", add_cond)
+        stored = core.get("Provisioner", "sub")
+        cond = get_condition(stored.status.conditions, "Active")
+        assert cond is not None and cond.status == "True"
+
+        # a main-resource PUT carrying a status mutation must NOT change
+        # status (real-apiserver semantics, modeled by the stub)
+        live = client.get("Provisioner", "sub")
+        live.status.conditions = []
+        live.spec.ttl_seconds_after_empty = 30
+        # drive the raw main PUT (bypassing update()'s subresource split)
+        raw = client._request("GET", client._item("Provisioner", "sub",
+                                                  "default"))
+        raw["spec"]["ttlSecondsAfterEmpty"] = 60
+        raw["status"] = {}  # attempt to clear status via the main resource
+        client._request("PUT", client._item("Provisioner", "sub", "default"),
+                        raw)
+        stored = core.get("Provisioner", "sub")
+        assert stored.spec.ttl_seconds_after_empty == 60  # spec applied
+        cond = get_condition(stored.status.conditions, "Active")
+        assert cond is not None, (
+            "main-resource PUT cleared status — the stub no longer models "
+            "the real subresource contract")
+
+    def test_status_put_ignores_spec_changes(self, api):
+        """PUT …/status applies status only (the inverse contract)."""
+        from karpenter_tpu.api.provisioner import Provisioner
+
+        core, client, _ = api
+        prov = Provisioner()
+        prov.metadata.name = "sub2"
+        prov.spec.ttl_seconds_after_empty = 10
+        core.create(prov)
+        item = client._item("Provisioner", "sub2", "default")
+        raw = client._request("GET", item)
+        raw["spec"]["ttlSecondsAfterEmpty"] = 999
+        raw["status"] = {"resources": {"cpu": "4"}}
+        client._request("PUT", item + "/status", raw)
+        stored = core.get("Provisioner", "sub2")
+        assert stored.spec.ttl_seconds_after_empty == 10  # spec untouched
+        assert str(stored.status.resources["cpu"]) == "4"
+
+    def test_bookmark_events_are_swallowed(self, api):
+        """A real apiserver sends BOOKMARK events when asked
+        (allowWatchBookmarks — this client asks): they are rv checkpoints,
+        not object events, and must neither reach consumers (an empty-name
+        reconcile) nor touch the cache."""
+        core, client, behavior = api
+
+        def drain_to(name, deadline_s=5.0):
+            """Deliver events until `name` appears; any event with an empty
+            name is a leaked bookmark shell (the failure being tested).
+            Duplicate ADDEDs from list replay are expected (level-triggered
+            consumers tolerate them)."""
+            deadline = time.time() + deadline_s
+            while time.time() < deadline:
+                ev = q.get(timeout=deadline_s)
+                assert ev.obj.metadata.name, "bookmark shell reached consumer"
+                if ev.obj.metadata.name == name:
+                    return ev
+            raise AssertionError(f"{name} never delivered")
+
+        q = client.watch("Pod")
+        core.create(unschedulable_pod(name="bm-1"))
+        drain_to("bm-1")
+        behavior["bookmark_next"] = True
+        core.create(unschedulable_pod(name="bm-2"))  # bookmark follows this
+        drain_to("bm-2")
+        # the bookmark between bm-2 and bm-3 must be swallowed and the
+        # stream keep flowing
+        core.create(unschedulable_pod(name="bm-3"))
+        drain_to("bm-3")
+        with client._cache_lock:
+            assert ("Pod", "default", "") not in client._read_cache
         client.unwatch(q)
 
 

@@ -1,0 +1,227 @@
+"""Concurrency stress: the Go `-race` analog (SURVEY §5.2, VERDICT r3).
+
+Python has no race detector; these tests instead hammer each shared-state
+hotspot from many threads and assert the invariants that a data race would
+break — lost items, double delivery, torn counters, inconsistent intern
+mappings, deadlocks. Round 3's one failing test was exactly a
+thread-teardown race (watch severing); this module makes the remaining
+shared state earn its locks:
+
+- Batcher: concurrent add vs wait/flush windows (counters, no loss/dup)
+- Manager _WorkQueue: processing exclusivity + dirty re-add (no lost keys)
+- Device watchdog: concurrent run() storm (no deadlock, breaker sane)
+- Shape-intern table: concurrent interning across forced rollovers
+  (every returned (sid, gen) stays resolvable or detectably stale)
+"""
+
+import random
+import threading
+import time
+
+from karpenter_tpu.runtime.manager import _WorkQueue
+from karpenter_tpu.scheduling.batcher import Batcher
+from karpenter_tpu.solver.solve import _DeviceWatchdog
+
+STRESS_SECONDS = 3.0
+
+
+class TestBatcherRaces:
+    def test_concurrent_add_flush_loses_nothing(self):
+        b = Batcher(idle_seconds=0.01, max_seconds=0.05, max_items=64)
+        produced = []
+        consumed = []
+        stop = threading.Event()
+        errors = []
+
+        def producer(tid):
+            try:
+                i = 0
+                while not stop.is_set():
+                    item = (tid, i)
+                    b.add(item)
+                    produced.append(item)  # list.append is GIL-atomic
+                    i += 1
+                    if i % 7 == 0:
+                        time.sleep(0.001)
+            except Exception as e:
+                errors.append(repr(e))
+
+        def consumer():
+            try:
+                while not stop.is_set() or b.added_total > b.consumed_total:
+                    items, _ = b.wait()
+                    consumed.extend(items)
+                    b.flush()
+                    if stop.is_set() and not items:
+                        return
+            except Exception as e:
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=producer, args=(t,)) for t in range(4)]
+        ct = threading.Thread(target=consumer)
+        for t in threads:
+            t.start()
+        ct.start()
+        time.sleep(STRESS_SECONDS)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        b.stop()  # unblock a consumer parked in wait()
+        ct.join(timeout=5.0)
+        assert not errors, errors[0]
+        # no item lost, none duplicated (consumed may miss the tail cut off
+        # by stop() — every CONSUMED item must be unique and produced)
+        assert len(consumed) == len(set(consumed))
+        assert set(consumed) <= set(produced)
+        assert len(produced) - len(consumed) <= b.added_total - b.consumed_total + 64
+        # counters are consistent with the item flow
+        assert b.consumed_total >= len(consumed)
+        assert b.processed_total <= b.consumed_total <= b.added_total
+
+
+class TestWorkQueueRaces:
+    def test_processing_exclusivity_and_no_lost_dirty(self):
+        wq = _WorkQueue()
+        KEYS = [(f"k{i}", "default") for i in range(8)]
+        in_flight = set()
+        in_flight_lock = threading.Lock()
+        processed = {k: 0 for k in KEYS}
+        last_add = {k: 0.0 for k in KEYS}
+        last_done = {k: 0.0 for k in KEYS}
+        errors = []
+        stop = threading.Event()
+
+        def adder():
+            rng = random.Random(1)
+            while not stop.is_set():
+                k = rng.choice(KEYS)
+                last_add[k] = time.monotonic()
+                wq.add(k)
+                time.sleep(rng.uniform(0.0, 0.002))
+
+        def worker():
+            try:
+                while not stop.is_set():
+                    item = wq.get(timeout=0.05)
+                    if item is None:
+                        continue
+                    with in_flight_lock:
+                        # client-go contract: a key being processed is never
+                        # handed to a second worker
+                        assert item not in in_flight, f"{item} handed twice"
+                        in_flight.add(item)
+                    time.sleep(random.uniform(0.0, 0.002))
+                    with in_flight_lock:
+                        in_flight.discard(item)
+                        processed[item] += 1
+                        last_done[item] = time.monotonic()
+                    wq.done(item)
+            except Exception as e:
+                errors.append(repr(e))
+
+        threads = ([threading.Thread(target=adder) for _ in range(3)]
+                   + [threading.Thread(target=worker) for _ in range(6)])
+        for t in threads:
+            t.start()
+        time.sleep(STRESS_SECONDS)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert not errors, errors[0]
+        assert all(processed[k] > 0 for k in KEYS), processed
+        # drain: every key added before stop must still be deliverable —
+        # dirty re-adds were not lost (process whatever remains)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            item = wq.get(timeout=0.1)
+            if item is None:
+                break
+            wq.done(item)
+
+
+class TestWatchdogRaces:
+    def test_concurrent_run_storm(self):
+        wd = _DeviceWatchdog()
+        errors = []
+        ok = []
+
+        def caller(i):
+            try:
+                # generous deadline: the single serialized worker queues
+                # 24 × ~1 ms jobs; queue-wait has its own equal budget
+                r = wd.run(lambda: time.sleep(0.001) or i,
+                           timeout_s=5.0, breaker_s=0.2)
+                ok.append(r)
+            except TimeoutError:
+                pass  # acceptable under storm; breaker must stay sane
+            except Exception as e:
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=caller, args=(i,))
+                   for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not any(t.is_alive() for t in threads), "watchdog deadlocked"
+        assert not errors, errors[0]
+        assert len(ok) >= 20  # the serialized worker drains the storm
+        # a subsequent healthy call still works (pool not wedged/leaked)
+        assert wd.run(lambda: "after", timeout_s=5.0, breaker_s=0.2) == "after"
+
+    def test_breaker_state_consistent_under_concurrent_trips(self):
+        wd = _DeviceWatchdog()
+        results = []
+
+        def tripper():
+            try:
+                wd.run(lambda: time.sleep(2.0), timeout_s=0.05, breaker_s=0.5)
+            except TimeoutError:
+                results.append("timeout")
+
+        threads = [threading.Thread(target=tripper) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert results, "no trip registered"
+        assert wd.tripped()
+        time.sleep(0.6)
+        assert not wd.tripped()  # breaker closes; no torn _open_until
+
+
+class TestInternRaces:
+    def test_concurrent_interning_across_rollovers(self, monkeypatch):
+        from karpenter_tpu.solver import adapter
+
+        monkeypatch.setattr(adapter, "_INTERN_MAX", 64)
+        monkeypatch.setattr(adapter, "_VEC_INTERN", {})
+        monkeypatch.setattr(adapter, "_VEC_BY_ID", [])
+        monkeypatch.setattr(adapter, "_INTERN_GEN", 50_000)
+        observed = []  # (vec, sid, gen) triples, appended GIL-atomically
+        errors = []
+
+        def worker(seed):
+            rng = random.Random(seed)
+            try:
+                for _ in range(600):
+                    vec = (rng.randint(0, 300) * 10**6, 0, 0, 0, 0, 0, 0, 0)
+                    sid, gen = adapter._intern_vec(vec)
+                    observed.append((vec, sid, gen))
+            except Exception as e:
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=worker, args=(s,)) for s in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not errors, errors[0]
+        # every returned (sid, gen) is either resolvable to EXACTLY the
+        # interned vec, or detectably stale (snapshot returns None) — a
+        # silently-wrong mapping is the race being hunted
+        for vec, sid, gen in observed:
+            got = adapter.interned_vecs_snapshot([sid], gen)
+            assert got is None or got[0] == vec, (
+                f"sid {sid}@gen{gen} resolved to {got and got[0]} != {vec}")
+        assert len(adapter._VEC_BY_ID) <= 64
