@@ -115,7 +115,8 @@ def _solve_batch(problems: Sequence[Problem],
             log.exception("batched device solve failed; falling back per problem")
             host_results = None
         if host_results is not None:
-            solve_module.record_executor("device-batch")
+            solve_module.record_executor("device-batch",
+                                         count=len(batch_idx))
             for j, i in enumerate(batch_idx):
                 results[i] = materialize(
                     host_results[j], problems[i].pods, prepared[i][1],

@@ -31,6 +31,19 @@ from karpenter_tpu.utils.profiling import trace
 log = logging.getLogger("karpenter.solver")
 
 
+def _set_breaker_gauge(value: int) -> None:
+    """1 while the device circuit is open (or half-open awaiting a probe);
+    0 after a successful device solve. Prometheus sees breaker flips
+    immediately; the Provisioner's SolverHealthy condition refreshes only
+    per reconcile."""
+    from karpenter_tpu.metrics.registry import DEFAULT
+
+    DEFAULT.gauge(
+        "solver_breaker_open",
+        "device-solve circuit breaker state (1=open/half-open, 0=closed)",
+    ).set(float(value))
+
+
 class _DeviceWatchdog:
     """Serializes device solves onto ONE worker thread with a deadline and
     a circuit breaker. A timed-out call leaves its thread blocked (a hung
@@ -54,7 +67,13 @@ class _DeviceWatchdog:
 
     def tripped(self) -> bool:
         with self._lock:
-            return time.monotonic() < self._open_until
+            open_ = time.monotonic() < self._open_until
+            # gauge derived from actual state on every check: event-only
+            # writes could leave it stuck at 1 after a silent half-open
+            # expiry (e.g. the probe failed with a non-timeout error, or
+            # the workload stopped reaching the device ring)
+            _set_breaker_gauge(1 if open_ else 0)
+            return open_
 
     def run(self, fn, timeout_s: float, breaker_s: float):
         """fn() under the deadline; TimeoutError opens the breaker and is
@@ -95,6 +114,7 @@ class _DeviceWatchdog:
                         # thread is genuinely wedged)
                         self._pool.shutdown(wait=False)
                     self._pool = None
+                    _set_breaker_gauge(1)
                 log.error(
                     "device solve never started within %.0fs (worker "
                     "occupied) — circuit open for %.0fs (host executors "
@@ -108,6 +128,7 @@ class _DeviceWatchdog:
                 # the worker is wedged on the dead transport; drop the pool
                 # so the next (half-open) probe gets a fresh thread
                 self._pool = None
+                _set_breaker_gauge(1)
             log.error(
                 "device solve exceeded %.0fs — transport presumed hung; "
                 "circuit open for %.0fs (host executors answer meanwhile)",
@@ -115,10 +136,14 @@ class _DeviceWatchdog:
             raise TimeoutError("device solve watchdog expired")
         with self._lock:
             self._open_until = 0.0  # success closes the breaker
+            _set_breaker_gauge(0)
         return result
 
 
 _WATCHDOG = _DeviceWatchdog()
+# register the series at import so "never tripped" is a visible 0, not an
+# absent metric an alert can never match
+_set_breaker_gauge(0)
 
 # -- solver health introspection -------------------------------------------
 # Which executor ring answered the most recent solve, and when. Surfaced as
@@ -133,12 +158,23 @@ _HEALTH = {
 }
 
 
-def record_executor(executor: str, elapsed_s: Optional[float] = None) -> None:
+def record_executor(executor: str, elapsed_s: Optional[float] = None,
+                    count: int = 1) -> None:
+    """``count`` keeps the per-executor counter comparable across rings:
+    a device BATCH answers many problems in one call and must count each
+    (else a healthy batch path looks undercounted vs solo fallbacks)."""
     with _HEALTH_LOCK:
         _HEALTH["last_executor"] = executor
         _HEALTH["last_solve_unix"] = time.time()
         _HEALTH["last_solve_ms"] = (
             round(elapsed_s * 1000.0, 3) if elapsed_s is not None else None)
+    from karpenter_tpu.metrics.registry import DEFAULT
+
+    DEFAULT.counter(
+        "solver_solves_total",
+        "problems solved, labeled by executor ring "
+        "(device|device-batch|native|host)").inc(
+        amount=float(count), executor=executor)
 
 
 def solver_health() -> dict:

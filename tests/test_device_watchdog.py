@@ -189,3 +189,23 @@ class TestBatchSolveWithWatchdog:
         assert [r.node_count for r in got] == [r.node_count for r in want]
         # and the breaker now routes the SOLO device ring away too
         assert bs.solve_module._WATCHDOG.tripped()
+
+
+class TestSolverMetrics:
+    def test_executor_counter_and_breaker_gauge(self, fresh_watchdog):
+        from karpenter_tpu.metrics.registry import DEFAULT
+        from karpenter_tpu.solver.solve import SolverConfig, solve
+
+        constraints, pods, catalog = make_problem()
+        solve(constraints, pods, catalog,
+              config=SolverConfig(use_device=False, use_native=False))
+        exposed = DEFAULT.expose()
+        assert 'karpenter_solver_solves_total{executor="host"}' in exposed
+
+        wd = fresh_watchdog
+        with pytest.raises(TimeoutError):
+            wd.run(lambda: time.sleep(5.0), timeout_s=0.05, breaker_s=0.2)
+        assert 'karpenter_solver_breaker_open{} 1.0' in DEFAULT.expose()
+        time.sleep(0.25)
+        wd.run(lambda: 1, timeout_s=1.0, breaker_s=0.2)
+        assert 'karpenter_solver_breaker_open{} 0.0' in DEFAULT.expose()

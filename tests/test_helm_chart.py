@@ -88,3 +88,24 @@ class TestChartRender:
                 f.write("v: {{ .Values.missing.key }}\n")
             with pytest.raises(KeyError):
                 render_chart(d)
+
+
+class TestCrdPrinterColumns:
+    def test_columns_reference_conditions_the_controller_writes(self):
+        """kubectl get provisioner surfaces Active/SolverHealthy — the
+        jsonPaths must name the exact condition types the provisioning
+        controller maintains (controllers/provisioning.py)."""
+        import re
+
+        for path in ("deploy/crds/karpenter.sh_provisioners.yaml",
+                     "charts/karpenter-tpu/crds/karpenter.sh_provisioners.yaml"):
+            with open(path) as f:
+                src = f.read()
+            assert "additionalPrinterColumns" in src, path
+            types = set(re.findall(r'@\.type=="(\w+)"', src))
+            assert types == {"Active", "SolverHealthy"}, (path, types)
+            assert ".status.resources.cpu" in src
+            assert ".status.resources.memory" in src
+            # declaring printer columns replaces the apiserver's default
+            # set — Age must be re-added explicitly or kubectl loses it
+            assert ".metadata.creationTimestamp" in src
