@@ -30,6 +30,15 @@ dryrun: ## Compile-check the sharded multi-chip step on an 8-device CPU mesh
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+soak: ## Extended differential soak: 500 fuzz cases + repeated chaos/races
+	KARPENTER_FUZZ_CASES=500 python -m pytest tests/test_fuzz_parity.py -q
+	python -m pytest tests/test_chaos.py tests/test_races.py -q --count=5 \
+		2>/dev/null || for i in 1 2 3 4 5; do \
+		python -m pytest tests/test_chaos.py tests/test_races.py -q; done
+
+cardinality-diff: ## One-off full-size 50k×25k-shape differential (hours)
+	python tools/full_cardinality_diff.py
+
 clean: ## Remove build artifacts
 	rm -f karpenter_tpu/native/_libktffd.so
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
