@@ -163,6 +163,9 @@ class KubeApiClient:
         # overruns the apiserver's accept backlog under the 64-worker
         # selection plane (observed as ECONNRESET at 1k-pod wire load)
         self._local = threading.local()
+        # chunked LISTs (reflector default): pages of this many items via
+        # limit/continue; 0 = unpaginated single response
+        self.list_page_size: int = 500
         # informer read cache (the controller-runtime cached-client analog,
         # SURVEY.md L1 "client cache/indexer"): kinds with an active watch
         # serve get/list/scan/read from watch-fed local state instead of
@@ -459,11 +462,43 @@ class KubeApiClient:
             params["labelSelector"] = ",".join(parts)
         if field is not None:
             params["fieldSelector"] = f"{field[0]}={field[1]}"
-        path = self._collection(kind, namespace)
-        if params:
-            path += "?" + urlencode(params)
-        body = self._request("GET", path)
-        return [_decode(kind, item) for item in body.get("items", [])]
+        items, _ = self._list_pages(self._collection(kind, namespace), params)
+        return [_decode(kind, item) for item in items]
+
+    def _list_pages(self, path: str, params: Dict[str, str]):
+        """Chunked LIST (client-go reflector semantics): request
+        ``limit=list_page_size`` and follow ``metadata.continue`` until the
+        snapshot is exhausted. A big cluster's 50k-pod collection comes
+        back as bounded responses instead of one giant body; the returned
+        resourceVersion identifies the consistent snapshot (every page
+        carries the same one) and seeds the subsequent watch."""
+        for attempt in range(3):
+            items: List[Dict] = []
+            rv = ""
+            cont = None
+            try:
+                while True:
+                    q = dict(params)
+                    if self.list_page_size:
+                        q["limit"] = str(self.list_page_size)
+                    if cont:
+                        q["continue"] = cont
+                    body = self._request(
+                        "GET", path + ("?" + urlencode(q) if q else ""))
+                    items.extend(body.get("items", []))
+                    meta = body.get("metadata") or {}
+                    rv = meta.get("resourceVersion", rv) or rv
+                    cont = meta.get("continue")
+                    if not cont:
+                        return items, rv
+            except ResourceExpired:
+                # continue token expired mid-pagination (etcd compaction /
+                # token TTL on a slow multi-page list) — client-go's
+                # ListPager restarts with a fresh list; so do we, bounded
+                if attempt == 2:
+                    raise
+                log.info("paginated list %s expired mid-walk; restarting",
+                         path)
 
     def create(self, obj):
         path = self._collection(obj.kind, obj.metadata.namespace)
@@ -636,9 +671,8 @@ class KubeApiClient:
         path = self._collection(kind, None)
         while self._watch_active(q):
             try:
-                body = self._request("GET", path)
-                rv = (body.get("metadata") or {}).get("resourceVersion", "")
-                objs = [_decode(kind, item) for item in body.get("items", [])]
+                raw_items, rv = self._list_pages(path, {})
+                objs = [_decode(kind, item) for item in raw_items]
                 # feeder only: seed/refresh the read cache from the LIST
                 # snapshot and mark the kind cache-served (readers never
                 # see a partial snapshot); a re-list after a watch gap

@@ -90,10 +90,36 @@ class StubHandler(BaseHTTPRequestHandler):
             field = (fname, fval)
         if qs.get("watch") == ["true"]:
             return self._watch(kind)
+        if ("continue" in qs and self.behavior
+                and self.behavior.pop("list_410_once", None)):
+            # expired continue token (etcd compaction / token TTL): the
+            # real apiserver answers 410 Gone mid-pagination
+            return self._send(410, b'{"kind":"Status","code":410}')
         items = self.core.list(kind, namespace=namespace, field=field)
-        body = {"kind": f"{kind}List",
-                "metadata": {"resourceVersion": "1"},
-                "items": [wire_encode(o) for o in items]}
+        if "labelSelector" in qs:
+            # equality terms only — enough for the client's match_labels
+            # (operator terms are covered by the serialization test)
+            terms = [t for t in qs["labelSelector"][0].split(",") if "=" in t
+                     and " in " not in t and " notin " not in t]
+            pairs = [t.split("=", 1) for t in terms]
+            items = [o for o in items
+                     if all(o.metadata.labels.get(k) == v for k, v in pairs)]
+        # real-apiserver chunking: limit/continue over a stable ordering
+        # (the apiserver pages by etcd key order; name order is the analog)
+        items.sort(key=lambda o: (o.metadata.namespace or "", o.metadata.name))
+        limit = int(qs.get("limit", ["0"])[0] or 0)
+        offset = int(qs.get("continue", ["0"])[0] or 0)
+        meta = {"resourceVersion": "1"}
+        if limit and offset + limit < len(items):
+            page = items[offset:offset + limit]
+            meta["continue"] = str(offset + limit)
+        else:
+            page = items[offset:]
+        if self.behavior is not None:
+            self.behavior["list_requests"] = (
+                self.behavior.get("list_requests", 0) + 1)
+        body = {"kind": f"{kind}List", "metadata": meta,
+                "items": [wire_encode(o) for o in page]}
         self._send(200, json.dumps(body).encode())
 
     def _watch(self, kind):
@@ -517,7 +543,10 @@ class TestRealServerSemantics:
             except queue_mod.Empty:
                 continue
             seen[ev.obj.metadata.name] = seen.get(ev.obj.metadata.name, 0) + 1
-            if "after-410" in seen:
+            # exit only once BOTH proofs are in: the post-expiry object
+            # arrived AND the re-list replay was observed (replay order is
+            # name-sorted — pagination — so after-410 can arrive first)
+            if "after-410" in seen and seen.get("before", 0) >= 2:
                 break
         assert "after-410" in seen, f"event lost across 410 resync: {seen}"
         # the resync re-list replays pre-existing objects as ADDED again
@@ -737,6 +766,67 @@ class TestInformerReadCache:
         stored = core.get("Pod", "patched")
         assert stored.metadata.annotations["x"] == "y"
         client.unwatch(q)
+
+
+class TestListPagination:
+    """Chunked LISTs (limit/continue): the reflector default. One giant
+    response for a 50k-object collection is where big-cluster clients
+    fall over; the client must follow continue tokens transparently."""
+
+    def test_list_follows_continue_tokens(self, api):
+        core, client, behavior = api
+        for i in range(7):
+            core.create(unschedulable_pod(name=f"page-{i}"))
+        client.list_page_size = 3
+        behavior["list_requests"] = 0
+        pods = client.list("Pod")
+        assert sorted(p.metadata.name for p in pods) == [
+            f"page-{i}" for i in range(7)]
+        assert behavior["list_requests"] == 3  # 3 + 3 + 1
+
+    def test_watch_relist_paginates(self, api):
+        core, client, behavior = api
+        for i in range(5):
+            core.create(unschedulable_pod(name=f"wp-{i}"))
+        client.list_page_size = 2
+        q = client.watch("Pod")
+        seen = set()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and len(seen) < 5:
+            ev = q.get(timeout=5.0)
+            seen.add(ev.obj.metadata.name)
+        assert seen == {f"wp-{i}" for i in range(5)}
+        # the watch-fed cache holds the full paginated snapshot
+        assert len(client.list("Pod")) == 5
+        client.unwatch(q)
+
+    def test_expired_continue_token_restarts_list(self, api):
+        """A 410 on a continue token mid-pagination (compaction/TTL) must
+        restart the list transparently — before pagination this failure
+        mode could not exist, and no list() caller handles it."""
+        core, client, behavior = api
+        for i in range(7):
+            core.create(unschedulable_pod(name=f"exp-{i}"))
+        client.list_page_size = 3
+        behavior["list_410_once"] = True
+        pods = client.list("Pod")
+        assert sorted(p.metadata.name for p in pods) == [
+            f"exp-{i}" for i in range(7)]
+        assert "list_410_once" not in behavior  # the fault actually fired
+
+    def test_selector_filters_compose_with_pagination(self, api):
+        core, client, behavior = api
+        from karpenter_tpu.api.core import LabelSelector
+
+        for i in range(6):
+            pod = unschedulable_pod(name=f"sel-{i}")
+            pod.metadata.labels["team"] = "a" if i % 2 == 0 else "b"
+            core.create(pod)
+        client.list_page_size = 2
+        got = client.list("Pod", label_selector=LabelSelector(
+            match_labels={"team": "a"}))
+        assert sorted(p.metadata.name for p in got) == [
+            "sel-0", "sel-2", "sel-4"]
 
 
 class TestStatusSubresourceAndBookmarks:
