@@ -71,7 +71,7 @@ def solve_ffd_device(
     packables: Sequence[Packable],
     max_instance_types: int = MAX_INSTANCE_TYPES,
     chunk_iters: int = DEFAULT_CHUNK_ITERS,
-    kernel: Optional[str] = None,   # "xla" | "pallas" | None = auto
+    kernel: Optional[str] = None,   # "xla"|"pallas"|"type-spmd"|None=auto
     prices: Optional[Sequence[float]] = None,  # per-packable effective $/h
     cost_tiebreak: bool = False,
     max_shapes: Optional[int] = None,  # decline above this cardinality
@@ -83,8 +83,8 @@ def solve_ffd_device(
     same descending total order as the host oracle is applied here.
 
     ``cost_tiebreak`` picks the cheapest max-pods type per node (capacity
-    order on price ties); currently served by the XLA kernel — a pallas
-    request silently routes there in this mode.
+    order on price ties); currently served by the XLA kernel — pallas and
+    type-spmd requests silently route there in this mode.
 
     ``max_shapes``: return None above this distinct-shape count so the
     caller's native ring answers instead (SolverConfig.device_max_shapes —
@@ -112,16 +112,37 @@ def solve_ffd_device(
 
     if kernel is None:
         kernel = default_kernel()
-    if kernel not in ("xla", "pallas"):
+    if kernel not in ("xla", "pallas", "type-spmd"):
         raise ValueError(f"unknown device kernel {kernel!r}: "
-                         "expected None, 'xla' or 'pallas'")
+                         "expected None, 'xla', 'pallas' or 'type-spmd'")
     if kernel == "pallas" and enc.num_shapes > pallas_max_shapes:
         # the fused VMEM kernel is routed only to its hardware-validated
         # buckets (SolverConfig.pallas_max_shapes); the block-tiled XLA
         # scan is the executor built for anything above
         kernel = "xla"
     use_cost = cost_tiebreak and prices is not None
-    if kernel == "pallas" and not use_cost:
+    if use_cost and kernel in ("pallas", "type-spmd"):
+        # the in-kernel cost tie-break lives in the XLA scan only
+        kernel = "xla"
+    if kernel == "type-spmd":
+        # ONE problem across the whole mesh, instance-type axis sharded,
+        # per-node decisions via in-solve collectives (parallel/
+        # type_sharded.py). Bit-identical to the single-device kernels;
+        # wins when the catalog is large and the batch axis can't fill
+        # the mesh. Falls back to the XLA scan when the padded type
+        # bucket doesn't divide across the mesh.
+        from karpenter_tpu.parallel.type_sharded import (
+            pack_chunk_type_sharded, type_mesh,
+        )
+
+        tmesh = type_mesh()
+        if enc.totals.shape[0] % tmesh.devices.size == 0:
+            import functools
+
+            _chunk = functools.partial(pack_chunk_type_sharded, mesh=tmesh)
+        else:
+            kernel = "xla"
+    if kernel == "pallas":
         import functools
 
         from karpenter_tpu.ops.pack_pallas import pack_chunk_pallas_flat
@@ -130,7 +151,7 @@ def solve_ffd_device(
         _chunk = functools.partial(
             pack_chunk_pallas_flat,
             interpret=jax.default_backend() != "tpu")
-    else:
+    elif kernel == "xla":
         import functools
 
         _chunk = pack_chunk_flat

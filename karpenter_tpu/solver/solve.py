@@ -203,7 +203,11 @@ class SolverConfig:
     device_breaker_seconds: float = 120.0
     max_instance_types: int = host_ffd.MAX_INSTANCE_TYPES
     chunk_iters: int = 64
-    # device kernel: "xla" | "pallas" | None = auto (pallas on real TPU)
+    # device kernel: "xla" | "pallas" | "type-spmd" | None = auto (pallas
+    # on real TPU). "type-spmd" solves ONE problem across the whole mesh
+    # (instance-type axis sharded, in-solve collectives) — for large
+    # catalogs / few-schedule windows where the batch axis can't fill the
+    # mesh; cost-tiebreak demotes it to the XLA scan
     device_kernel: Optional[str] = None
     # below this many pods a device round-trip costs more than it saves
     # (tens of ms over the transport vs sub-ms native solve); the native/

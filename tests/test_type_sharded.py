@@ -90,3 +90,82 @@ class TestTypeShardedParity:
         mesh = type_mesh(cpu_mesh_devices(3))
         with pytest.raises(AssertionError):
             pack_chunk_type_sharded(*device_args(enc), num_iters=4, mesh=mesh)
+
+
+class TestTypeSpmdSolvePath:
+    """The type-SPMD kernel as a first-class routed executor: selectable
+    via SolverConfig(device_kernel='type-spmd') through the public solve()
+    and solve_ffd_device, with chunk resume — not just a raw kernel."""
+
+    def _problem(self, n_pods=120, n_types=16):
+        catalog = instance_types(n_types)
+        constraints = universe_constraints(catalog)
+        pods = [unschedulable_pod(
+            requests={"cpu": f"{100 + 37 * (i % 9)}m",
+                      "memory": f"{64 * (1 + i % 5)}Mi"})
+            for i in range(n_pods)]
+        return catalog, constraints, pods
+
+    def test_solve_ffd_device_type_spmd_matches_host(self):
+        from karpenter_tpu.models.ffd import solve_ffd_device
+        from karpenter_tpu.solver.adapter import build_packables
+
+        catalog, constraints, pods = self._problem()
+        packables, _ = build_packables(catalog, constraints, pods, [])
+        vecs, ids = pod_vectors(pods), list(range(len(pods)))
+        want = host_ffd.pack(vecs, ids, packables)
+        got = solve_ffd_device(vecs, ids, packables, kernel="type-spmd")
+        assert got is not None
+        key = lambda r: (r.node_count, sorted(r.unschedulable),
+                         sorted((tuple(p.instance_type_indices),
+                                 p.node_quantity) for p in r.packings))
+        assert key(got) == key(want)
+
+    def test_chunk_resume(self):
+        from karpenter_tpu.models.ffd import solve_ffd_device
+        from karpenter_tpu.solver.adapter import build_packables
+
+        catalog, constraints, pods = self._problem(n_pods=90)
+        packables, _ = build_packables(catalog, constraints, pods, [])
+        vecs, ids = pod_vectors(pods), list(range(len(pods)))
+        want = host_ffd.pack(vecs, ids, packables)
+        got = solve_ffd_device(vecs, ids, packables, kernel="type-spmd",
+                               chunk_iters=2)  # force many resumes
+        assert got is not None and got.node_count == want.node_count
+
+    def test_public_solve_routes_type_spmd(self):
+        from karpenter_tpu.solver.solve import SolverConfig, solve
+
+        catalog, constraints, pods = self._problem()
+        got = solve(constraints, pods, catalog, config=SolverConfig(
+            device_min_pods=1, device_kernel="type-spmd"))
+        want = solve(constraints, pods, catalog,
+                     config=SolverConfig(use_device=False))
+        assert got.node_count == want.node_count
+        assert not got.unschedulable
+
+    def test_cost_tiebreak_demotes_to_xla(self):
+        """The in-kernel cost tie-break lives in the XLA scan; type-spmd
+        with tiebreak must demote rather than silently ignore prices."""
+        from karpenter_tpu.solver.solve import SolverConfig, solve
+
+        catalog, constraints, pods = self._problem()
+        # DESCENDING prices invert the default first-tie order, so the
+        # cost-tiebreak result provably differs from the no-cost result —
+        # otherwise this test passes even with the demotion deleted
+        for i, it in enumerate(catalog):
+            it.price = 0.1 * (len(catalog) - i)
+        key = lambda r: sorted(
+            (tuple(it.name for it in p.instance_type_options),
+             p.node_quantity) for p in r.packings)
+        want = solve(constraints, pods, catalog, config=SolverConfig(
+            device_min_pods=1, device_kernel="xla", cost_tiebreak=True))
+        plain = solve(constraints, pods, catalog, config=SolverConfig(
+            device_min_pods=1, device_kernel="xla", cost_tiebreak=False))
+        assert key(want) != key(plain), (
+            "precondition: tiebreak must change the packing for this "
+            "problem, or the demotion check below is vacuous")
+        got = solve(constraints, pods, catalog, config=SolverConfig(
+            device_min_pods=1, device_kernel="type-spmd",
+            cost_tiebreak=True))
+        assert key(got) == key(want)
