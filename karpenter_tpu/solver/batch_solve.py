@@ -146,6 +146,18 @@ def _device_batch(encs, packables_list, config: SolverConfig):
     mesh = solver_mesh()
     on_tpu = jax.default_backend() == "tpu"
     kernel = config.device_kernel or default_kernel()
+    if kernel == "type-spmd":
+        # type-axis sharding scales ONE problem across the mesh (solo path,
+        # models/ffd.py); a batch already fills the mesh on the batch axis,
+        # so batched schedules run the per-problem default kernel — loudly,
+        # not silently
+        kernel = default_kernel()
+        log.info("device_kernel='type-spmd' applies to solo solves; "
+                 "batched schedules use the %r kernel", kernel)
+    if kernel not in ("xla", "pallas"):
+        # same contract as the solo path: a typo must not silently run XLA
+        raise ValueError(f"unknown device kernel {kernel!r} for the batched "
+                         "path: expected None, 'xla', 'pallas' or 'type-spmd'")
     L = config.chunk_iters
     batch = pad_problems(encs, mesh.devices.size)
     (shapes, counts, dropped, totals, reserved0, valid,

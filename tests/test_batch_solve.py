@@ -178,3 +178,19 @@ class TestBatchKernels:
         problems = mixed_problems(seed=3, n=3)
         solve_batch(problems, config=SolverConfig(device_min_pods=10**9))
         assert calls["n"] == len(problems)
+
+    def test_type_spmd_config_demotes_in_batch(self, caplog):
+        """device_kernel='type-spmd' is a solo-path axis; the batched path
+        must run the per-problem default kernel LOUDLY (review finding:
+        it previously fell through to XLA silently) and stay correct."""
+        import logging
+
+        problems = mixed_problems(seed=21, n=3)
+        config = SolverConfig(device_min_pods=1, device_kernel="type-spmd")
+        with caplog.at_level(logging.INFO, logger="karpenter.solver.batch"):
+            out = solve_batch(problems, config=config)
+        assert any("type-spmd" in r.message for r in caplog.records)
+        for prob, got in zip(problems, out):
+            want = solve(prob.constraints, prob.pods, prob.instance_types,
+                         config=SolverConfig(use_device=False))
+            assert result_key(got) == result_key(want)
