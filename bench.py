@@ -511,6 +511,8 @@ def config_7_control_plane():
     from karpenter_tpu.scheduling.batcher import Batcher
     from tests.expectations import unschedulable_pod
 
+    from karpenter_tpu.utils.workers import adaptive_workers
+
     N = 10_000
     catalog = make_catalog(100)
     kube = KubeCore()
@@ -521,7 +523,12 @@ def config_7_control_plane():
             Batcher, idle_seconds=0.3, max_seconds=5.0))
     manager = Manager(kube)
     manager.register(provisioning, workers=2)
-    manager.register(SelectionController(kube, provisioning), workers=64)
+    # clamped to the host's cores (utils/workers.py): 64 GIL-bound threads
+    # on a 1-core host bound 10k pods ~4x slower than the adaptive pool
+    # (driver capture BENCH_r04 config_7: 128 pods/s)
+    sel_workers = adaptive_workers(64)
+    manager.register(SelectionController(kube, provisioning),
+                     workers=sel_workers)
 
     prov = Provisioner()
     prov.metadata.name = "load"
@@ -535,6 +542,14 @@ def config_7_control_plane():
                 raise RuntimeError("provisioner worker did not start")
             _time.sleep(0.02)
 
+        # meta-only watch for bind detection: event-driven timestamps with
+        # no deep copies and no polling (the previous 50 ms no-copy scan of
+        # 10k objects consumed ~20% of the single core it shares with the
+        # plane under test)
+        import queue as _queue
+
+        watch_q = kube.watch("Pod", meta_only=True)
+
         shapes = MIXED_SHAPES
         created_at = {}
         t_start = _time.perf_counter()
@@ -547,19 +562,22 @@ def config_7_control_plane():
             created_at[pod.metadata.name] = _time.perf_counter()
         t_created = _time.perf_counter()
 
-        # poll until all bound; record first-seen bind time per pod. The
-        # no-copy scan keeps the measurement itself off the books (a
-        # deep-copying list of 10k pods costs seconds per poll).
         bound_at = {}
         deadline = _time.monotonic() + 240.0
         while len(bound_at) < N and _time.monotonic() < deadline:
-            now = _time.perf_counter()
-            for name, node in kube.scan(
-                    "Pod", lambda p: (p.metadata.name, p.spec.node_name)):
-                if node and name not in bound_at:
-                    bound_at[name] = now
-            _time.sleep(0.05)
+            try:
+                event = watch_q.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            name = event.obj.metadata.name
+            if (event.type == "MODIFIED" and name in created_at
+                    and name not in bound_at):
+                # cheap no-copy confirmation that this MODIFIED is the bind
+                if kube.read("Pod", name, event.obj.metadata.namespace,
+                             lambda p: bool(p.spec.node_name)):
+                    bound_at[name] = _time.perf_counter()
         t_done = _time.perf_counter()
+        kube.unwatch(watch_q)
     finally:
         manager.stop()
 
@@ -574,8 +592,10 @@ def config_7_control_plane():
         "wall_s": round(total_s, 2),
         "pods_bound_per_sec": round(bound / total_s) if total_s > 0 else 0,
         "nodes_created": len(kube.list("Node")),
-        "stack": "watch → selection(64w, non-blocking) → batcher → "
-                 "batched sharded solve → launch → bind (kubecore)",
+        "selection_workers": sel_workers,
+        "stack": f"watch → selection({sel_workers}w adaptive, non-blocking)"
+                 " → batcher → batched sharded solve → launch → "
+                 "bulk bind (kubecore)",
     }
     assert bound == N, f"only {bound}/{N} pods bound"
     return out

@@ -134,22 +134,22 @@ def provisioner_to_manifest(p: Provisioner) -> Dict[str, Any]:
         "metadata": {"name": p.metadata.name},
         "spec": spec,
     }
-    if p.status.conditions or p.status.resources:
-        st: Dict[str, Any] = {}
-        if p.status.conditions:
-            st["conditions"] = [
-                {"type": c.type, "status": c.status,
-                 **({"reason": c.reason} if c.reason else {}),
-                 **({"message": c.message} if c.message else {}),
-                 **({"lastTransitionTime": codec_core_ts_to(
-                     c.last_transition_time)}
-                    if c.last_transition_time is not None else {})}
-                for c in p.status.conditions
-            ]
-        if p.status.resources:
-            st["resources"] = {
-                k: str(q) for k, q in p.status.resources.items()}
-        manifest["status"] = st
+    # status is ALWAYS emitted, empty lists/maps included: _merge's removal
+    # contract is "owned fields always present, even when empty" — omitting
+    # an empty status made clearing the last condition or the resources map
+    # inexpressible through update/_merge (advisor r4)
+    manifest["status"] = {
+        "conditions": [
+            {"type": c.type, "status": c.status,
+             **({"reason": c.reason} if c.reason else {}),
+             **({"message": c.message} if c.message else {}),
+             **({"lastTransitionTime": codec_core_ts_to(
+                 c.last_transition_time)}
+                if c.last_transition_time is not None else {})}
+            for c in p.status.conditions
+        ],
+        "resources": {k: str(q) for k, q in p.status.resources.items()},
+    }
     meta = manifest["metadata"]
     if p.metadata.namespace and p.metadata.namespace != "default":
         meta["namespace"] = p.metadata.namespace

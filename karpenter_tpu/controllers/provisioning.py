@@ -21,7 +21,7 @@ from karpenter_tpu.api.provisioner import Provisioner, set_condition
 from karpenter_tpu.api.requirements import Requirements
 from karpenter_tpu.cloudprovider.spi import CloudProvider, InstanceType
 from karpenter_tpu.metrics.registry import HISTOGRAMS
-from karpenter_tpu.runtime.kubecore import AlreadyExists, Conflict, KubeCore, NotFound
+from karpenter_tpu.runtime.kubecore import AlreadyExists, KubeCore, NotFound
 from karpenter_tpu.scheduling.batcher import Batcher
 from karpenter_tpu.scheduling.scheduler import Scheduler
 from karpenter_tpu.solver.batch_solve import Problem, solve_batch
@@ -97,9 +97,15 @@ class ProvisionerWorker:
                 log.exception("provisioning failed")
 
     # -- API for the selection controller -----------------------------------
-    def add(self, pod: Pod) -> threading.Event:
-        """Enqueue a pod; returns the gate to block on (provisioner.go:80-82)."""
-        return self.batcher.add(pod)
+    def add(self, pod: Pod, key=None) -> threading.Event:
+        """Enqueue a pod; returns the gate to block on (provisioner.go:80-82).
+        ``key`` (namespace, name) enables :meth:`pending` de-duplication."""
+        return self.batcher.add(pod, key=key)
+
+    def pending(self, key) -> bool:
+        """True while a pod with this (namespace, name) key awaits a batch
+        window — the selection requeue loop skips re-adding it."""
+        return self.batcher.contains(key)
 
     # -- the hot loop (provisioner.go:84-120) --------------------------------
     def provision(self) -> Optional[SolveResult]:
@@ -211,16 +217,14 @@ class ProvisionerWorker:
                 self.kube.create(node)
             except AlreadyExists:
                 pass  # self-registered first — idempotent (provisioner.go:177-186)
-            bound = 0
-            for pod in pods:
-                try:
-                    self.kube.bind_pod(pod, node.metadata.name)
-                    bound += 1
-                except (NotFound, Conflict) as e:
-                    log.error("failed to bind %s/%s to %s: %s",
-                              pod.metadata.namespace, pod.metadata.name,
-                              node.metadata.name, e)
-            log.info("bound %d pod(s) to node %s", bound, node.metadata.name)
+            # one locked pass for the node's whole pod set (provisioner.go
+            # binds sequentially; per-pod lock round-trips dominated the
+            # 10k-pod flood on a contended host)
+            errs = self.kube.bind_pods(pods, node.metadata.name)
+            for e in errs:
+                log.error("failed to bind to %s: %s", node.metadata.name, e)
+            log.info("bound %d pod(s) to node %s",
+                     len(pods) - len(errs), node.metadata.name)
             return None
 
 

@@ -64,17 +64,29 @@ def validate(p: Pod) -> Optional[str]:
 class Preferences:
     """Iterative preference relaxation with TTL reset (preferences.go:40-106)."""
 
+    # full-cache sweeps are amortized: a sweep per relax() call is O(cache)
+    # under the lock, which goes quadratic at the 10k-pending-pod regime
+    # (every pod's 5 s requeue rebuilt a 10k-entry dict — measured as a
+    # top GIL consumer on a 1-core host). Per-entry TTL stays exact via the
+    # timestamp check below; the sweep only reclaims memory.
+    SWEEP_INTERVAL_SECONDS = RELAXATION_TTL_SECONDS / 4
+
     def __init__(self):
         self._cache: Dict[str, Tuple[Optional[Affinity], float]] = {}
         self._lock = threading.Lock()
+        self._next_sweep = 0.0
 
     def relax(self, pod: Pod) -> None:
         now = clock.now()
         uid = pod.metadata.uid or f"{pod.metadata.namespace}/{pod.metadata.name}"
         with self._lock:
-            self._cache = {k: v for k, v in self._cache.items()
-                           if now - v[1] < RELAXATION_TTL_SECONDS}
+            if now >= self._next_sweep:
+                self._cache = {k: v for k, v in self._cache.items()
+                               if now - v[1] < RELAXATION_TTL_SECONDS}
+                self._next_sweep = now + self.SWEEP_INTERVAL_SECONDS
             entry = self._cache.get(uid)
+            if entry is not None and now - entry[1] >= RELAXATION_TTL_SECONDS:
+                entry = None  # expired between sweeps: same TTL semantics
             if entry is None:
                 self._cache[uid] = (pod.spec.affinity, now)
                 return
@@ -191,6 +203,25 @@ class SelectionController:
         return "Pod"
 
     def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
+        # no-copy provisionability probe first: in the 10k-pod flood most
+        # reconciles are bind-MODIFIED events or 5 s re-verify requeues of
+        # already-filtered pods, and paying a full deep-copy GET for a
+        # one-predicate answer was a top CPU line on a 1-core host
+        try:
+            if not self.kube.read("Pod", name, namespace, is_provisionable):
+                return None
+        except NotFound:
+            return None
+        # already awaiting a batch window? Skip the relax/validate/select
+        # repeat — the window's consumption clears the key, so the NEXT
+        # requeue performs the full post-batch re-verification this requeue
+        # exists for (see the concurrency note in the class docstring)
+        key = (namespace, name)
+        # list() snapshot: the workers dict is mutated under the provisioning
+        # controller's lock; iterating it live can see a resize mid-scan
+        if any(w.pending(key)
+               for w in list(self.provisioning.workers.values())):
+            return self.REQUEUE_SECONDS
         try:
             pod = self.kube.get("Pod", name, namespace)
         except NotFound:
@@ -227,7 +258,7 @@ class SelectionController:
             errs.append(f"tried provisioner/{worker.provisioner.metadata.name}: {err}")
         if chosen is None:
             return f"matched 0/{len(errs)} provisioners: " + "; ".join(errs)
-        gate = chosen.add(pod)
+        gate = chosen.add(pod, key=(pod.metadata.namespace, pod.metadata.name))
         if self.gate_timeout > 0:
             gate.wait(timeout=self.gate_timeout)
         return None

@@ -32,6 +32,7 @@ from karpenter_tpu.runtime.kubecore import KubeCore
 from karpenter_tpu.runtime.manager import Manager
 from karpenter_tpu.scheduling.batcher import Batcher
 from karpenter_tpu.solver.solve import SolverConfig
+from karpenter_tpu.utils.workers import adaptive_workers
 
 log = logging.getLogger("karpenter")
 
@@ -71,9 +72,14 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
             max_items=options.batch_max_items))
     manager = Manager(kube)
     manager.register(provisioning)
-    manager.register(SelectionController(kube, provisioning), workers=64)
-    manager.register(NodeController(kube), workers=10)
-    manager.register(TerminationController(kube, cloud_provider), workers=10)
+    # worker pools are clamped to the host's cores (utils/workers.py): the
+    # reference's 10k-concurrent-goroutine regime maps to a few GIL-bound
+    # threads per core here, not a thread per in-flight reconcile
+    manager.register(SelectionController(kube, provisioning),
+                     workers=adaptive_workers(64))
+    manager.register(NodeController(kube), workers=adaptive_workers(10))
+    manager.register(TerminationController(kube, cloud_provider),
+                     workers=adaptive_workers(10))
     manager.register(CounterController(kube))
     manager.register(ConsolidationController(kube))
     manager.register(PVCController(kube))

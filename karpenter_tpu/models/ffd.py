@@ -83,8 +83,9 @@ def solve_ffd_device(
     same descending total order as the host oracle is applied here.
 
     ``cost_tiebreak`` picks the cheapest max-pods type per node (capacity
-    order on price ties); currently served by the XLA kernel — pallas and
-    type-spmd requests silently route there in this mode.
+    order on price ties); implemented in-kernel by all three device
+    executors (XLA scan, pallas, type-spmd) with identical semantics —
+    differentially enforced by tests/test_cost_model.py.
 
     ``max_shapes``: return None above this distinct-shape count so the
     caller's native ring answers instead (SolverConfig.device_max_shapes —
@@ -121,9 +122,10 @@ def solve_ffd_device(
         # scan is the executor built for anything above
         kernel = "xla"
     use_cost = cost_tiebreak and prices is not None
-    if use_cost and kernel in ("pallas", "type-spmd"):
-        # the in-kernel cost tie-break lives in the XLA scan only
-        kernel = "xla"
+    prices_dev = None
+    if use_cost:
+        prices_dev = jax.device_put(
+            encode_prices(prices, enc.totals.shape[0]))
     if kernel == "type-spmd":
         # ONE problem across the whole mesh, instance-type axis sharded,
         # per-node decisions via in-solve collectives (parallel/
@@ -139,7 +141,9 @@ def solve_ffd_device(
         if enc.totals.shape[0] % tmesh.devices.size == 0:
             import functools
 
-            _chunk = functools.partial(pack_chunk_type_sharded, mesh=tmesh)
+            _chunk = functools.partial(
+                pack_chunk_type_sharded, mesh=tmesh,
+                prices=prices_dev, cost_tiebreak=use_cost)
         else:
             kernel = "xla"
     if kernel == "pallas":
@@ -150,16 +154,13 @@ def solve_ffd_device(
         # off-TPU (tests, dev laptops) Mosaic can't compile — interpret
         _chunk = functools.partial(
             pack_chunk_pallas_flat,
-            interpret=jax.default_backend() != "tpu")
+            interpret=jax.default_backend() != "tpu",
+            prices=prices_dev, cost_tiebreak=use_cost)
     elif kernel == "xla":
         import functools
 
-        _chunk = pack_chunk_flat
-        if use_cost:
-            prices_dev = jax.device_put(
-                encode_prices(prices, enc.totals.shape[0]))
-            _chunk = functools.partial(pack_chunk_flat, prices=prices_dev,
-                                       cost_tiebreak=True)
+        _chunk = functools.partial(pack_chunk_flat, prices=prices_dev,
+                                   cost_tiebreak=use_cost)
 
     S, L = enc.shapes.shape[0], chunk_iters
     # one host→device transfer for the whole problem (tunnel-latency bound)

@@ -41,6 +41,7 @@ def _pack_kernel(
     totals_t,     # (R, T) int32
     reserved0_t,  # (R, T) int32
     valid,        # (1, T) int32 (0/1)
+    prices_in,    # (1, T) int32 effective micro-$/h (cost_tiebreak only)
     lastv,        # (1, 1) int32 SMEM — index of largest viable type
     pods_unit,    # (1, 1) int32 SMEM — one pod in device units
     # outputs
@@ -56,6 +57,8 @@ def _pack_kernel(
     npacked,      # (1, T) VMEM int32
     maxfit,       # (1, S) VMEM int32
     packedv_s,    # (1, S) VMEM int32
+    *,
+    cost_tiebreak: bool,
 ):
     R, S = shapes_t.shape
     T = totals_t.shape[1]
@@ -144,8 +147,16 @@ def _pack_kernel(
         jax.lax.fori_loop(0, S, shape_step, 0)
 
         max_pods = lane_scalar(npacked[:], iota_t, lastv[0, 0])
-        chosen = jnp.min(jnp.where(
-            valid_b & (npacked[:] == max_pods), iota_t, INT32_MAX))
+        tie = valid_b & (npacked[:] == max_pods)
+        if cost_tiebreak:
+            # cheapest max-pods type; capacity order (smallest index) breaks
+            # price ties — same semantics as ops/pack.py's cost branch and
+            # models/cost.order_options_by_price. The fast-forward stays
+            # valid: prices are constant, so a repeated round re-derives
+            # the identical tie set and the identical chosen type.
+            best_price = jnp.min(jnp.where(tie, prices_in[:], INT32_MAX))
+            tie = tie & (prices_in[:] == best_price)
+        chosen = jnp.min(jnp.where(tie, iota_t, INT32_MAX))
         nothing = max_pods == 0
 
         # pass 2: replay the chosen type's column alone to recover its
@@ -211,7 +222,8 @@ def _pack_kernel(
     done_out[0, 0] = done_f.astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("num_iters", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("num_iters", "interpret", "cost_tiebreak"))
 def pack_chunk_pallas(
     shapes,     # (S, R) int32 — same layout as ops.pack.pack_chunk
     counts,     # (S,)
@@ -223,18 +235,23 @@ def pack_chunk_pallas(
     pods_unit,  # () int32
     num_iters: int,
     interpret: bool = False,
+    prices=None,               # (T,) int32 micro-$/h (models/ffd.encode_prices)
+    cost_tiebreak: bool = False,
 ):
     """Same contract as ops.pack.pack_chunk (up to the junk-row caveat:
     iterations past `done` or with q == 0 report chosen=-1/q=0/packed=0
     here, while the scan version reports stale values — callers only
     consume q > 0 rows). Transposes at the boundary; the kernel runs in
-    the (R, lanes) layout."""
+    the (R, lanes) layout. ``cost_tiebreak`` matches ops.pack.pack_chunk:
+    cheapest max-pods type wins, capacity order breaks price ties."""
     S, R = shapes.shape
     T = totals.shape[0]
     L = num_iters
+    if prices is None:
+        prices = jnp.zeros((T,), jnp.int32)
 
     outs = pl.pallas_call(
-        _pack_kernel,
+        functools.partial(_pack_kernel, cost_tiebreak=cost_tiebreak),
         out_shape=(
             jax.ShapeDtypeStruct((1, S), jnp.int32),   # counts
             jax.ShapeDtypeStruct((1, S), jnp.int32),   # dropped
@@ -250,6 +267,7 @@ def pack_chunk_pallas(
             pl.BlockSpec(memory_space=pltpu.VMEM),     # totals_t
             pl.BlockSpec(memory_space=pltpu.VMEM),     # reserved0_t
             pl.BlockSpec(memory_space=pltpu.VMEM),     # valid
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # prices
             pl.BlockSpec(memory_space=pltpu.SMEM),     # last_valid
             pl.BlockSpec(memory_space=pltpu.SMEM),     # pods_unit
         ],
@@ -276,6 +294,7 @@ def pack_chunk_pallas(
         totals.T.astype(jnp.int32),
         reserved0.T.astype(jnp.int32),
         valid.reshape(1, T).astype(jnp.int32),
+        prices.reshape(1, T).astype(jnp.int32),
         jnp.asarray(last_valid, jnp.int32).reshape(1, 1),
         jnp.asarray(pods_unit, jnp.int32).reshape(1, 1),
     )
@@ -284,11 +303,14 @@ def pack_chunk_pallas(
             chosen_seq[0], q_seq[0], packed_seq)
 
 
-@functools.partial(jax.jit, static_argnames=("num_iters", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("num_iters", "interpret", "cost_tiebreak"))
 def pack_chunk_pallas_flat(
     shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
     num_iters: int,
     interpret: bool = False,
+    prices=None,
+    cost_tiebreak: bool = False,
 ):
     """Flattened single-buffer variant in ops.pack's shared layout
     (flatten_chunk_outputs / unpack_flat) so a solve costs exactly one
@@ -298,4 +320,5 @@ def pack_chunk_pallas_flat(
 
     return flatten_chunk_outputs(*pack_chunk_pallas(
         shapes, counts, dropped, totals, reserved0, valid,
-        last_valid, pods_unit, num_iters=num_iters, interpret=interpret))
+        last_valid, pods_unit, num_iters=num_iters, interpret=interpret,
+        prices=prices, cost_tiebreak=cost_tiebreak))

@@ -17,6 +17,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -57,7 +58,8 @@ def pack_batch_sharded(
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_iters", "mesh", "kernel", "interpret"))
+                   static_argnames=("num_iters", "mesh", "kernel", "interpret",
+                                    "cost_tiebreak"))
 def pack_batch_sharded_flat(
     shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
     *,
@@ -65,6 +67,8 @@ def pack_batch_sharded_flat(
     mesh: Mesh,
     kernel: str = "xla",
     interpret: bool = False,
+    prices=None,               # (B, T) int32 micro-$/h per problem
+    cost_tiebreak: bool = False,
 ):
     """pack_batch_sharded with the six per-problem outputs flattened into ONE
     (B, 2S+1+2L+L·S) int32 buffer. The TPU sits behind a tunnel whose
@@ -73,15 +77,29 @@ def pack_batch_sharded_flat(
     awaited outputs would each pay a full RTT. Each row is exactly one
     ops.pack.pack_chunk_flat buffer (the layout lives only there).
     ``kernel`` selects the per-problem executor ("xla" scan or the fused
-    "pallas" kernel, models/ffd.default_kernel semantics)."""
+    "pallas" kernel, models/ffd.default_kernel semantics);
+    ``cost_tiebreak`` applies each problem's price row in-kernel
+    (ops.pack.pack_chunk semantics), either executor."""
+    if prices is None:
+        prices = jnp.zeros(valid.shape, jnp.int32)
     if kernel == "pallas":
         from karpenter_tpu.ops.pack_pallas import pack_chunk_pallas_flat
 
-        one = functools.partial(pack_chunk_pallas_flat, num_iters=num_iters,
-                                interpret=interpret)
+        def one(shapes, counts, dropped, totals, reserved0, valid,
+                last_valid, pods_unit, prices):
+            return pack_chunk_pallas_flat(
+                shapes, counts, dropped, totals, reserved0, valid,
+                last_valid, pods_unit, num_iters=num_iters,
+                interpret=interpret, prices=prices,
+                cost_tiebreak=cost_tiebreak)
     else:
-        one = functools.partial(pack_chunk_flat, num_iters=num_iters)
-    vmapped = jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0, 0, 0))
+        def one(shapes, counts, dropped, totals, reserved0, valid,
+                last_valid, pods_unit, prices):
+            return pack_chunk_flat(
+                shapes, counts, dropped, totals, reserved0, valid,
+                last_valid, pods_unit, num_iters=num_iters,
+                prices=prices, cost_tiebreak=cost_tiebreak)
+    vmapped = jax.vmap(one, in_axes=(0,) * 9)
     spec = P("batch")
     # check_vma=False: problems are independent per shard (no collectives,
     # nothing replicated), and the pallas kernel's out_shape carries no vma
@@ -90,10 +108,11 @@ def pack_batch_sharded_flat(
     # the xla kernel via the retry ring
     return shard_map(
         vmapped, mesh=mesh,
-        in_specs=(spec,) * 8,
+        in_specs=(spec,) * 9,
         out_specs=spec,
         check_vma=False,
-    )(shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit)
+    )(shapes, counts, dropped, totals, reserved0, valid, last_valid,
+      pods_unit, prices)
 
 
 def unpack_batch_flat(buf, S: int, L: int):

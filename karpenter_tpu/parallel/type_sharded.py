@@ -52,7 +52,8 @@ def type_mesh(devices=None) -> Mesh:
 
 
 def _local_pack(shapes, counts, dropped, totals_l, reserved0_l, valid_l,
-                last_valid, pods_unit, num_iters: int):
+                prices_l, last_valid, pods_unit, num_iters: int,
+                cost_tiebreak: bool = False):
     """Per-device body under shard_map: totals/reserved0/valid carry this
     device's type shard; everything else is replicated. Every cross-type
     decision goes through a collective, after which all devices hold
@@ -116,6 +117,14 @@ def _local_pack(shapes, counts, dropped, totals_l, reserved0_l, valid_l,
         # first (globally smallest-index) type achieving the bound — pmin
         # over per-device first-tie global indices (packer.go:174-183)
         tie = valid_l & (npacked == max_pods)
+        if cost_tiebreak:
+            # cheapest max-pods type globally (ops/pack.py cost branch):
+            # pmin of each shard's best local price narrows the tie set to
+            # the global minimum before the first-index pmin below —
+            # capacity order still breaks price ties
+            best_price = jax.lax.pmin(
+                jnp.min(jnp.where(tie, prices_l, INT32_MAX)), AXIS)
+            tie = tie & (prices_l == best_price)
         local_first = jnp.where(
             jnp.any(tie), offset + jnp.argmax(tie).astype(jnp.int32),
             INT32_MAX)
@@ -150,25 +159,33 @@ def _local_pack(shapes, counts, dropped, totals_l, reserved0_l, valid_l,
                                  chosen_seq, q_seq, packed_seq)
 
 
-@functools.partial(jax.jit, static_argnames=("num_iters", "mesh"))
+@functools.partial(
+    jax.jit, static_argnames=("num_iters", "mesh", "cost_tiebreak"))
 def pack_chunk_type_sharded(
     shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
     *,
     num_iters: int,
     mesh: Mesh,
+    prices=None,               # (T,) int32 micro-$/h (models/ffd.encode_prices)
+    cost_tiebreak: bool = False,
 ):
     """pack_chunk with the TYPE axis sharded over the mesh; returns the
     same flat buffer as pack_chunk_flat (replicated — one fetch). T must be
     a multiple of the mesh size (the TYPE_BUCKETS are powers of two, so any
-    power-of-two mesh divides them)."""
+    power-of-two mesh divides them). ``cost_tiebreak`` matches
+    ops.pack.pack_chunk: cheapest max-pods type wins (one extra pmin)."""
     T = totals.shape[0]
     n = mesh.devices.size
     assert T % n == 0, f"type axis {T} not divisible by mesh size {n}"
-    body = functools.partial(_local_pack, num_iters=num_iters)
+    if prices is None:
+        prices = jnp.zeros((T,), jnp.int32)
+    body = functools.partial(_local_pack, num_iters=num_iters,
+                             cost_tiebreak=cost_tiebreak)
     spec_t = P(AXIS)
     rep = P()
     return shard_map(
         body, mesh=mesh,
-        in_specs=(rep, rep, rep, spec_t, spec_t, spec_t, rep, rep),
+        in_specs=(rep, rep, rep, spec_t, spec_t, spec_t, spec_t, rep, rep),
         out_specs=rep,
-    )(shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit)
+    )(shapes, counts, dropped, totals, reserved0, valid, prices,
+      last_valid, pods_unit)

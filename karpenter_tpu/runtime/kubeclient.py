@@ -569,6 +569,20 @@ class KubeApiClient:
             "target": {"apiVersion": "v1", "kind": "Node", "name": node_name},
         })
 
+    def bind_pods(self, pods: List[Pod], node_name: str) -> List[str]:
+        """Bulk-bind parity with kubecore.bind_pods: the real API has no
+        batch Binding verb, so this is one POST per pod with per-pod error
+        capture (the bulk win — one lock acquisition — is a property of the
+        in-memory store, not the wire)."""
+        errs: List[str] = []
+        for pod in pods:
+            try:
+                self.bind_pod(pod, node_name)
+            except ApiError as e:
+                errs.append(f"pod {pod.metadata.namespace}/"
+                            f"{pod.metadata.name}: {e}")
+        return errs
+
     def evict_pod(self, name: str, namespace: str = "default") -> None:
         path = self._item("Pod", name, namespace) + "/eviction"
         self._request("POST", path, {
@@ -581,12 +595,17 @@ class KubeApiClient:
                          field=("spec.nodeName", node_name))
 
     # -- watch ---------------------------------------------------------------
-    def watch(self, kind: Optional[str] = None) -> "queue.Queue[Event]":
+    def watch(self, kind: Optional[str] = None,
+              meta_only: bool = False) -> "queue.Queue[Event]":
         """Streamed watch with informer semantics: LIST replayed as ADDED,
         then ?watch=true from the list's resourceVersion. EVERY reconnect
         redoes the LIST — a watch without a resourceVersion replays
         nothing, so events from the disconnect gap would otherwise be lost
-        (controllers are level-triggered, so duplicate ADDEDs are safe)."""
+        (controllers are level-triggered, so duplicate ADDEDs are safe).
+
+        ``meta_only`` is accepted for kubecore.watch signature parity and
+        ignored: wire events are freshly decoded objects, never shared with
+        a store, so there is no copy to skip."""
         assert kind is not None, "the API client watches one kind at a time"
         q: "queue.Queue[Event]" = queue.Queue()
         self._watch_queues.append(q)

@@ -55,6 +55,30 @@ class Event:
     obj: object
 
 
+class _Meta:
+    """Metadata stub carried by meta-only watch events."""
+
+    __slots__ = ("name", "namespace")
+
+    def __init__(self, name: str, namespace: str):
+        self.name = name
+        self.namespace = namespace
+
+
+class MetaObj:
+    """Lightweight object for meta-only watches: kind + metadata
+    (name/namespace) and nothing else. Watch pumps that only enqueue
+    reconcile keys (runtime/manager.py) read exactly these fields; handing
+    them a deep copy of a full pod per event was a top allocation source
+    in the 10k-pod control-plane flood."""
+
+    __slots__ = ("kind", "metadata")
+
+    def __init__(self, kind: str, name: str, namespace: str):
+        self.kind = kind
+        self.metadata = _Meta(name, namespace)
+
+
 Key = Tuple[str, str, str]  # (kind, namespace, name)
 
 
@@ -70,7 +94,8 @@ class KubeCore:
         self._objects: Dict[Key, object] = {}
         self._rv = itertools.count(1)
         self._uid = itertools.count(1)
-        self._watchers: List[Tuple[Optional[str], "queue.Queue[Event]"]] = []
+        self._watchers: List[
+            Tuple[Optional[str], "queue.Queue[Event]", bool]] = []
         # the spec.nodeName field index (manager.go:39-43): node name → pod
         # keys, maintained on every pod mutation so pods_on_node is O(pods
         # on that node), not O(all pods) — emptiness/termination/metrics
@@ -101,25 +126,42 @@ class KubeCore:
             self._pods_by_node.setdefault(new_node, {})[key] = None
 
     def _notify(self, event_type: str, obj) -> None:
-        for kind, q in self._watchers:
+        # safe with or without self._lock held: _watchers is copy-on-write
+        # (watch/unwatch REPLACE the list under the lock, never mutate it),
+        # so iterating a snapshot reference cannot see a resize
+        meta = None
+        for kind, q, meta_only in self._watchers:
             if kind is None or kind == obj.kind:
-                q.put(Event(event_type, deep_copy(obj)))
+                if meta_only:
+                    if meta is None:
+                        meta = MetaObj(obj.kind, obj.metadata.name,
+                                       obj.metadata.namespace)
+                    q.put(Event(event_type, meta))
+                else:
+                    q.put(Event(event_type, deep_copy(obj)))
 
     # -- watch --------------------------------------------------------------
-    def watch(self, kind: Optional[str] = None) -> "queue.Queue[Event]":
+    def watch(self, kind: Optional[str] = None,
+              meta_only: bool = False) -> "queue.Queue[Event]":
         """Subscribe to events for a kind (None = all). Existing objects are
-        replayed as ADDED, matching informer initial-list semantics."""
+        replayed as ADDED, matching informer initial-list semantics.
+        ``meta_only`` delivers :class:`MetaObj` stubs (kind + name/namespace)
+        instead of deep copies — for subscribers that only enqueue keys."""
         q: "queue.Queue[Event]" = queue.Queue()
         with self._lock:
             for obj in self._objects.values():
                 if kind is None or obj.kind == kind:
-                    q.put(Event("ADDED", deep_copy(obj)))
-            self._watchers.append((kind, q))
+                    stub = (MetaObj(obj.kind, obj.metadata.name,
+                                    obj.metadata.namespace)
+                            if meta_only else deep_copy(obj))
+                    q.put(Event("ADDED", stub))
+            # copy-on-write (see _notify)
+            self._watchers = self._watchers + [(kind, q, meta_only)]
         return q
 
     def unwatch(self, q) -> None:
         with self._lock:
-            self._watchers = [(k, w) for k, w in self._watchers if w is not q]
+            self._watchers = [w for w in self._watchers if w[1] is not q]
 
     # -- CRUD ---------------------------------------------------------------
     def create(self, obj):
@@ -281,6 +323,39 @@ class KubeCore:
             stored.metadata.resource_version = self._next_rv()
             self._reindex(k, None, stored)  # was unbound: nothing to remove
             self._notify("MODIFIED", stored)
+
+    def bind_pods(self, pods: List[Pod], node_name: str) -> List[str]:
+        """Bulk binding: bind every pod to ``node_name`` under ONE lock
+        acquisition (a node's worth of binds — the provisioning hot loop
+        previously paid a lock round-trip and watcher fan-out per pod).
+        Returns per-pod error strings for the pods that failed; successful
+        pods are bound and notified exactly as bind_pod would."""
+        errs: List[str] = []
+        bound: List[object] = []
+        with self._lock:
+            for pod in pods:
+                k = ("Pod", pod.metadata.namespace, pod.metadata.name)
+                stored = self._objects.get(k)
+                if stored is None:
+                    errs.append(f"pod {k} not found")
+                    continue
+                if stored.spec.node_name:
+                    errs.append(f"pod {pod.metadata.name} already bound "
+                                f"to {stored.spec.node_name}")
+                    continue
+                stored.spec.node_name = node_name
+                stored.metadata.resource_version = self._next_rv()
+                self._reindex(k, None, stored)  # was unbound
+                bound.append(stored)
+        # notify OUTSIDE the lock: full-copy watchers pay a deep copy per
+        # event, and a node's worth of copies inside the critical section
+        # would stall every concurrent read behind the bind (review r5).
+        # An event may therefore carry object state slightly NEWER than the
+        # bind it announces (same coalescing a real informer's watch cache
+        # performs); controllers here are level-triggered by design.
+        for stored in bound:
+            self._notify("MODIFIED", stored)
+        return errs
 
     def evict_pod(self, name: str, namespace: str = "default") -> None:
         """Eviction subresource: deletes the pod (PDB checks live in the

@@ -130,7 +130,9 @@ class Manager:
         for controller, workers in self._controllers:
             wq = _WorkQueue()
             self._queues.append(wq)
-            watch_q = self.kube.watch(controller.kind())
+            # the primary pump only enqueues (name, namespace) keys, so it
+            # subscribes meta-only: no per-event deep copy (kubecore.MetaObj)
+            watch_q = self.kube.watch(controller.kind(), meta_only=True)
 
             def pump(watch_q=watch_q, wq=wq):
                 while not self._stop.is_set():

@@ -32,6 +32,11 @@ class Batcher:
         self._lock = threading.Lock()
         self._gate = threading.Event()
         self._running = True
+        # keys awaiting a window (cleared as wait() consumes them): lets the
+        # selection requeue loop skip the full relax/validate/select path for
+        # a pod that is already queued — on a contended 1-core host the 5 s
+        # re-verify requeues of 10k pending pods otherwise dominate the GIL
+        self._pending_keys: set = set()
         # monotonic counters for synchronizers (tests/expectations.py):
         # added_total — items enqueued; consumed_total — items a wait()
         # window has picked up; processed_total — items whose window has
@@ -43,13 +48,26 @@ class Batcher:
         self.consumed_total = 0
         self.processed_total = 0
 
-    def add(self, item: Any) -> threading.Event:
+    def add(self, item: Any, key: Any = None) -> threading.Event:
         """Enqueue an item; returns the gate event the caller may wait on
-        (batcher.go:61-69)."""
-        self._queue.put(item)
+        (batcher.go:61-69). ``key`` (optional) registers the item for
+        :meth:`contains` until its window is consumed. The key is registered
+        BEFORE the item becomes consumable so a concurrent wait() can never
+        observe the item yet miss the key (which would strand it forever)."""
         with self._lock:
+            if key is not None:
+                self._pending_keys.add(key)
             self.added_total += 1
-            return self._gate
+            gate = self._gate
+        self._queue.put((item, key))
+        return gate
+
+    def contains(self, key: Any) -> bool:
+        """True while an item added with ``key`` awaits a window. Returns
+        False the moment wait() consumes it — the caller's next requeue then
+        performs the full post-batch re-verification."""
+        with self._lock:
+            return key in self._pending_keys
 
     def flush(self) -> None:
         """Release all waiters and open a new gate (batcher.go:72-77)."""
@@ -68,10 +86,20 @@ class Batcher:
         """Collect one windowed batch (batcher.go:80-103): starts at the
         first item; extends on arrivals up to idle/max/size limits."""
         items: List[Any] = []
+        keys: List[Any] = []
+
+        def take(envelope) -> bool:
+            if envelope is None:
+                return False
+            item, key = envelope
+            items.append(item)
+            if key is not None:
+                keys.append(key)
+            return True
+
         first = self._queue.get()
-        if first is None or not self._running:
+        if not self._running or not take(first):
             return items, 0.0
-        items.append(first)
         start = time.monotonic()
         deadline = start + self.max_seconds
         while self._running and len(items) < self.max_items:
@@ -80,12 +108,12 @@ class Batcher:
             if timeout <= 0:
                 break
             try:
-                item = self._queue.get(timeout=timeout)
+                envelope = self._queue.get(timeout=timeout)
             except queue.Empty:
                 break
-            if item is None:
+            if not take(envelope):
                 break
-            items.append(item)
         with self._lock:
+            self._pending_keys.difference_update(keys)
             self.consumed_total += len(items)
         return items, time.monotonic() - start

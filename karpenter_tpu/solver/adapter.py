@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import functools
 import itertools
+import os
 import threading
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
@@ -78,7 +79,29 @@ _VEC_BY_ID: List[Vec] = []
 # sid batches carry their generation, and any generation mismatch makes
 # the consumer fall back to the (always-correct) dict dedupe — a stale sid
 # can never index the wrong vector.
-_INTERN_MAX = 1 << 20
+#
+# Cap sizing (advisor r4): each entry is an 8-tuple of ints plus dict/list
+# slots — roughly 400 B — so the table's worst-case RSS is about
+# cap x 400 B. 1<<18 bounds it near ~100 MB, still 32x the largest device
+# shape bucket (8192) and far beyond any observed steady state; override
+# via KARPENTER_INTERN_MAX for unusual fleets (rollover is correctness-
+# neutral either way, it only costs a dedupe-path fallback per generation).
+def _intern_max_from_env() -> int:
+    raw = os.environ.get("KARPENTER_INTERN_MAX", "")
+    if not raw.strip():
+        return 1 << 18
+    try:
+        return max(1, int(raw.strip()))
+    except ValueError:
+        import logging
+
+        logging.getLogger("karpenter.solver.adapter").warning(
+            "KARPENTER_INTERN_MAX=%r is not an integer; using default %d",
+            raw, 1 << 18)
+        return 1 << 18
+
+
+_INTERN_MAX = _intern_max_from_env()
 _INTERN_GEN = 0
 
 

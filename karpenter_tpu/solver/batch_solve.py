@@ -23,7 +23,9 @@ import numpy as np
 from karpenter_tpu.api.constraints import Constraints
 from karpenter_tpu.api.core import Pod
 from karpenter_tpu.cloudprovider.spi import InstanceType
-from karpenter_tpu.models.ffd import MAX_CHUNKS, _decode, default_kernel
+from karpenter_tpu.models.ffd import (
+    MAX_CHUNKS, _decode, default_kernel, encode_prices,
+)
 from karpenter_tpu.ops.encode import encode
 from karpenter_tpu.solver.adapter import (
     build_packables_cached, marshal_pods_interned,
@@ -66,6 +68,25 @@ def _solve_batch(problems: Sequence[Problem],
             required=required)
         prepared.append((packables, sorted_types, vecs, sids))
 
+    def _problem_prices(i: int) -> Optional[list]:
+        """Per-problem effective prices for the in-kernel cost tie-break —
+        the SAME vector the solo path builds (solve.py solve_with_packables),
+        so batched and solo cost-mode solves stay differential. Called only
+        for problems that actually join the device batch: solo fallbacks
+        build their own, and paying effective_price() for a batch the gate
+        rejects would waste the provisioning hot loop."""
+        from karpenter_tpu.models.cost import effective_price
+
+        packables, sorted_types, _, _ = prepared[i]
+        if not (packables and any(it.price for it in sorted_types)):
+            return None
+        return [
+            effective_price(sorted_types[p.index],
+                            problems[i].constraints.requirements,
+                            config.cost_config)[0]
+            for p in packables
+        ]
+
     # gate on the cheap signals BEFORE paying for encoding: a batch of tiny
     # problems is faster on the native/host executors than a device trip
     total_pods = sum(len(p.pods) for p in problems)
@@ -102,15 +123,19 @@ def _solve_batch(problems: Sequence[Problem],
                 # same hang watchdog + circuit breaker as the solo device
                 # ring (solver/solve.py): a sick transport must not stall
                 # the provisioning hot loop
+                batch_packables = [prepared[i][0] for i in batch_idx]
+                batch_prices = [
+                    _problem_prices(i) if config.cost_tiebreak else None
+                    for i in batch_idx]
                 if config.device_timeout_s > 0:
                     host_results = solve_module._WATCHDOG.run(
                         lambda: _device_batch(
-                            encs, [prepared[i][0] for i in batch_idx], config),
+                            encs, batch_packables, batch_prices, config),
                         config.device_timeout_s,
                         config.device_breaker_seconds)
                 else:
                     host_results = _device_batch(
-                        encs, [prepared[i][0] for i in batch_idx], config)
+                        encs, batch_packables, batch_prices, config)
         except Exception:  # device ring: never drop a provisioning loop
             log.exception("batched device solve failed; falling back per problem")
             host_results = None
@@ -131,11 +156,14 @@ def _solve_batch(problems: Sequence[Problem],
     return results
 
 
-def _device_batch(encs, packables_list, config: SolverConfig):
+def _device_batch(encs, packables_list, prices_list, config: SolverConfig):
     """One (or rarely more) pack_batch_sharded_flat call(s) solving all
     encoded problems; chunk-resumes any problem that outlives num_iters.
     Invariant tensors ship host→device ONCE; resumes send only the small
-    counts/dropped rows."""
+    counts/dropped rows. ``prices_list`` carries each problem's per-packable
+    effective $/h (or None); rows without prices get all-INT32_MAX price
+    vectors, which degrade the in-kernel tie-break to Go's first-smallest —
+    exactly what the solo path does for an unpriced catalog."""
     import jax
 
     from karpenter_tpu.parallel.mesh import solver_mesh
@@ -167,16 +195,29 @@ def _device_batch(encs, packables_list, config: SolverConfig):
         # padded batch landed above the pallas-validated bucket — the
         # block-tiled XLA scan is the executor for it (models/ffd.py:117)
         kernel = "xla"
+    use_cost = config.cost_tiebreak and any(
+        p is not None for p in prices_list)
+    prices_arr = None
+    if use_cost:
+        T = totals.shape[1]
+        prices_arr = np.full((shapes.shape[0], T),
+                             np.iinfo(np.int32).max, np.int32)
+        for b, pr in enumerate(prices_list):
+            if pr is not None:
+                prices_arr[b] = encode_prices(pr, T)
     # one transfer for the invariants (tunnel-latency bound, models/ffd.py)
     shapes, totals, reserved0, valid, last_valid, pods_unit = jax.device_put(
         (shapes, totals, reserved0, valid, last_valid, pods_unit))
+    if prices_arr is not None:
+        prices_arr = jax.device_put(prices_arr)
     counts_d, dropped_d = jax.device_put((counts, dropped))
 
     def run(kern):
         return np.asarray(pack_batch_sharded_flat(
             shapes, counts_d, dropped_d, totals, reserved0, valid,
             last_valid, pods_unit, num_iters=L, mesh=mesh,
-            kernel=kern, interpret=kern == "pallas" and not on_tpu))
+            kernel=kern, interpret=kern == "pallas" and not on_tpu,
+            prices=prices_arr, cost_tiebreak=use_cost))
 
     records: List[list] = [[] for _ in range(len(encs))]
     dropped_rows = None

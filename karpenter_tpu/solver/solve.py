@@ -79,14 +79,19 @@ class _DeviceWatchdog:
         """fn() under the deadline; TimeoutError opens the breaker and is
         re-raised (callers fall through their failure rings).
 
-        The deadline is armed from when fn actually STARTS executing, not
-        from submit: the single serialized worker means queue-wait includes
-        any in-flight solve (two overlapping cold compiles from the
-        provisioning and consolidation threads are legitimate), and counting
-        that wait against this call's deadline would spuriously open the
-        breaker with the transport healthy. Queue-wait gets its own equal
-        budget — a worker wedged on a hung transport never starts the next
-        call, and that genuinely is breaker-worthy."""
+        Queue-wait is DEDUCTED from the run budget, with a floor of
+        timeout_s/2: the single serialized worker means queue-wait includes
+        any in-flight solve (overlapping cold compiles from the
+        provisioning and consolidation threads are legitimate), so the run
+        deadline arms only when fn actually STARTS, but the caller-visible
+        latency ceiling drops from 2x timeout_s to 1.5x (advisor r4). The
+        floor is what keeps the breaker honest: it only opens for a run
+        that exceeds a budget no legitimate solve needs (~0.2 s warm,
+        ~40 s cold compile vs a >=60 s floor at defaults) — a call that
+        merely queued long must not arm a sliver of a budget and trip the
+        breaker on a live transport. A call that never starts within the
+        full timeout_s means a wedged worker, which genuinely is
+        breaker-worthy."""
         from concurrent.futures import TimeoutError as FutureTimeout
 
         started = threading.Event()
@@ -95,6 +100,8 @@ class _DeviceWatchdog:
             started.set()
             return fn()
 
+        t_submit = time.monotonic()
+        late_start = False
         future = self._executor().submit(wrapped)
         if not started.wait(timeout=timeout_s):
             # never started: the worker is occupied past a full deadline —
@@ -120,8 +127,17 @@ class _DeviceWatchdog:
                     "occupied) — circuit open for %.0fs (host executors "
                     "answer meanwhile)", timeout_s, breaker_s)
                 raise TimeoutError("device solve watchdog expired in queue")
+            late_start = True
+        # the run budget is what the queue left of timeout_s, floored at
+        # timeout_s/2 (see docstring: the floor prevents queue pressure
+        # from arming a sliver budget that trips the breaker on a live
+        # transport). The cancel-race fallthrough keeps the full budget —
+        # fn began just as the queue budget expired, and the whole point
+        # of that branch is that the worker is healthy.
+        run_budget = timeout_s if late_start else max(
+            timeout_s / 2, timeout_s - (time.monotonic() - t_submit))
         try:
-            result = future.result(timeout=timeout_s)
+            result = future.result(timeout=run_budget)
         except FutureTimeout:
             with self._lock:
                 self._open_until = time.monotonic() + breaker_s
@@ -207,7 +223,7 @@ class SolverConfig:
     # on real TPU). "type-spmd" solves ONE problem across the whole mesh
     # (instance-type axis sharded, in-solve collectives) — for large
     # catalogs / few-schedule windows where the batch axis can't fill the
-    # mesh; cost-tiebreak demotes it to the XLA scan
+    # mesh. All three kernels implement the in-kernel cost tie-break.
     device_kernel: Optional[str] = None
     # below this many pods a device round-trip costs more than it saves
     # (tens of ms over the transport vs sub-ms native solve); the native/

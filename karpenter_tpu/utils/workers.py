@@ -1,0 +1,29 @@
+"""Adaptive worker-pool sizing for the GIL-bound control plane.
+
+The reference sizes concurrency for goroutines (10,000 concurrent selection
+reconciles, selection/controller.go:181). Python threads doing CPU-bound
+reconcile work share one GIL: beyond a few threads per core they add context
+switches, lock contention, and scheduling jitter without adding throughput —
+measured on a 1-core host, 64 selection workers bound 10k pods ~4x slower
+than 8 (driver capture BENCH_r04 config_7 vs the adaptive plane).
+
+The selection controller's non-blocking gate design (controllers/
+selection.py) means workers never park on the batch gate, so the pool only
+needs enough threads to hide the occasional kube I/O wait — not one thread
+per in-flight pod.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def adaptive_workers(requested: int, per_core: int = 8, floor: int = 2) -> int:
+    """Clamp a requested worker count to what the host can actually run.
+
+    ``requested`` is honored on hosts with enough cores (requested/per_core
+    or more); smaller hosts get per_core threads per core — enough to hide
+    I/O waits, few enough to keep GIL churn bounded.
+    """
+    cores = os.cpu_count() or 1
+    return max(floor, min(requested, cores * per_core))

@@ -179,6 +179,34 @@ class TestBatchKernels:
         solve_batch(problems, config=SolverConfig(device_min_pods=10**9))
         assert calls["n"] == len(problems)
 
+    @pytest.mark.parametrize("kernel", ["xla", "pallas"])
+    def test_cost_tiebreak_batch_matches_sequential(self, kernel):
+        """Cost mode through the BATCHED device path: each problem's price
+        row rides into the kernel (per-problem prices under vmap), so
+        batched ≡ sequential in cost mode too. Previously the batch path
+        ignored prices entirely — cost-mode batches silently produced
+        Go-parity packings (r4 verdict weak-item #3, batched leg)."""
+        problems = mixed_problems(seed=5, n=3)
+        # DESCENDING prices invert the first-tie order so cost mode provably
+        # changes the packing — otherwise this passes with prices dropped
+        catalog = problems[0].instance_types
+        for i, it in enumerate(catalog):
+            it.price = 0.1 * (len(catalog) - i)
+        config = SolverConfig(device_min_pods=1, device_kernel=kernel,
+                              cost_tiebreak=True)
+        out = solve_batch(problems, config=config)
+        changed = False
+        for prob, got in zip(problems, out):
+            want = solve(prob.constraints, prob.pods, prob.instance_types,
+                         config=config)
+            assert result_key(got) == result_key(want)
+            plain = solve(prob.constraints, prob.pods, prob.instance_types,
+                          config=SolverConfig(device_min_pods=1,
+                                              device_kernel=kernel))
+            changed = changed or result_key(got) != result_key(plain)
+        assert changed, ("precondition: tiebreak must change at least one "
+                         "packing, or the parity check above is vacuous")
+
     def test_type_spmd_config_demotes_in_batch(self, caplog):
         """device_kernel='type-spmd' is a solo-path axis; the batched path
         must run the per-problem default kernel LOUDLY (review finding:

@@ -77,6 +77,11 @@ class TestTieBreakModes:
                                         prices=prices, cost_tiebreak=True)),
             ("xla", solve_ffd_device(vecs, ids, packables, kernel="xla",
                                      prices=prices, cost_tiebreak=True)),
+            ("pallas", solve_ffd_device(vecs, ids, packables, kernel="pallas",
+                                        prices=prices, cost_tiebreak=True)),
+            ("type-spmd", solve_ffd_device(vecs, ids, packables,
+                                           kernel="type-spmd",
+                                           prices=prices, cost_tiebreak=True)),
         ):
             assert r is not None, name
             got = (r.node_count,
@@ -137,15 +142,29 @@ class TestTieBreakModes:
             cost = host_ffd.pack(vecs, ids, packables,
                                  prices=prices, cost_tiebreak=True)
             ctx = f"case={case}"
-            # quartet agreement in cost mode
-            for name, r in (
-                ("numpy", solve_ffd_numpy(vecs, ids, packables,
-                                          prices=prices, cost_tiebreak=True)),
-                ("native", solve_ffd_native(vecs, ids, packables,
-                                            prices=prices, cost_tiebreak=True)),
-                ("xla", solve_ffd_device(vecs, ids, packables, kernel="xla",
-                                         prices=prices, cost_tiebreak=True)),
-            ):
+            # executor agreement in cost mode (pallas/type-spmd covered on
+            # a rotating subset — interpret-mode pallas is debug-speed, so
+            # running it on all 40 cases would dominate the suite)
+            execs = [
+                ("numpy", lambda: solve_ffd_numpy(
+                    vecs, ids, packables, prices=prices, cost_tiebreak=True)),
+                ("native", lambda: solve_ffd_native(
+                    vecs, ids, packables, prices=prices, cost_tiebreak=True)),
+                ("xla", lambda: solve_ffd_device(
+                    vecs, ids, packables, kernel="xla",
+                    prices=prices, cost_tiebreak=True)),
+            ]
+            if case % 8 == 0:
+                execs += [
+                    ("pallas", lambda: solve_ffd_device(
+                        vecs, ids, packables, kernel="pallas",
+                        prices=prices, cost_tiebreak=True)),
+                    ("type-spmd", lambda: solve_ffd_device(
+                        vecs, ids, packables, kernel="type-spmd",
+                        prices=prices, cost_tiebreak=True)),
+                ]
+            for name, run in execs:
+                r = run()
                 assert r is not None and r.node_count == cost.node_count, \
                     f"{ctx}: {name}"
             assert len(cost.unschedulable) == len(parity.unschedulable), ctx

@@ -43,14 +43,15 @@ from karpenter_tpu.solver.solve import SolverConfig, solve
 N_CASES = int(os.environ.get("KARPENTER_FUZZ_CASES", "150"))
 PALLAS_EVERY = 25          # pallas interpret is debug-speed; sample cases
 TYPE_SHARDED_EVERY = 20    # SPMD path recompiles per bucket pair; sample
+COST_EVERY = 10            # cost-mode differential on a sampled subset
 
 
-def _type_sharded_signature(vecs, ids, packables):
+def _type_sharded_signature(vecs, ids, packables, prices=None):
     """Full result signature from the type-axis SPMD kernel on the 8-device
     CPU mesh, or None when the case doesn't fit one chunk (skip)."""
     import numpy as np
 
-    from karpenter_tpu.models.ffd import _decode, device_args
+    from karpenter_tpu.models.ffd import _decode, device_args, encode_prices
     from karpenter_tpu.ops.pack import unpack_flat
     from karpenter_tpu.parallel.type_sharded import (
         pack_chunk_type_sharded, type_mesh,
@@ -62,8 +63,12 @@ def _type_sharded_signature(vecs, ids, packables):
         return None
     L = 128
     mesh = type_mesh(cpu_mesh_devices(8))
+    kw = {}
+    if prices is not None:
+        kw = dict(prices=encode_prices(prices, enc.totals.shape[0]),
+                  cost_tiebreak=True)
     buf = np.asarray(pack_chunk_type_sharded(
-        *device_args(enc), num_iters=L, mesh=mesh))
+        *device_args(enc), num_iters=L, mesh=mesh, **kw))
     _, dropped_f, done, chosen, q, packed = unpack_flat(
         buf, enc.shapes.shape[0], L)
     if not done:
@@ -167,6 +172,9 @@ class TestExecutorQuartetFuzz:
         compared = 0
         pallas_checked = 0
         type_sharded_checked = 0
+        cost_checked = 0
+        cost_pallas_checked = 0
+        cost_ts_checked = 0
         for case in range(N_CASES):
             catalog = _random_catalog(rng)
             pods = _random_pods(rng)
@@ -215,10 +223,54 @@ class TestExecutorQuartetFuzz:
                         f"{ctx}: type-sharded SPMD"
                     type_sharded_checked += 1
 
+            # cost-mode differential: the in-kernel cheapest-tie semantics
+            # must agree across every executor that claims it (VERDICT r4
+            # item 2 — quintet fuzz extended to cost-aware cases)
+            want_cost = (case % COST_EVERY == 0
+                         or (cost_pallas_checked < 3 and len(pods) <= 80)
+                         or cost_ts_checked < 3)
+            if want_cost:
+                prices = [sorted_types[p.index].price for p in packables]
+                cost_oracle = host_ffd.pack(vecs, ids, packables,
+                                            prices=prices, cost_tiebreak=True)
+                cost_sig = _signature(cost_oracle, vecs)
+                for name, result in (
+                    ("numpy-cost", solve_ffd_numpy(
+                        vecs, ids, packables,
+                        prices=prices, cost_tiebreak=True)),
+                    ("native-cost", solve_ffd_native(
+                        vecs, ids, packables,
+                        prices=prices, cost_tiebreak=True)),
+                    ("xla-cost", solve_ffd_device(
+                        vecs, ids, packables, kernel="xla",
+                        prices=prices, cost_tiebreak=True)),
+                ):
+                    assert result is not None, f"{ctx}: {name} returned None"
+                    assert _signature(result, vecs) == cost_sig, \
+                        f"{ctx}: {name}"
+                cost_checked += 1
+                if cost_pallas_checked < 3 and len(pods) <= 80:
+                    result = solve_ffd_device(
+                        vecs, ids, packables, kernel="pallas",
+                        prices=prices, cost_tiebreak=True)
+                    assert result is not None, f"{ctx}: pallas-cost None"
+                    assert _signature(result, vecs) == cost_sig, \
+                        f"{ctx}: pallas-cost"
+                    cost_pallas_checked += 1
+                if cost_ts_checked < 3:
+                    ts_result = _type_sharded_signature(
+                        vecs, ids, packables, prices=prices)
+                    if ts_result is not None:
+                        assert _signature(ts_result, vecs) == cost_sig, \
+                            f"{ctx}: type-sharded-cost"
+                        cost_ts_checked += 1
+
         rate = encode_fallbacks / N_CASES
         print(f"\nfuzz summary: {N_CASES} cases, {compared} quartet-compared, "
               f"{pallas_checked} pallas-checked, "
               f"{type_sharded_checked} type-sharded-checked, "
+              f"{cost_checked} cost-compared "
+              f"({cost_pallas_checked} pallas, {cost_ts_checked} type-spmd), "
               f"encode-fallback rate {rate:.1%}")
         # the adversarial mix is tuned to exercise BOTH paths: most cases
         # must reach the device executors, and the boundary cases must
@@ -229,6 +281,8 @@ class TestExecutorQuartetFuzz:
             "adversarial pools need retuning")
         assert pallas_checked >= 3
         assert type_sharded_checked >= 3
+        assert cost_checked >= 5
+        assert cost_pallas_checked >= 3 and cost_ts_checked >= 3
 
 
 class TestEncodeBoundaryPinned:

@@ -144,15 +144,16 @@ class TestTypeSpmdSolvePath:
         assert got.node_count == want.node_count
         assert not got.unschedulable
 
-    def test_cost_tiebreak_demotes_to_xla(self):
-        """The in-kernel cost tie-break lives in the XLA scan; type-spmd
-        with tiebreak must demote rather than silently ignore prices."""
+    def test_cost_tiebreak_in_kernel(self):
+        """The in-kernel cost tie-break runs INSIDE the type-sharded kernel
+        (one extra pmin per node decision) — no demotion to the XLA scan —
+        and must produce the identical cost-ordered packing."""
         from karpenter_tpu.solver.solve import SolverConfig, solve
 
         catalog, constraints, pods = self._problem()
         # DESCENDING prices invert the default first-tie order, so the
         # cost-tiebreak result provably differs from the no-cost result —
-        # otherwise this test passes even with the demotion deleted
+        # otherwise this test passes even with the tie-break deleted
         for i, it in enumerate(catalog):
             it.price = 0.1 * (len(catalog) - i)
         key = lambda r: sorted(
@@ -164,8 +165,38 @@ class TestTypeSpmdSolvePath:
             device_min_pods=1, device_kernel="xla", cost_tiebreak=False))
         assert key(want) != key(plain), (
             "precondition: tiebreak must change the packing for this "
-            "problem, or the demotion check below is vacuous")
+            "problem, or the equivalence check below is vacuous")
         got = solve(constraints, pods, catalog, config=SolverConfig(
             device_min_pods=1, device_kernel="type-spmd",
             cost_tiebreak=True))
         assert key(got) == key(want)
+
+    def test_cost_tiebreak_record_stream_identical(self):
+        """Raw-kernel differential in cost mode: the sharded kernel's full
+        record stream (chosen/q/packed) must match the single-device XLA
+        scan bit-for-bit when both apply the same price vector."""
+        from karpenter_tpu.models.ffd import encode_prices
+
+        catalog = instance_types(16)
+        for i, it in enumerate(catalog):
+            it.price = 0.1 * (len(catalog) - i)  # descending: inverts ties
+        pods = [unschedulable_pod(requests={
+            "cpu": f"{(i % 7 + 1) * 250}m",
+            "memory": f"{(i % 5 + 1) * 256}Mi"}) for i in range(250)]
+        constraints = universe_constraints(catalog)
+        packables, sorted_types = build_packables(catalog, constraints,
+                                                  pods, [])
+        vecs = pod_vectors(pods)
+        enc = encode(vecs, list(range(len(pods))), packables)
+        assert enc is not None
+        prices = encode_prices(
+            [sorted_types[p.index].price for p in packables],
+            enc.totals.shape[0])
+        mesh = type_mesh(cpu_mesh_devices(8))
+        args = device_args(enc)
+        sharded = np.asarray(pack_chunk_type_sharded(
+            *args, num_iters=L, mesh=mesh, prices=prices,
+            cost_tiebreak=True))
+        single = np.asarray(pack_chunk_flat(
+            *args, num_iters=L, prices=prices, cost_tiebreak=True))
+        np.testing.assert_array_equal(sharded, single)

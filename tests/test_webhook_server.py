@@ -64,7 +64,12 @@ class TestCodec:
         assert p.spec.constraints.provider == {"instanceProfile": "karpenter-node"}
         assert p.spec.ttl_seconds_after_empty == 30
         assert str(p.spec.limits.resources["cpu"]) == "1000"
-        assert provisioner_to_manifest(p) == MANIFEST
+        # status is always emitted, even empty — _merge's removal contract
+        # ("owned fields always present") requires it (advisor r4); the
+        # defaulting webhook's /spec-only patch filter keeps user manifests
+        # untouched by this
+        assert provisioner_to_manifest(p) == {
+            **MANIFEST, "status": {"conditions": [], "resources": {}}}
 
     def test_empty_spec(self):
         p = provisioner_from_manifest({"metadata": {"name": "bare"}})
