@@ -21,6 +21,10 @@ from karpenter_tpu.solver.host_ffd import (
 DEFAULT_CHUNK_ITERS = 64
 MAX_CHUNKS = 4096  # hard safety valve; each iteration provably makes progress
 _INT32_MAX = 2**31 - 1
+# above this many record-buffer elements (L x S) the chunk loop switches to
+# the pipelined device-resident-carry path: the fetch is bandwidth-bound
+# over the tunnel (~45 MB/s measured) and overlaps the next chunk's compute
+_PIPELINE_ELEMS = 1 << 20
 
 
 def device_args(enc: EncodedProblem):
@@ -77,6 +81,7 @@ def solve_ffd_device(
     max_shapes: Optional[int] = None,  # decline above this cardinality
     enc: Optional[EncodedProblem] = None,  # precomputed (possibly unpadded)
     pallas_max_shapes: int = 8192,  # pallas-validated bucket ceiling
+    hedge: bool = True,  # tail-mitigating second fetch (solver/hedge.py)
 ) -> Optional[HostSolveResult]:
     """Solve on device; None when the problem is not device-encodable
     (caller falls back to the host oracle). Pods may arrive unsorted; the
@@ -121,6 +126,15 @@ def solve_ffd_device(
         # buckets (SolverConfig.pallas_max_shapes); the block-tiled XLA
         # scan is the executor built for anything above
         kernel = "xla"
+    if kernel == "pallas":
+        from karpenter_tpu.ops.pack_pallas import DIV_CAP
+
+        if int(enc.counts.max(initial=0)) >= DIV_CAP - 4:
+            # the pallas kernel's exact float32 division is valid while
+            # per-shape pod counts stay below DIV_CAP; the batcher guards
+            # batches at 100k pods so this is unreachable in production —
+            # routed to the XLA scan if it ever happens
+            kernel = "xla"
     use_cost = cost_tiebreak and prices is not None
     prices_dev = None
     if use_cost:
@@ -167,23 +181,72 @@ def solve_ffd_device(
     dev = jax.device_put(device_args(enc))
     shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit = dev
 
+    # the per-chunk dispatch+fetch, optionally hedged: tunnel jitter puts
+    # occasional >200 ms spikes on an otherwise ~72 ms RTT-bound leg; the
+    # hedger re-issues the (deterministic) chunk when a fetch overruns its
+    # own recent wall time and takes whichever lands first
+    hedge_key = (kernel, S, enc.totals.shape[0], chunk_iters, use_cost)
+
+    def fetch_chunk(counts_now, dropped_now):
+        def dispatch():
+            return np.asarray(_chunk(
+                shapes, counts_now, dropped_now, totals, reserved0, valid,
+                last_valid, pods_unit, num_iters=chunk_iters))
+
+        if not hedge:
+            return dispatch()
+        from karpenter_tpu.solver.hedge import FETCHER
+
+        return FETCHER.fetch(hedge_key, dispatch)
+
     records = []  # (chosen, qty, packed-vector)
     dropped_h = None
-    for _ in range(MAX_CHUNKS):
-        buf = _chunk(
-            shapes, counts, dropped, totals, reserved0, valid, last_valid,
-            pods_unit, num_iters=chunk_iters)
-        # one device→host fetch per chunk; typical solves need one chunk
-        counts_h, dropped_h, done, chosen_h, q_h, packed_h = unpack_flat(
-            np.asarray(buf), S, L)
-        for i in range(L):
-            if q_h[i] > 0:
-                records.append((int(chosen_h[i]), int(q_h[i]), packed_h[i]))
-        if done:
-            break
-        counts, dropped = jax.device_put((counts_h, dropped_h))
+    if S * L >= _PIPELINE_ELEMS:
+        # High-cardinality regime: the (L, S) record buffer is megabytes
+        # and the tunnel moves ~45 MB/s, so the fetch — not the kernel —
+        # bounds the wall time. Pipeline: keep the counts/dropped carry
+        # DEVICE-RESIDENT (sliced from the flat buffer, no host round-trip
+        # between chunks), speculatively dispatch chunk n+1, and overlap
+        # its compute with chunk n's async copy-out. A speculatively
+        # dispatched chunk after `done` is a no-op (the kernel's while
+        # loop exits immediately) and is never fetched. Hedging does not
+        # apply here — these fetches are bandwidth-bound, not jitter-bound
+        # (solver/hedge.py MAX_HEDGEABLE_WALL_S).
+        buf = _chunk(shapes, counts, dropped, totals, reserved0, valid,
+                     last_valid, pods_unit, num_iters=chunk_iters)
+        for _ in range(MAX_CHUNKS):
+            try:
+                buf.copy_to_host_async()
+            except Exception:
+                pass  # fetch below still works, just unoverlapped
+            next_buf = _chunk(
+                shapes, buf[:S], buf[S:2 * S], totals, reserved0, valid,
+                last_valid, pods_unit, num_iters=chunk_iters)
+            counts_h, dropped_h, done, chosen_h, q_h, packed_h = unpack_flat(
+                np.asarray(buf), S, L)
+            for i in range(L):
+                if q_h[i] > 0:
+                    records.append(
+                        (int(chosen_h[i]), int(q_h[i]), packed_h[i]))
+            if done:
+                break
+            buf = next_buf
+        else:
+            return None  # did not converge — impossible by construction
     else:
-        return None  # did not converge — impossible by construction, but safe
+        for _ in range(MAX_CHUNKS):
+            # one device→host fetch per chunk; typical solves need one chunk
+            counts_h, dropped_h, done, chosen_h, q_h, packed_h = unpack_flat(
+                fetch_chunk(counts, dropped), S, L)
+            for i in range(L):
+                if q_h[i] > 0:
+                    records.append(
+                        (int(chosen_h[i]), int(q_h[i]), packed_h[i]))
+            if done:
+                break
+            counts, dropped = jax.device_put((counts_h, dropped_h))
+        else:
+            return None  # did not converge — impossible by construction
 
     return _decode(enc, records, dropped_h, packables, max_instance_types)
 

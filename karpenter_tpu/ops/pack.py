@@ -31,6 +31,26 @@ from karpenter_tpu.solver.host_ffd import R_PODS
 INT32_MAX = jnp.iinfo(jnp.int32).max
 
 
+def compute_maxfit(shapes, totals, reserved0, valid):
+    """Per-shape upper bound on any valid type's capacity fit from the
+    initial reservation — the fast-forward validity bound (docs/solver.md).
+    Shared by the XLA scan and the pallas wrapper (the pallas kernel takes
+    it as an INPUT: computing it in-kernel was an O(R·S²) masked-reduction
+    loop, the dominant fixed cost at the 8192-shape bucket). Computed with
+    an unrolled loop over R so peak memory is (S, T), never (S, T, R) —
+    at the 8192-shape bucket the dense intermediate would be ~270 MB."""
+    S = shapes.shape[0]
+    T = totals.shape[0]
+    avail0 = totals - reserved0  # (T, R)
+    kfit0 = jnp.full((S, T), INT32_MAX, jnp.int32)
+    for r in range(shapes.shape[1]):
+        col = shapes[:, r][:, None]  # (S, 1)
+        kr_r = jnp.where(col > 0, avail0[None, :, r] // jnp.maximum(col, 1),
+                         INT32_MAX)
+        kfit0 = jnp.minimum(kfit0, kr_r)
+    return jnp.max(jnp.where(valid[None, :], kfit0, -1), axis=1)  # (S,)
+
+
 @functools.partial(jax.jit, static_argnames=("num_iters", "cost_tiebreak"))
 def pack_chunk(
     shapes: jax.Array,     # (S, R) int32, descending, reserve semantics
@@ -60,17 +80,8 @@ def pack_chunk(
     # Upper bound on any type's capacity fit per shape, from the initial
     # reservation (reserved only grows during a node pack). Fast-forward
     # validity needs counts to stay STRICTLY above this on every repeated
-    # round — see the derivation in docs/solver.md. Computed with an
-    # unrolled loop over R so peak memory is (S, T), never (S, T, R) —
-    # at the 8192-shape bucket the dense intermediate would be ~270 MB.
-    avail0 = totals - reserved0  # (T, R)
-    kfit0 = jnp.full((S, T), INT32_MAX, jnp.int32)
-    for r in range(R):
-        col = shapes[:, r][:, None]  # (S, 1)
-        kr_r = jnp.where(col > 0, avail0[None, :, r] // jnp.maximum(col, 1),
-                         INT32_MAX)
-        kfit0 = jnp.minimum(kfit0, kr_r)
-    maxfit = jnp.max(jnp.where(valid[None, :], kfit0, -1), axis=1)  # (S,)
+    # round — see the derivation in docs/solver.md.
+    maxfit = compute_maxfit(shapes, totals, reserved0, valid)  # (S,)
 
     # Block-tile the sequential shape axis: scan over S/B blocks with B
     # steps unrolled inside each. Semantics are identical (the shapes are

@@ -8,10 +8,25 @@ stop flags, per-shape counts — resident in VMEM for the entire solve and
 exits the node loop the moment the problem is done (a `while_loop`, not a
 fixed-length scan), so converged problems don't pay for dead iterations.
 
-Layout is TPU-native: capacity tensors are stored transposed (R, T) /
-(R, S) so the resource axis (R = 8) sits on sublanes and the wide
-type/shape axes on lanes; the per-shape fit `min_r floor(avail/shape)` is a
-sublane reduction of an (R, T) VPU op.
+Layout is TPU-native and BLOCKED on the shape axis: shapes live as
+(n_b, R, B) with B = 128 lanes per block, so the sequential shape walk
+loads one block with a dynamic leading index (a cheap VMEM copy) and then
+addresses individual shapes with STATIC lane slices — free at compile
+time. Mosaic has no dynamic slices on the lane axis, and the previous
+formulation worked around that with a masked O(R·S) reduction per shape
+step: at the 8192-shape bucket that made every node decision an O(R·S²)
+sweep (~0.5 G lane-ops) and the whole solve ~9.5 s. Three structural
+changes remove it:
+
+- blocked shape walk: per-step shape access is O(R) (static lane slice)
+  plus one O(R·B) block load per 128 steps;
+- the fast-forward bound (maxfit) arrives as an INPUT, computed by XLA in
+  the jitted wrapper (ops.pack.compute_maxfit) — in-kernel it was an
+  O(R·S²) masked loop that dominated the fixed cost;
+- early exit: the per-node fill walk stops at the first block where every
+  candidate type is stopped (exact — stopped types never unstop within a
+  node decision), so a node that fills after a few hundred shapes does not
+  walk all 8192.
 
 Semantics are bit-identical to ops.pack.pack_chunk for every committed
 node record (chosen, q, packed) and for counts/dropped/done — enforced by
@@ -32,41 +47,84 @@ from karpenter_tpu.solver.host_ffd import R_PODS
 
 INT32_MAX = jnp.iinfo(jnp.int32).max
 
+LANE_BLOCK = 128  # shape-axis block width (one full lane register)
+
+# The VPU has no native integer divide: a plain int32 `//` lowers to a long
+# software sequence that dominated this kernel (measured ~75% of the
+# 8192-bucket walk). The solver's divisions only need EXACT results while
+# the quotient is small — a capacity fit is consumed through
+# clip(kfit, 0, count) and a fast-forward term through 1 + min(terms), and
+# both count and terms are bounded by the pod count (the batcher guards at
+# 100k, models/ffd.py re-checks) — so quotients are computed in float32
+# with exact integer correction rounds, valid for true quotients
+# < DIV_CAP-2 and monotonically clamped ABOVE count beyond that
+# (behaviorally identical through the clip). Error analysis: q <= DIV_CAP
+# keeps the f32 relative error (~3·2^-24) well under 0.05 absolute, BUT
+# input rounding can cross an integer boundary in EITHER direction (e.g.
+# a=33558527, b=4096: f32(a)=33558528 gives an exact qf of 8193.0, one
+# above the true floor 8192 — caught in review r5), so the estimate may be
+# off by one either way. One downward and two upward correction rounds
+# restore exactness; the remainder test is wrap-safe because with
+# q <= q_true+1 the true remainder lies in (-2^31, 2^31), so int32 modular
+# arithmetic reproduces it exactly and its SIGN detects the overshoot.
+DIV_CAP = 1 << 18
+
+
+def _floordiv_small(a, b):
+    """floor(a/b) for b >= 1: exact while the true quotient < DIV_CAP-2,
+    clamped (monotone, >= DIV_CAP-2) above. Negative ``a`` returns a value
+    <= 0 — the clip consumers treat it identically to the true negative
+    floor."""
+    qf = a.astype(jnp.float32) / b.astype(jnp.float32)
+    q = jnp.minimum(qf, jnp.float32(DIV_CAP)).astype(jnp.int32)
+    q = jnp.maximum(q, 0)
+    # exact by modular arithmetic (see note above); r < 0 means the float
+    # estimate overshot the floor by one — correct DOWN first
+    r = a - q * b
+    dec = (r < 0).astype(jnp.int32)
+    q = q - dec
+    r = r + dec * b
+    inc = (r >= b).astype(jnp.int32)
+    q = q + inc
+    r = r - inc * b
+    q = q + (r >= b).astype(jnp.int32)
+    return q
+
 
 def _pack_kernel(
     # inputs
-    shapes_t,     # (R, S) int32, reserve semantics, descending shapes
-    counts_in,    # (1, S) int32
-    dropped_in,   # (1, S) int32
+    shapes_b,     # (n_b, R, B) int32, reserve semantics, descending shapes
+    counts_in,    # (n_b, 1, B) int32
+    dropped_in,   # (n_b, 1, B) int32
     totals_t,     # (R, T) int32
     reserved0_t,  # (R, T) int32
     valid,        # (1, T) int32 (0/1)
     prices_in,    # (1, T) int32 effective micro-$/h (cost_tiebreak only)
+    maxfit_in,    # (n_b, 1, B) int32 fast-forward bound (wrapper-computed)
     lastv,        # (1, 1) int32 SMEM — index of largest viable type
     pods_unit,    # (1, 1) int32 SMEM — one pod in device units
     # outputs
-    counts_out,   # (1, S)
-    dropped_out,  # (1, S)
+    counts_out,   # (n_b, 1, B)
+    dropped_out,  # (n_b, 1, B)
     done_out,     # (1, 1) SMEM
     chosen_out,   # (1, L)
     q_out,        # (1, L)
-    packed_out,   # (L, S)
+    packed_out,   # (n_b, L, B)
     # scratch
     resv,         # (R, T) VMEM
     stopped,      # (1, T) VMEM int32
     npacked,      # (1, T) VMEM int32
-    maxfit,       # (1, S) VMEM int32
-    packedv_s,    # (1, S) VMEM int32
+    packedv_s,    # (n_b, 1, B) VMEM int32
     *,
     cost_tiebreak: bool,
 ):
-    R, S = shapes_t.shape
+    n_b, R, B = shapes_b.shape
     T = totals_t.shape[1]
     L = q_out.shape[1]
 
-    # Mosaic has no dynamic slices/loads on the lane (last) axis; columns
-    # and scalars at runtime-computed lane indices are extracted by masked
-    # reduction instead (a full-width VPU op — cheap at these sizes).
+    # lane-axis columns/scalars at RUNTIME-computed indices are extracted by
+    # masked reduction (no dynamic lane slices in Mosaic). In this blocked
+    # formulation these run once per NODE DECISION, never per shape step.
     def lane_col(mat, iota, idx):
         """mat (R, N)[:, idx] → (R, 1) without a dynamic lane slice."""
         return jnp.sum(jnp.where(iota == idx, mat, 0), axis=1, keepdims=True)
@@ -79,26 +137,14 @@ def _pack_kernel(
     dropped_out[:] = dropped_in[:]
     chosen_out[:] = jnp.full((1, L), -1, jnp.int32)
     q_out[:] = jnp.zeros((1, L), jnp.int32)
-    packed_out[:] = jnp.zeros((L, S), jnp.int32)
+    packed_out[:] = jnp.zeros((n_b, L, B), jnp.int32)
 
-    iota_s = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
     iota_t = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+    # global shape index per blocked element: [b, 0, j] → b*B + j
+    giota = (jax.lax.broadcasted_iota(jnp.int32, (n_b, 1, B), 0) * B
+             + jax.lax.broadcasted_iota(jnp.int32, (n_b, 1, B), 2))
     valid_b = valid[:] != 0
-    avail0 = totals_t[:] - reserved0_t[:]          # (R, T)
-
-    # maxfit_s = max over valid types of the capacity-bound fit from the
-    # initial reservation (fast-forward validity bound — docs/solver.md)
-    def maxfit_body(s, _):
-        shape_col = lane_col(shapes_t[:], iota_s, s)   # (R, 1)
-        kr = jnp.where(shape_col > 0,
-                       avail0 // jnp.maximum(shape_col, 1), INT32_MAX)
-        kfit = jnp.min(kr, axis=0, keepdims=True)  # (1, T)
-        best = jnp.max(jnp.where(valid_b, kfit, -1))
-        # masked row store — Mosaic has no scalar VMEM stores
-        maxfit[:] = jnp.where(iota_s == s, best, maxfit[:])
-        return 0
-
-    jax.lax.fori_loop(0, S, maxfit_body, 0)
 
     pods_one = jnp.where(
         jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0) == R_PODS,
@@ -106,29 +152,40 @@ def _pack_kernel(
 
     def node_iter(carry):
         it, _ = carry
-        counts = counts_out[:]                     # (1, S)
+        counts = counts_out[:]                     # (n_b, 1, B)
         has = counts > 0
-        largest_idx = jnp.min(jnp.where(has, iota_s, INT32_MAX))
-        smallest_idx = jnp.max(jnp.where(has, iota_s, -1))
+        largest_idx = jnp.min(jnp.where(has, giota, INT32_MAX))
+        smallest_idx = jnp.max(jnp.where(has, giota, -1))
         # fits() uses raw requests (no implicit pods:1) — packable.go:118,146
+        s_blk = shapes_b[pl.ds(smallest_idx // B, 1)][0]       # (R, B)
         smallest_fits = jnp.maximum(
-            lane_col(shapes_t[:], iota_s, smallest_idx) - pods_one, 0)  # (R, 1)
+            lane_col(s_blk, iota_b, smallest_idx % B) - pods_one, 0)
 
-        # pass 1: greedy-fill every candidate type at once (VPU over T)
+        # pass 1: greedy-fill every candidate type at once (VPU over T).
+        # Walk shapes block-by-block; stop at the first block boundary
+        # where no type remains active (exact: stopped never clears).
         resv[:] = reserved0_t[:]
         stopped[:] = jnp.where(valid_b, 0, 1).astype(jnp.int32)
         npacked[:] = jnp.zeros((1, T), jnp.int32)
 
-        def shape_step(s, _):
-            count = lane_scalar(counts_out[:], iota_s, s)
-
-            @pl.when(count > 0)
-            def _():
-                shape_col = lane_col(shapes_t[:], iota_s, s)  # (R, 1)
-                active = stopped[:] == 0                      # (1, T)
+        def fill_block(carry2):
+            b, _ = carry2
+            sh_blk = shapes_b[pl.ds(b, 1)][0]      # (R, B)
+            cnt_blk = counts_out[pl.ds(b, 1)][0]   # (1, B)
+            for j in range(B):                     # static lane indices
+                # BRANCHLESS step: the per-shape count stays a (1, 1)
+                # vector (no vector→scalar transfer, no pl.when branch) —
+                # per-step scalar extraction and branching dominated the
+                # 8192-bucket walk. count == 0 degrades to a no-op through
+                # the masks (k = 0 everywhere).
+                count = cnt_blk[:, j:j + 1]                   # (1, 1)
+                shape_col = sh_blk[:, j:j + 1]                # (R, 1)
+                active = (stopped[:] == 0) & (count > 0)      # (1, T)
                 avail = totals_t[:] - resv[:]
                 kr = jnp.where(shape_col > 0,
-                               avail // jnp.maximum(shape_col, 1), INT32_MAX)
+                               _floordiv_small(
+                                   avail, jnp.maximum(shape_col, 1)),
+                               INT32_MAX)
                 kfit = jnp.min(kr, axis=0, keepdims=True)     # (1, T)
                 k = jnp.where(active, jnp.clip(kfit, 0, count), 0)
                 failure = active & (k < count)
@@ -142,9 +199,17 @@ def _pack_kernel(
                 npacked[:] = new_np
                 stopped[:] = jnp.where(
                     failure & (full | (new_np == 0)), 1, stopped[:])
-            return 0
+            return b + 1, jnp.any(stopped[:] == 0)
 
-        jax.lax.fori_loop(0, S, shape_step, 0)
+        # start at the first block holding a remaining shape: shapes are
+        # consumed in descending order, so late node decisions would
+        # otherwise trudge through thousands of already-empty leading lanes
+        # (count 0 → branchless no-ops, but real cycles). largest_idx IS
+        # the first remaining shape. Exact: skipped blocks are all-zero.
+        first_b = largest_idx // B
+        jax.lax.while_loop(
+            lambda c: (c[0] < n_b) & c[1],
+            fill_block, (first_b, jnp.any(stopped[:] == 0)))
 
         max_pods = lane_scalar(npacked[:], iota_t, lastv[0, 0])
         tie = valid_b & (npacked[:] == max_pods)
@@ -161,45 +226,66 @@ def _pack_kernel(
 
         # pass 2: replay the chosen type's column alone to recover its
         # per-shape pack vector (each type's fill is independent, so the
-        # replay is exact) — avoids materializing the (S, T) k matrix
+        # replay is exact) — avoids materializing the (S, T) k matrix.
+        # All per-step math here is (R, 1)-sized; the walk early-exits the
+        # moment the replayed type stops (its k is 0 ever after — exact,
+        # and packedv_s is pre-zeroed).
         totals_col = lane_col(totals_t[:], iota_t, chosen)    # (R, 1)
         resv0_col = lane_col(reserved0_t[:], iota_t, chosen)
+        packedv_s[:] = jnp.zeros((n_b, 1, B), jnp.int32)
 
-        def replay_step(s, carry2):
-            resv_col, stopped_c, npacked_c = carry2
-            count = lane_scalar(counts_out[:], iota_s, s)
-            shape_col = lane_col(shapes_t[:], iota_s, s)
-            active = (count > 0) & (stopped_c == 0)
-            avail = totals_col - resv_col
-            kr = jnp.where(shape_col > 0,
-                           avail // jnp.maximum(shape_col, 1), INT32_MAX)
-            kfit = jnp.min(kr)
-            k = jnp.where(active, jnp.clip(kfit, 0, count), 0)
-            failure = active & (k < count)
-            resv_col = resv_col + k * shape_col
-            full = jnp.any((totals_col > 0) &
-                           (resv_col + smallest_fits >= totals_col))
-            npacked_c = npacked_c + k
-            stopped_c = jnp.where(failure & (full | (npacked_c == 0)),
-                                  1, stopped_c)
-            packedv_s[:] = jnp.where(iota_s == s, k, packedv_s[:])
-            return resv_col, stopped_c, npacked_c
+        def replay_block(carry2):
+            b, resv_col, stopped_c, npacked_c = carry2
+            sh_blk = shapes_b[pl.ds(b, 1)][0]      # (R, B)
+            cnt_blk = counts_out[pl.ds(b, 1)][0]   # (1, B)
+            kblk = jnp.zeros((1, B), jnp.int32)
+            for j in range(B):
+                # branchless, all-(1,1)/(R,1) math — see fill_block
+                count = cnt_blk[:, j:j + 1]                   # (1, 1)
+                shape_col = sh_blk[:, j:j + 1]                # (R, 1)
+                active = (count > 0) & (stopped_c == 0)       # (1, 1)
+                avail = totals_col - resv_col
+                kr = jnp.where(shape_col > 0,
+                               _floordiv_small(
+                                   avail, jnp.maximum(shape_col, 1)),
+                               INT32_MAX)
+                kfit = jnp.min(kr, axis=0, keepdims=True)     # (1, 1)
+                k = jnp.where(active, jnp.clip(kfit, 0, count), 0)
+                failure = active & (k < count)
+                resv_col = resv_col + k * shape_col
+                full = jnp.any((totals_col > 0) &
+                               (resv_col + smallest_fits >= totals_col),
+                               axis=0, keepdims=True)         # (1, 1)
+                npacked_c = npacked_c + k
+                stopped_c = jnp.where(failure & (full | (npacked_c == 0)),
+                                      1, stopped_c)
+                kblk = jnp.where(iota_b == j, k, kblk)  # static mask: free
+            packedv_s[pl.ds(b, 1)] = kblk.reshape(1, 1, B)
+            return b + 1, resv_col, stopped_c, npacked_c
 
-        jax.lax.fori_loop(
-            0, S, replay_step,
-            (resv0_col, jnp.int32(0), jnp.int32(0)))
+        jax.lax.while_loop(
+            lambda c: (c[0] < n_b) & jnp.all(c[2] == 0),
+            replay_block,
+            (first_b, resv0_col, jnp.zeros((1, 1), jnp.int32),
+             jnp.zeros((1, 1), jnp.int32)))
 
-        packed = packedv_s[:]                                 # (1, S)
+        packed = packedv_s[:]                                 # (n_b, 1, B)
         # exact fast-forward (ops/pack.py, proof in docs/solver.md): every
-        # packed shape must stay STRICTLY above maxfit through all repeats
-        terms = jnp.where(packed > 0,
-                          (counts - maxfit[:] - 1) // jnp.maximum(packed, 1),
-                          INT32_MAX)
+        # packed shape must stay STRICTLY above maxfit through all repeats.
+        # Negative numerators (count already at/below the bound) must yield
+        # a negative term so q stays 1 — _floordiv_small returns 0 for
+        # them, hence the explicit -1 branch.
+        numer = counts - maxfit_in[:] - 1
+        terms = jnp.where(
+            packed > 0,
+            jnp.where(numer < 0, -1,
+                      _floordiv_small(numer, jnp.maximum(packed, 1))),
+            INT32_MAX)
         q = jnp.maximum(1, 1 + jnp.min(terms))
         q = jnp.where(nothing, 0, q)
 
         # drop path: the largest remaining shape fits nowhere
-        drop_vec = jnp.where(nothing & (iota_s == largest_idx), counts, 0)
+        drop_vec = jnp.where(nothing & (giota == largest_idx), counts, 0)
 
         new_counts = counts - q * packed - drop_vec
         counts_out[:] = new_counts
@@ -210,7 +296,13 @@ def _pack_kernel(
             iota_l = jax.lax.broadcasted_iota(jnp.int32, (1, L), 1)
             chosen_out[:] = jnp.where(iota_l == it, chosen, chosen_out[:])
             q_out[:] = jnp.where(iota_l == it, q, q_out[:])
-            packed_out[pl.ds(it, 1), :] = packed
+
+            def store(b, _):
+                packed_out[pl.ds(b, 1), pl.ds(it, 1), :] = (
+                    packedv_s[pl.ds(b, 1)])
+                return 0
+
+            jax.lax.fori_loop(0, n_b, store, 0)
 
         done = jnp.logical_not(jnp.any(new_counts > 0))
         return it + 1, done
@@ -241,33 +333,46 @@ def pack_chunk_pallas(
     """Same contract as ops.pack.pack_chunk (up to the junk-row caveat:
     iterations past `done` or with q == 0 report chosen=-1/q=0/packed=0
     here, while the scan version reports stale values — callers only
-    consume q > 0 rows). Transposes at the boundary; the kernel runs in
-    the (R, lanes) layout. ``cost_tiebreak`` matches ops.pack.pack_chunk:
+    consume q > 0 rows). Re-layouts at the boundary (XLA-side, cheap): the
+    kernel runs blocked (n_b, R, B) on the shape axis and (R, lanes) for
+    capacity tensors. ``cost_tiebreak`` matches ops.pack.pack_chunk:
     cheapest max-pods type wins, capacity order breaks price ties."""
+    from karpenter_tpu.ops.pack import compute_maxfit
+
     S, R = shapes.shape
     T = totals.shape[0]
     L = num_iters
+    B = min(S, LANE_BLOCK)
+    assert S % B == 0, f"shape bucket {S} not a multiple of {B}"
+    n_b = S // B
     if prices is None:
         prices = jnp.zeros((T,), jnp.int32)
+
+    shapes32 = shapes.astype(jnp.int32)
+    # [b, r, j] = shapes[b*B + j, r]
+    shapes_blocked = shapes32.T.reshape(R, n_b, B).transpose(1, 0, 2)
+    maxfit = compute_maxfit(shapes32, totals.astype(jnp.int32),
+                            reserved0.astype(jnp.int32), valid)
 
     outs = pl.pallas_call(
         functools.partial(_pack_kernel, cost_tiebreak=cost_tiebreak),
         out_shape=(
-            jax.ShapeDtypeStruct((1, S), jnp.int32),   # counts
-            jax.ShapeDtypeStruct((1, S), jnp.int32),   # dropped
-            jax.ShapeDtypeStruct((1, 1), jnp.int32),   # done
-            jax.ShapeDtypeStruct((1, L), jnp.int32),   # chosen
-            jax.ShapeDtypeStruct((1, L), jnp.int32),   # q
-            jax.ShapeDtypeStruct((L, S), jnp.int32),   # packed
+            jax.ShapeDtypeStruct((n_b, 1, B), jnp.int32),   # counts
+            jax.ShapeDtypeStruct((n_b, 1, B), jnp.int32),   # dropped
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),        # done
+            jax.ShapeDtypeStruct((1, L), jnp.int32),        # chosen
+            jax.ShapeDtypeStruct((1, L), jnp.int32),        # q
+            jax.ShapeDtypeStruct((n_b, L, B), jnp.int32),   # packed
         ),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),     # shapes_t
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # shapes_b
             pl.BlockSpec(memory_space=pltpu.VMEM),     # counts
             pl.BlockSpec(memory_space=pltpu.VMEM),     # dropped
             pl.BlockSpec(memory_space=pltpu.VMEM),     # totals_t
             pl.BlockSpec(memory_space=pltpu.VMEM),     # reserved0_t
             pl.BlockSpec(memory_space=pltpu.VMEM),     # valid
             pl.BlockSpec(memory_space=pltpu.VMEM),     # prices
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # maxfit
             pl.BlockSpec(memory_space=pltpu.SMEM),     # last_valid
             pl.BlockSpec(memory_space=pltpu.SMEM),     # pods_unit
         ],
@@ -280,27 +385,28 @@ def pack_chunk_pallas(
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
-            pltpu.VMEM((R, T), jnp.int32),   # resv
-            pltpu.VMEM((1, T), jnp.int32),   # stopped
-            pltpu.VMEM((1, T), jnp.int32),   # npacked
-            pltpu.VMEM((1, S), jnp.int32),   # maxfit
-            pltpu.VMEM((1, S), jnp.int32),   # packedv
+            pltpu.VMEM((R, T), jnp.int32),        # resv
+            pltpu.VMEM((1, T), jnp.int32),        # stopped
+            pltpu.VMEM((1, T), jnp.int32),        # npacked
+            pltpu.VMEM((n_b, 1, B), jnp.int32),   # packedv
         ],
         interpret=interpret,
     )(
-        shapes.T.astype(jnp.int32),
-        counts.reshape(1, S).astype(jnp.int32),
-        dropped.reshape(1, S).astype(jnp.int32),
+        shapes_blocked,
+        counts.reshape(n_b, 1, B).astype(jnp.int32),
+        dropped.reshape(n_b, 1, B).astype(jnp.int32),
         totals.T.astype(jnp.int32),
         reserved0.T.astype(jnp.int32),
         valid.reshape(1, T).astype(jnp.int32),
         prices.reshape(1, T).astype(jnp.int32),
+        maxfit.reshape(n_b, 1, B).astype(jnp.int32),
         jnp.asarray(last_valid, jnp.int32).reshape(1, 1),
         jnp.asarray(pods_unit, jnp.int32).reshape(1, 1),
     )
     counts_f, dropped_f, done_f, chosen_seq, q_seq, packed_seq = outs
-    return (counts_f[0], dropped_f[0], done_f[0, 0] != 0,
-            chosen_seq[0], q_seq[0], packed_seq)
+    return (counts_f.reshape(S), dropped_f.reshape(S), done_f[0, 0] != 0,
+            chosen_seq[0], q_seq[0],
+            packed_seq.transpose(1, 0, 2).reshape(L, S))
 
 
 @functools.partial(

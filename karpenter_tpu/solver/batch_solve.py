@@ -195,6 +195,13 @@ def _device_batch(encs, packables_list, prices_list, config: SolverConfig):
         # padded batch landed above the pallas-validated bucket — the
         # block-tiled XLA scan is the executor for it (models/ffd.py:117)
         kernel = "xla"
+    if kernel == "pallas":
+        from karpenter_tpu.ops.pack_pallas import DIV_CAP
+
+        if int(counts.max(initial=0)) >= DIV_CAP - 4:
+            # pallas float32-division count bound (models/ffd.py) —
+            # unreachable behind the 100k batch guard, checked anyway
+            kernel = "xla"
     use_cost = config.cost_tiebreak and any(
         p is not None for p in prices_list)
     prices_arr = None
@@ -213,11 +220,21 @@ def _device_batch(encs, packables_list, prices_list, config: SolverConfig):
     counts_d, dropped_d = jax.device_put((counts, dropped))
 
     def run(kern):
-        return np.asarray(pack_batch_sharded_flat(
-            shapes, counts_d, dropped_d, totals, reserved0, valid,
-            last_valid, pods_unit, num_iters=L, mesh=mesh,
-            kernel=kern, interpret=kern == "pallas" and not on_tpu,
-            prices=prices_arr, cost_tiebreak=use_cost))
+        def dispatch():
+            return np.asarray(pack_batch_sharded_flat(
+                shapes, counts_d, dropped_d, totals, reserved0, valid,
+                last_valid, pods_unit, num_iters=L, mesh=mesh,
+                kernel=kern, interpret=kern == "pallas" and not on_tpu,
+                prices=prices_arr, cost_tiebreak=use_cost))
+
+        if not config.device_hedge:
+            return dispatch()
+        # same tail mitigation as the solo leg (models/ffd.py): the batched
+        # fetch is equally tunnel-RTT-bound and equally deterministic
+        from karpenter_tpu.solver.hedge import FETCHER
+
+        key = ("batch", kern, shapes.shape, totals.shape[1], L, use_cost)
+        return FETCHER.fetch(key, dispatch)
 
     records: List[list] = [[] for _ in range(len(encs))]
     dropped_rows = None

@@ -1,0 +1,151 @@
+"""Hedged device fetches: tail mitigation for RTT-bound solves.
+
+The TPU here sits behind a tunnel with a ~67 ms round-trip floor; a warm
+solve's device leg is RTT-bound (~72 ms), but tunnel jitter puts occasional
+>200 ms spikes on the p99 (observed on every 20k-pod capture, r4 verdict
+weak-item #2). The 120 s watchdog (solver/solve.py) is tail *protection* —
+this module is tail *reduction*: when a fetch overruns a small multiple of
+its own recent wall time, an identical second fetch is issued and the first
+to finish wins. The duplicated work is one spare kernel dispatch + fetch on
+tail events only; results are deterministic, so either answer is THE answer.
+
+Hedging is self-calibrating and off until proven fast: the first call for a
+given compiled shape (which may include a 20-40 s XLA compile) and any path
+whose recent wall time is large never hedge — only known-RTT-bound shapes
+do. The reference has no analog (its packer is a local CPU loop; nothing to
+hedge); this is transport-induced design, same family as the chunked
+single-fetch ABI (ops/pack.py pack_chunk_flat).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import Callable, Dict, Tuple
+
+log = logging.getLogger("karpenter.solver.hedge")
+
+# hedge only when the expected wall is comfortably RTT-shaped: beyond this
+# the duplicate dispatch costs real device time (e.g. the 8192-shape pallas
+# bucket runs seconds — a spike there is compute variance, not tunnel jitter)
+MAX_HEDGEABLE_WALL_S = 0.75
+
+
+class HedgedFetcher:
+    """Issue ``fn`` (a blocking dispatch+fetch) with a one-shot hedge.
+
+    Per-key EWMA of observed wall times decides the hedge delay:
+    ``max(min_delay_s, multiplier x ewma)``. Unknown keys run unhedged and
+    seed the EWMA. Thread-safe; the two-worker pool bounds concurrency (a
+    hedge in flight never spawns further hedges).
+    """
+
+    def __init__(self, min_delay_s: float = 0.15, multiplier: float = 3.0,
+                 ewma_alpha: float = 0.3):
+        self.min_delay_s = min_delay_s
+        self.multiplier = multiplier
+        self.ewma_alpha = ewma_alpha
+        self._wall: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        self._pool: ThreadPoolExecutor = None  # lazy: most processes never hedge
+        self._inflight = 0
+        self.hedges_fired = 0
+        self.hedges_won = 0
+
+    def _executor(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="hedged-fetch")
+            return self._pool
+
+    def _record(self, key: Tuple, wall_s: float) -> None:
+        with self._lock:
+            prev = self._wall.get(key)
+            self._wall[key] = wall_s if prev is None else (
+                self.ewma_alpha * wall_s + (1 - self.ewma_alpha) * prev)
+            if len(self._wall) > 4096:  # bounded: keys are compile signatures
+                self._wall.clear()
+
+    def fetch(self, key: Tuple, fn: Callable):
+        """Run ``fn()`` hedged. ``key`` identifies the compiled shape
+        (kernel, bucket dims, chunk length) so the delay calibrates to the
+        path actually running."""
+        with self._lock:
+            ewma = self._wall.get(key)
+        if ewma is None or ewma > MAX_HEDGEABLE_WALL_S:
+            # unknown (possibly cold-compiling) or too big to duplicate:
+            # run plain, learn the wall time
+            t0 = time.perf_counter()
+            out = fn()
+            self._record(key, time.perf_counter() - t0)
+            return out
+
+        delay = max(self.min_delay_s, self.multiplier * ewma)
+
+        # a sustained stall leaves abandoned losers running on the pool;
+        # piling more attempts behind them would make a new fetch WAIT on
+        # stale duplicates — during congestion, run plain in the caller's
+        # thread instead (review r5)
+        with self._lock:
+            congested = self._inflight >= 2
+        if congested:
+            t0 = time.perf_counter()
+            out = fn()
+            self._record(key, time.perf_counter() - t0)
+            return out
+
+        def timed():
+            with self._lock:
+                self._inflight += 1
+            try:
+                t0 = time.perf_counter()
+                return fn(), time.perf_counter() - t0
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+
+        pool = self._executor()
+        first = pool.submit(timed)
+        done, _ = wait([first], timeout=delay)
+        if done:
+            out, wall = first.result()  # raises the solve's own error, if any
+            self._record(key, wall)
+            return out
+
+        # tail event: fire the hedge, first successful result wins; the
+        # loser is cancelled if it has not started (a started attempt runs
+        # to completion — threads cannot be killed — but the congestion
+        # gate above keeps such stragglers from stacking up)
+        with self._lock:
+            self.hedges_fired += 1
+        log.debug("device fetch exceeded %.0f ms; hedging", delay * 1e3)
+        second = pool.submit(timed)
+        pending = {first, second}
+        error = None
+        winner = None
+        while pending and winner is None:
+            done, pending = wait(pending, return_when=FIRST_COMPLETED)
+            for f in done:
+                try:
+                    out, wall = f.result()
+                except Exception as e:  # keep waiting for the other attempt
+                    error = e
+                    continue
+                if f is second:
+                    with self._lock:
+                        self.hedges_won += 1
+                self._record(key, wall)
+                winner = (out,)
+                break
+        for f in pending:
+            f.cancel()
+        if winner is not None:
+            return winner[0]
+        raise error  # both attempts failed
+
+
+# process-wide instance: the EWMA must persist across solves to calibrate
+FETCHER = HedgedFetcher()

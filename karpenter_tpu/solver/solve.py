@@ -254,6 +254,11 @@ class SolverConfig:
     # option ordering), so it is off by default — parity mode is the
     # differential-test contract.
     cost_tiebreak: bool = False
+    # hedged second fetch on tail events (solver/hedge.py): re-issues an
+    # RTT-bound device fetch that overruns ~3x its own recent wall time —
+    # tunnel-jitter p99 reduction at the cost of one duplicate dispatch on
+    # tail events only. Self-disables for cold compiles and long solves.
+    device_hedge: bool = True
 
 
 @dataclass
@@ -349,7 +354,8 @@ def solve_with_packables(
                 kernel=config.device_kernel,
                 prices=prices, cost_tiebreak=prices is not None,
                 max_shapes=config.device_max_shapes, enc=enc,
-                pallas_max_shapes=config.pallas_max_shapes)
+                pallas_max_shapes=config.pallas_max_shapes,
+                hedge=config.device_hedge)
 
         try:
             with trace("karpenter.solve.device"):

@@ -1,0 +1,132 @@
+"""Hedged device fetch (solver/hedge.py): tail mitigation semantics.
+
+The hedger must (a) never hedge an unknown or long-running path, (b) fire
+exactly one spare attempt when a known-fast path overruns its delay,
+(c) return whichever attempt lands first, and (d) surface errors only when
+both attempts fail. Driven with stub fetch fns — determinism of the real
+device fetch is covered by the executor parity suites, which run with
+hedging enabled by default.
+"""
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.solver.hedge import MAX_HEDGEABLE_WALL_S, HedgedFetcher
+
+
+def test_unknown_key_runs_plain_and_seeds_ewma():
+    f = HedgedFetcher(min_delay_s=0.01)
+    calls = []
+    out = f.fetch(("k",), lambda: calls.append(1) or "a")
+    assert out == "a" and len(calls) == 1
+    assert f.hedges_fired == 0
+    assert ("k",) in f._wall
+
+
+def test_fast_path_never_hedges():
+    f = HedgedFetcher(min_delay_s=0.2)
+    for _ in range(5):
+        assert f.fetch(("k",), lambda: "ok") == "ok"
+    assert f.hedges_fired == 0
+
+
+def test_tail_event_fires_hedge_and_second_attempt_wins():
+    f = HedgedFetcher(min_delay_s=0.05, multiplier=2.0)
+    f.fetch(("k",), lambda: time.sleep(0.005) or "seed")  # seed ~5 ms ewma
+
+    attempt = {"n": 0}
+    lock = threading.Lock()
+
+    def jittery():
+        with lock:
+            attempt["n"] += 1
+            mine = attempt["n"]
+        if mine == 1:
+            time.sleep(1.0)  # the stuck first fetch (tunnel spike)
+            return "slow"
+        return "fast"
+
+    t0 = time.perf_counter()
+    out = f.fetch(("k",), jittery)
+    wall = time.perf_counter() - t0
+    assert out == "fast"
+    assert f.hedges_fired == 1 and f.hedges_won == 1
+    assert wall < 0.9  # did not wait out the stuck attempt
+
+
+def test_first_attempt_winning_after_hedge_is_fine():
+    f = HedgedFetcher(min_delay_s=0.02, multiplier=2.0)
+    f.fetch(("k",), lambda: "seed")
+
+    def first_slow_but_wins():
+        # both attempts take ~80 ms: the hedge fires at ~20 ms, then the
+        # FIRST attempt completes first (it had a head start)
+        time.sleep(0.08)
+        return "done"
+
+    assert f.fetch(("k",), first_slow_but_wins) == "done"
+    assert f.hedges_fired == 1
+
+
+def test_error_only_when_both_attempts_fail():
+    f = HedgedFetcher(min_delay_s=0.02, multiplier=2.0)
+    f.fetch(("k",), lambda: "seed")
+    attempt = {"n": 0}
+    lock = threading.Lock()
+
+    def first_fails():
+        with lock:
+            attempt["n"] += 1
+            mine = attempt["n"]
+        if mine == 1:
+            time.sleep(0.2)
+            raise RuntimeError("transport glitch")
+        return "recovered"
+
+    assert f.fetch(("k",), first_fails) == "recovered"
+
+    f2 = HedgedFetcher(min_delay_s=0.02, multiplier=2.0)
+    f2.fetch(("k",), lambda: "seed")
+
+    def always_fails():
+        time.sleep(0.05)
+        raise RuntimeError("down")
+
+    with pytest.raises(RuntimeError, match="down"):
+        f2.fetch(("k",), always_fails)
+
+
+def test_long_paths_are_never_hedged():
+    f = HedgedFetcher(min_delay_s=0.01)
+    f._wall[("big",)] = MAX_HEDGEABLE_WALL_S * 2  # e.g. the 8192-shape bucket
+    calls = []
+
+    def slowish():
+        calls.append(1)
+        time.sleep(0.05)
+        return "x"
+
+    assert f.fetch(("big",), slowish) == "x"
+    assert len(calls) == 1 and f.hedges_fired == 0
+
+
+def test_solve_path_respects_device_hedge_flag(monkeypatch):
+    """SolverConfig(device_hedge=False) must keep the fetch un-hedged."""
+    import karpenter_tpu.solver.hedge as hedge_mod
+    from karpenter_tpu.cloudprovider.fake.provider import instance_types
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.solver.solve import SolverConfig, solve
+    from tests.expectations import unschedulable_pod
+
+    def must_not_run(*a, **kw):
+        raise AssertionError("hedger used with device_hedge=False")
+
+    monkeypatch.setattr(hedge_mod.FETCHER, "fetch", must_not_run)
+    catalog = instance_types(8)
+    pods = [unschedulable_pod(requests={"cpu": "250m", "memory": "256Mi"})
+            for _ in range(50)]
+    res = solve(universe_constraints(catalog), pods, catalog,
+                config=SolverConfig(device_min_pods=1, device_hedge=False))
+    assert res.node_count >= 1 and not res.unschedulable

@@ -232,3 +232,63 @@ class TestPallasRouting:
         result = solve_ffd_device(vecs, ids, packables, kernel="pallas",
                                   pallas_max_shapes=512)
         assert result is not None  # solved by the xla kernel instead
+
+
+class TestFloordivSmall:
+    """The kernel's float32 division must be EXACT for every quotient below
+    DIV_CAP-2 (ops/pack_pallas.py). Pins the review-r5 counterexample where
+    float32 input rounding crossed an integer boundary UPWARD (the original
+    correction rounds only adjusted upward, so the kernel over-packed)."""
+
+    def test_upward_rounding_counterexample(self):
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops.pack_pallas import _floordiv_small
+
+        # f32(33558527) = 33558528 → qf = 8193.0 exactly, one above floor
+        assert int(_floordiv_small(jnp.int32(33558527),
+                                   jnp.int32(4096))) == 8192
+
+    def test_randomized_exactness(self):
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops.pack_pallas import DIV_CAP, _floordiv_small
+
+        rng = np.random.default_rng(7)
+        n = 100_000
+        bs = rng.integers(1, 2**31 - 1, size=n).astype(np.int64)
+        qs = np.minimum(rng.integers(0, DIV_CAP - 2, size=n),
+                        (2**31 - 1) // bs)
+        rs = (rng.random(n) * bs).astype(np.int64)
+        a = qs * bs + rs
+        m = a < 2**31
+        got = np.asarray(_floordiv_small(jnp.asarray(a[m], jnp.int32),
+                                         jnp.asarray(bs[m], jnp.int32)))
+        np.testing.assert_array_equal(got, a[m] // bs[m])
+
+    def test_boundary_adversaries(self):
+        """a = q·b - 1 and q·b exactly: the fractions nearest an integer
+        boundary, where a one-ULP rounding flips the f32 quotient."""
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops.pack_pallas import DIV_CAP, _floordiv_small
+
+        rng = np.random.default_rng(11)
+        n = 100_000
+        b = rng.integers(1, 2**14, size=n).astype(np.int64)
+        q = np.minimum(rng.integers(1, DIV_CAP - 2, size=n),
+                       (2**31 - 2) // b)
+        for delta in (-1, 0):
+            a = q * b + delta
+            m = (a >= 0) & (a < 2**31)
+            got = np.asarray(_floordiv_small(jnp.asarray(a[m], jnp.int32),
+                                             jnp.asarray(b[m], jnp.int32)))
+            np.testing.assert_array_equal(got, a[m] // b[m])
+
+    def test_negative_numerator_clips_like_floor(self):
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops.pack_pallas import _floordiv_small
+
+        for a in (-1, -5, -(2**30)):
+            assert int(_floordiv_small(jnp.int32(a), jnp.int32(7))) <= 0
