@@ -487,6 +487,75 @@ def config_6_high_cardinality():
     return out
 
 
+def config_8_large_catalog_type_spmd():
+    """The type-axis SPMD kernel at its claimed regime (VERDICT r4 #6):
+    ONE 50k-pod problem over a 2,000-type catalog (the 2048 TYPE bucket).
+    Single chip, two executors on the identical encoded problem:
+
+    - the standard solo device kernel (production default routing);
+    - the type-sharded kernel on a 1-device mesh (the collective pattern
+      with degenerate collectives — the single-chip data point for the
+      multi-chip scaling row; the 8-device CPU-mesh run lives in
+      MULTICHIP_r05 with exact parity).
+    """
+    from karpenter_tpu.cloudprovider.fake.provider import instance_types
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.models.ffd import device_args, solve_ffd_device
+    from karpenter_tpu.ops.encode import encode, pad_encoding
+    from karpenter_tpu.parallel.type_sharded import (
+        pack_chunk_type_sharded, type_mesh,
+    )
+    from karpenter_tpu.solver.adapter import build_packables_cached, pod_vectors
+    from karpenter_tpu.solver.native_ffd import solve_ffd_per_pod_native
+
+    import numpy as np
+
+    catalog = instance_types(2_000)
+    constraints = universe_constraints(catalog)
+    pods = make_pods(50_000, MIXED_SHAPES)
+    packables, _ = build_packables_cached(catalog, constraints, pods, [])
+    vecs, ids = pod_vectors(pods), list(range(len(pods)))
+    enc = encode(vecs, ids, packables)
+    assert enc is not None and enc.totals.shape[0] == 2048
+
+    # parity first (both executors vs the per-pod C++ oracle)
+    dev = solve_ffd_device(vecs, ids, packables, enc=enc)
+    oracle = solve_ffd_per_pod_native(vecs, ids, packables)
+    parity = "unchecked (no C++ toolchain)"
+    if oracle is not None and dev is not None:
+        assert dev.node_count == oracle.node_count
+        parity = "exact (per-pod C++ oracle)"
+
+    out = {"pods": 50_000, "types": 2_000, "type_bucket": 2048,
+           "node_count": dev.node_count if dev else None,
+           "node_parity": parity}
+
+    times = run_timed(lambda: solve_ffd_device(vecs, ids, packables, enc=enc),
+                      max_iters=25, budget_s=45.0)
+    out["standard_kernel"] = _stats(times)
+
+    tmesh = type_mesh(jax_devices_first())
+    L = 256
+    args = device_args(pad_encoding(enc))
+    buf = np.asarray(pack_chunk_type_sharded(*args, num_iters=L, mesh=tmesh))
+    from karpenter_tpu.ops.pack import unpack_flat
+
+    _, _, done, _, q, _ = unpack_flat(buf, args[0].shape[0], L)
+    assert done, "type-sharded kernel did not converge in one chunk"
+    if oracle is not None:
+        assert int(q[q > 0].sum()) == oracle.node_count
+    times = run_timed(lambda: np.asarray(pack_chunk_type_sharded(
+        *args, num_iters=L, mesh=tmesh)), max_iters=25, budget_s=45.0)
+    out["type_spmd_1device"] = _stats(times)
+    return out
+
+
+def jax_devices_first():
+    import jax
+
+    return jax.devices()[:1]
+
+
 def config_7_control_plane():
     """Control-plane load: 10k unschedulable pods through the FULL stack —
     watch pump → selection (64 workers, non-blocking gate) → batcher →
@@ -641,6 +710,7 @@ def run_all(degraded: bool, probe_note: str = ""):
         ("config_5_consolidate_2k_nodes", config_5_consolidation),
         ("config_6_high_shape_cardinality", config_6_high_cardinality),
         ("config_7_control_plane_10k_pods", config_7_control_plane),
+        ("config_8_large_catalog_type_spmd", config_8_large_catalog_type_spmd),
     ):
         try:
             extra[key] = fn()

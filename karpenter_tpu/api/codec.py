@@ -73,6 +73,7 @@ def provisioner_from_manifest(manifest: Dict[str, Any]) -> Provisioner:
             ],
             resources=parse_resource_list(
                 {k: str(v) for k, v in status_res.items()}),
+            last_scale_time=_ts_from_lenient(status.get("lastScaleTime")),
         ),
         metadata=ObjectMeta(
             name=meta.get("name", ""),
@@ -150,6 +151,12 @@ def provisioner_to_manifest(p: Provisioner) -> Dict[str, Any]:
         ],
         "resources": {k: str(q) for k, q in p.status.resources.items()},
     }
+    if p.status.last_scale_time is not None:
+        # scalar + volatile: emitted when set (reference omitempty,
+        # provisioner_status.go:27) — unlike the owned list/map fields
+        # above, absence means "unset", not "cleared"
+        manifest["status"]["lastScaleTime"] = codec_core_ts_to(
+            p.status.last_scale_time)
     meta = manifest["metadata"]
     if p.metadata.namespace and p.metadata.namespace != "default":
         meta["namespace"] = p.metadata.namespace

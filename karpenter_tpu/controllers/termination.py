@@ -21,7 +21,9 @@ from typing import List, Optional, Set, Tuple
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.core import Node, Pod
 from karpenter_tpu.cloudprovider.spi import CloudProvider
-from karpenter_tpu.runtime.kubecore import Conflict, KubeCore, NotFound
+from karpenter_tpu.runtime.kubecore import (
+    Conflict, InternalError, KubeCore, NotFound, TooManyRequests,
+)
 from karpenter_tpu.utils import clock
 from karpenter_tpu.utils import pod as podutil
 
@@ -92,7 +94,12 @@ class EvictionQueue:
                     self._items.append((time.monotonic() + backoff, nn))
 
     def _evict(self, nn: Tuple[str, str]) -> bool:
-        """eviction.go:91-110: 404 → done; PDB rejection → retry."""
+        """eviction.go:91-110: 404 → done; PDB rejection → retry. The 500
+        vs 429 distinction is preserved (eviction.go:94-101): 500 means the
+        PDB CONFIGURATION is broken (more than one budget selects the pod)
+        — an operator problem worth a distinct message — while 429 means a
+        healthy budget is simply holding the line. Both requeue with
+        backoff."""
         namespace, name = nn
         try:
             self.kube.evict_pod(name, namespace)
@@ -100,7 +107,15 @@ class EvictionQueue:
             return True
         except NotFound:
             return True
-        except Conflict:  # PDB violation analog (429)
+        except InternalError:  # 500: PDB misconfiguration
+            log.debug("failed to evict %s/%s due to PDB misconfiguration "
+                      "(multiple budgets select it)", namespace, name)
+            return False
+        except TooManyRequests:  # 429: PDB violation
+            log.debug("failed to evict %s/%s due to PDB violation",
+                      namespace, name)
+            return False
+        except Conflict:  # fake layers may still signal PDB via Conflict
             log.debug("eviction of %s/%s rejected (PDB)", namespace, name)
             return False
         except Exception:

@@ -29,7 +29,10 @@ INT32_LIMIT = 2**31 - 1
 # distinct request vectors); the kernel's shape scan is block-tiled
 # (ops/pack.py) so the longer sequential axis stays scan-overhead-efficient.
 SHAPE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
-TYPE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
+# 2048/4096: the "catalog is large" regime the type-axis SPMD kernel
+# exists for (parallel/type_sharded.py) — a real cloud catalog with every
+# size × family × generation easily exceeds 1024 distinct types
+TYPE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 
 def bucket(n: int, buckets: Sequence[int]) -> Optional[int]:

@@ -36,7 +36,8 @@ from karpenter_tpu.api import codec, codec_core
 from karpenter_tpu.api.core import LabelSelector, Pod
 from karpenter_tpu.utils.fastcopy import deep_copy
 from karpenter_tpu.runtime.kubecore import (
-    AlreadyExists, ApiError, Conflict, Event, NotFound,
+    AlreadyExists, ApiError, Conflict, Event, InternalError, NotFound,
+    TooManyRequests,
 )
 
 log = logging.getLogger("karpenter.kubeclient")
@@ -284,11 +285,13 @@ class KubeApiClient:
                 raise ResourceExpired(f"{method} {path}: gone (410)")
             if resp.status == 429:
                 # only the eviction subresource uses 429 to mean "PDB would
-                # be violated" (mapped to Conflict so the eviction queue
-                # backs off); anywhere else it is API-Priority-and-Fairness
-                # throttling — honor Retry-After and retry in place
+                # be violated" (typed TooManyRequests so the eviction queue
+                # mirrors eviction.go:94-101); anywhere else it is
+                # API-Priority-and-Fairness throttling — honor Retry-After
+                # and retry in place
                 if path.split("?")[0].endswith("/eviction"):
-                    raise Conflict(f"{method} {path}: too many requests (PDB)")
+                    raise TooManyRequests(
+                        f"{method} {path}: too many requests (PDB)")
                 if _throttle_retries > 0:
                     import time as _time
 
@@ -301,6 +304,12 @@ class KubeApiClient:
                     return self._request(method, path, body, content_type,
                                          _throttle_retries - 1)
                 raise ApiError(f"{method} {path}: HTTP 429: rate limited")
+            if resp.status == 500:
+                # typed for the eviction queue's PDB-misconfiguration
+                # branch (eviction.go:94-97); InternalError is an ApiError,
+                # so all other 500 handling is unchanged
+                raise InternalError(
+                    f"{method} {path}: HTTP 500: {data[:300]!r}")
             if resp.status >= 300:
                 raise ApiError(
                     f"{method} {path}: HTTP {resp.status}: {data[:300]!r}")
@@ -547,8 +556,17 @@ class KubeApiClient:
                 last = e
         raise last or Conflict(f"patch {kind} {namespace}/{name}: retries exhausted")
 
-    def delete(self, kind: str, name: str, namespace: str = "default"):
-        return self._request("DELETE", self._item(kind, name, namespace)) or None
+    def delete(self, kind: str, name: str, namespace: str = "default",
+               precondition_rv=None):
+        body = None
+        if precondition_rv is not None:
+            # DeleteOptions with preconditions — the apiserver answers 409
+            # when the live resourceVersion no longer matches
+            body = {"apiVersion": "v1", "kind": "DeleteOptions",
+                    "preconditions": {
+                        "resourceVersion": str(precondition_rv)}}
+        return self._request(
+            "DELETE", self._item(kind, name, namespace), body) or None
 
     # -- raw access ----------------------------------------------------------
     # For kinds without a modeled codec (e.g. admissionregistration

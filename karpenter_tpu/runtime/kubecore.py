@@ -49,6 +49,19 @@ class Conflict(ApiError):
     pass
 
 
+class TooManyRequests(ApiError):
+    """HTTP 429 from the eviction subresource: the eviction would violate a
+    PodDisruptionBudget (k8s disruption controller semantics). Distinct
+    from Conflict so callers can mirror the reference's eviction.go:94-101
+    handling."""
+
+
+class InternalError(ApiError):
+    """HTTP 500: for eviction, the PDB configuration is ambiguous (more
+    than one PodDisruptionBudget matches the pod — the real apiserver's
+    'found more than one PodDisruptionBudget' error)."""
+
+
 @dataclass
 class Event:
     type: str  # ADDED | MODIFIED | DELETED
@@ -286,13 +299,23 @@ class KubeCore:
             self._notify("MODIFIED", obj)
             return deep_copy(obj)
 
-    def delete(self, kind: str, name: str, namespace: str = "default"):
-        """Delete; with finalizers present, only stamps deletionTimestamp."""
+    def delete(self, kind: str, name: str, namespace: str = "default",
+               precondition_rv=None):
+        """Delete; with finalizers present, only stamps deletionTimestamp.
+        ``precondition_rv``: DeleteOptions.preconditions.resourceVersion —
+        the delete conflicts unless the live object still carries exactly
+        this resourceVersion (apiserver optimistic-delete semantics)."""
         with self._lock:
             k = (kind, namespace, name)
             stored = self._objects.get(k)
             if stored is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
+            if precondition_rv is not None and \
+                    str(stored.metadata.resource_version) != str(precondition_rv):
+                raise Conflict(
+                    f"{kind} {namespace}/{name}: delete precondition failed "
+                    f"(resourceVersion {stored.metadata.resource_version} "
+                    f"!= {precondition_rv})")
             if stored.metadata.finalizers:
                 if stored.metadata.deletion_timestamp is None:
                     # k8s semantics: deletionTimestamp = request time + the
@@ -358,9 +381,49 @@ class KubeCore:
         return errs
 
     def evict_pod(self, name: str, namespace: str = "default") -> None:
-        """Eviction subresource: deletes the pod (PDB checks live in the
-        fake layer for tests that need 429 behavior)."""
-        self.delete("Pod", name, namespace)
+        """Eviction subresource with PodDisruptionBudget semantics
+        (the real apiserver's eviction REST handler):
+
+        - more than one PDB selects the pod → 500 InternalError
+          ("found more than one PodDisruptionBudget" — misconfiguration);
+        - exactly one, and evicting would drop the selected-and-scheduled
+          pod count below minAvailable → 429 TooManyRequests;
+        - otherwise the pod is deleted.
+        """
+        with self._lock:
+            pod = self._objects.get(("Pod", namespace, name))
+            if pod is not None:
+                matching = [
+                    o for (k, ns, _), o in self._objects.items()
+                    if k == "PodDisruptionBudget" and ns == namespace
+                    and o.selector is not None
+                    and o.selector.matches(pod.metadata.labels)
+                ]
+                if len(matching) > 1:
+                    raise InternalError(
+                        f"pod {namespace}/{name}: found more than one "
+                        f"PodDisruptionBudget ({len(matching)}) — "
+                        "misconfigured")
+                if matching and matching[0].min_available is not None:
+                    pdb = matching[0]
+                    healthy = sum(
+                        1 for (k, ns, _), o in self._objects.items()
+                        if k == "Pod" and ns == namespace
+                        and getattr(o.spec, "node_name", None)
+                        and pdb.selector.matches(o.metadata.labels))
+                    # the eviction only reduces the healthy count if the
+                    # evicted pod is itself counted (scheduled): evicting
+                    # an unscheduled pod never moves the budget
+                    loss = 1 if getattr(pod.spec, "node_name", None) else 0
+                    if healthy - loss < pdb.min_available:
+                        raise TooManyRequests(
+                            f"pod {namespace}/{name}: eviction would "
+                            f"violate PDB {pdb.metadata.name} "
+                            f"({healthy}/{pdb.min_available} available)")
+            # delete INSIDE the lock (RLock re-entry): releasing between the
+            # PDB check and the delete would let two concurrent evictions
+            # both pass the check and jointly breach minAvailable
+            self.delete("Pod", name, namespace)
 
     # -- convenience indexes -------------------------------------------------
     def pods_on_node(self, node_name: str) -> List[Pod]:

@@ -21,7 +21,8 @@ from karpenter_tpu.runtime.kubeclient import (
     KubeApiClient, ROUTES, _decode as wire_decode, _encode as wire_encode,
 )
 from karpenter_tpu.runtime.kubecore import (
-    AlreadyExists, Conflict, KubeCore, NotFound,
+    AlreadyExists, Conflict, InternalError, KubeCore, NotFound,
+    TooManyRequests,
 )
 from tests.expectations import unschedulable_pod
 
@@ -185,6 +186,17 @@ class StubHandler(BaseHTTPRequestHandler):
                 self.core.evict_pod(name, namespace)
             except NotFound:
                 return self._send(404, b"{}")
+            except TooManyRequests:
+                # PDB violation — real apiserver eviction REST semantics
+                return self._send(
+                    429, b'{"kind":"Status","code":429,'
+                         b'"reason":"TooManyRequests"}')
+            except InternalError:
+                # >1 PDB matches: misconfiguration → 500
+                return self._send(
+                    500, b'{"kind":"Status","code":500,'
+                         b'"message":"found more than one '
+                         b'PodDisruptionBudget"}')
             return self._send(201, b"{}")
         obj = wire_decode(kind, body)
         try:
@@ -220,9 +232,18 @@ class StubHandler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         kind, namespace, name, _, _ = self._parse()
+        precondition_rv = None
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            opts = json.loads(self.rfile.read(length))
+            precondition_rv = (opts.get("preconditions") or {}).get(
+                "resourceVersion")
         try:
             self.core.delete(kind, name, namespace or "default"
-                             if not ROUTES[kind][2] else "")
+                             if not ROUTES[kind][2] else "",
+                             precondition_rv=precondition_rv)
+        except Conflict:
+            return self._send(409, b'{"kind":"Status","code":409}')
         except NotFound:
             return self._send(404, b"{}")
         self._send(200, b"{}")
@@ -563,15 +584,73 @@ class TestRealServerSemantics:
         assert got.data["k"] == "v"
         assert behavior["throttle_429"] == 0  # the throttle was actually hit
 
-    def test_429_on_eviction_is_pdb_conflict(self, api):
+    def test_429_on_eviction_is_typed_pdb_violation(self, api):
         """On the eviction subresource 429 means 'PDB would be violated' —
-        that one keeps the Conflict mapping so the eviction queue backs off
-        (termination.py eviction backoff)."""
+        typed TooManyRequests so the eviction queue mirrors the reference's
+        distinct handling (eviction.go:98-101)."""
         core, client, behavior = api
         core.create(Pod(metadata=ObjectMeta(name="guarded")))
         behavior["evict_429"] = True
-        with pytest.raises(Conflict):
+        with pytest.raises(TooManyRequests):
             client.evict_pod("guarded")
+
+    def test_eviction_pdb_semantics_over_the_wire(self, api):
+        """PDB-aware eviction END TO END: the stub consults real
+        PodDisruptionBudget objects via kubecore's eviction handler —
+        violation → 429 TooManyRequests, two matching budgets → 500
+        InternalError ('found more than one PodDisruptionBudget'),
+        headroom → eviction succeeds. Contract: the real apiserver's
+        eviction REST handler."""
+        from karpenter_tpu.api.core import LabelSelector, PodDisruptionBudget
+
+        core, client, behavior = api
+        for i in range(2):
+            pod = Pod(metadata=ObjectMeta(name=f"web-{i}",
+                                          labels={"app": "web"}))
+            pod.spec.node_name = "n1"
+            core.create(pod)
+        core.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="web-pdb"),
+            selector=LabelSelector(match_labels={"app": "web"}),
+            min_available=2))
+        with pytest.raises(TooManyRequests):
+            client.evict_pod("web-0")
+        assert core.get("Pod", "web-0")  # still there
+
+        # a second overlapping budget → misconfiguration → 500
+        core.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="web-pdb-2"),
+            selector=LabelSelector(match_labels={"app": "web"}),
+            min_available=1))
+        with pytest.raises(InternalError):
+            client.evict_pod("web-0")
+
+        # drop to one budget with headroom → eviction succeeds
+        core.delete("PodDisruptionBudget", "web-pdb", "default")
+        pod = Pod(metadata=ObjectMeta(name="web-2", labels={"app": "web"}))
+        pod.spec.node_name = "n1"
+        core.create(pod)
+        client.evict_pod("web-0")
+        with pytest.raises(NotFound):
+            core.get("Pod", "web-0")
+
+    def test_delete_preconditions_over_the_wire(self, api):
+        """DELETE with preconditions.resourceVersion: a stale precondition
+        conflicts (409) and leaves the object; the live one deletes."""
+        core, client, behavior = api
+        cm = core.create(ConfigMap(metadata=ObjectMeta(name="pc"),
+                                   data={"k": "1"}))
+        stale_rv = cm.metadata.resource_version
+        core.patch("ConfigMap", "pc", "default",
+                   lambda o: o.data.update({"k": "2"}))
+        with pytest.raises(Conflict):
+            client.delete("ConfigMap", "pc", precondition_rv=stale_rv)
+        live = core.get("ConfigMap", "pc")
+        assert live.data["k"] == "2"
+        client.delete("ConfigMap", "pc",
+                      precondition_rv=live.metadata.resource_version)
+        with pytest.raises(NotFound):
+            core.get("ConfigMap", "pc")
 
 
 class TestGraceCodec:

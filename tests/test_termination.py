@@ -38,9 +38,10 @@ def terminating_node(kube, name="node-1"):
 
 
 def pod_on(kube, node_name, name="p1", annotations=None, priority="",
-           tolerations=None, static=False):
+           tolerations=None, static=False, labels=None):
     pod = Pod(
-        metadata=ObjectMeta(name=name, annotations=annotations or {}),
+        metadata=ObjectMeta(name=name, annotations=annotations or {},
+                            labels=labels or {}),
         spec=PodSpec(node_name=node_name, tolerations=tolerations or [],
                      priority_class_name=priority))
     if static:
@@ -173,6 +174,74 @@ class TestEvictionBackoff:
             controller.reconcile(node.metadata.name)
             with pytest.raises(NotFound):
                 kube.get("Node", node.metadata.name, "")
+        finally:
+            controller.stop_all()
+
+    def test_real_pdb_objects_hold_then_release_drain(self):
+        """PDB semantics via REAL PodDisruptionBudget objects (kubecore's
+        eviction handler, r5 contract tier): a drain blocked by
+        minAvailable retries with backoff (429 TooManyRequests,
+        eviction.go:98-101) and completes once the budget is deleted."""
+        from karpenter_tpu.api.core import LabelSelector, PodDisruptionBudget
+
+        kube = KubeCore()
+        provider = FakeCloudProvider()
+        controller = TerminationController(kube, provider)
+        try:
+            node = terminating_node(kube)
+            pod_on(kube, node.metadata.name, name="guarded",
+                   labels={"app": "db"})
+            kube.create(PodDisruptionBudget(
+                metadata=ObjectMeta(name="db-pdb"),
+                selector=LabelSelector(match_labels={"app": "db"}),
+                min_available=1))
+            controller.reconcile(node.metadata.name)
+            time.sleep(0.5)  # several backoff rounds
+            assert any(p.metadata.name == "guarded"
+                       for p in kube.list("Pod")), "PDB did not hold"
+            kube.delete("PodDisruptionBudget", "db-pdb", "default")
+
+            def evicted():
+                names = [p.metadata.name for p in kube.list("Pod")]
+                assert "guarded" not in names, f"still present: {names}"
+            eventually(evicted, timeout=15.0)
+        finally:
+            controller.stop_all()
+
+    def test_pdb_misconfiguration_is_distinct_and_retries(self, caplog):
+        """Two budgets selecting one pod → 500 InternalError with the
+        distinct misconfiguration message (eviction.go:94-97), retried —
+        not swallowed by the generic handler."""
+        import logging
+
+        from karpenter_tpu.api.core import LabelSelector, PodDisruptionBudget
+
+        kube = KubeCore()
+        provider = FakeCloudProvider()
+        controller = TerminationController(kube, provider)
+        try:
+            node = terminating_node(kube)
+            pod_on(kube, node.metadata.name, name="doubly",
+                   labels={"app": "web"})
+            for i in range(2):
+                kube.create(PodDisruptionBudget(
+                    metadata=ObjectMeta(name=f"pdb-{i}"),
+                    selector=LabelSelector(match_labels={"app": "web"}),
+                    min_available=0))
+            with caplog.at_level(logging.DEBUG,
+                                 logger="karpenter.termination"):
+                controller.reconcile(node.metadata.name)
+                time.sleep(0.4)
+            assert any("misconfiguration" in r.message
+                       for r in caplog.records), (
+                "500-vs-429 distinction lost: no misconfiguration log")
+            # fixing the config (one budget left) releases the drain
+            kube.delete("PodDisruptionBudget", "pdb-1", "default")
+
+            def evicted():
+                names = [p.metadata.name for p in kube.list("Pod")]
+                assert "doubly" not in names, f"still present: {names}"
+            eventually(evicted, timeout=15.0)
         finally:
             controller.stop_all()
 
