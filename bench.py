@@ -422,8 +422,9 @@ def config_6_high_cardinality():
     # records ≈ nodes and each extra chunk is a device round trip.
     # kernel=None → default (pallas on real TPU): the 8192 bucket was
     # hardware-validated r4 (exact vs the per-pod C++ oracle at 5k/8k
-    # shapes) and the fused pallas kernel runs it ~4× faster than the
-    # block-tiled XLA scan (9.5 s vs 37 s warm)
+    # shapes) and the fused pallas kernel runs it ~1.9 s warm (r5 blocked
+    # walk + exact f32 division + pipelined fetch; the block-tiled XLA
+    # scan needs ~37 s) — docs/solver.md §9 has the measured roofline
     dev = solve_ffd_device(vecs, ids, packables, chunk_iters=512)  # warm-up
     if dev is not None:
         import jax

@@ -239,8 +239,8 @@ class SolverConfig:
     # largest shape bucket the fused pallas VMEM kernel is routed to;
     # requests above it take the block-tiled XLA scan. 8192 validated on
     # hardware r4: exact vs the per-pod C++ oracle at 5k and 8k distinct
-    # shapes (50k pods × 400 types), and ~4× the XLA scan's speed there
-    # (9.5 s vs 37 s warm) — see BASELINE.md config 6
+    # shapes (50k pods × 400 types); ~1.9 s warm there in the r5 capture
+    # (~20× the XLA scan) — see BASELINE.md config 6 and docs/solver.md §9
     pallas_max_shapes: int = 8192
     # prefer the C++ kernel over the per-pod Python oracle for host solves
     use_native: bool = True
