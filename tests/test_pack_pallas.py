@@ -292,3 +292,50 @@ class TestFloordivSmall:
 
         for a in (-1, -5, -(2**30)):
             assert int(_floordiv_small(jnp.int32(a), jnp.int32(7))) <= 0
+
+
+class TestPipelinedChunkLoop:
+    """The high-cardinality chunk loop (models/ffd.py): device-resident
+    counts/dropped carry + speculative next-chunk dispatch + async
+    copy-out. On CPU the S*L trigger is never reached naturally, so the
+    threshold is forced down to exercise the multi-chunk resume through
+    the pipelined path — the result must match the host oracle and the
+    unpipelined loop exactly."""
+
+    def _problem(self, n_pods=120):
+        catalog = instance_types(6)
+        pods = [make_pod({"cpu": f"{100 + 7 * i}m", "memory": "64Mi"})
+                for i in range(n_pods)]
+        packables, _ = build_packables(
+            catalog, allow_all_constraints(catalog), pods, [])
+        vecs = [pod_vector(p) for p in pods]
+        return vecs, list(range(len(pods))), packables
+
+    @pytest.mark.parametrize("kernel", ["xla", "pallas"])
+    def test_pipelined_multi_chunk_resume_exact(self, monkeypatch, kernel):
+        import karpenter_tpu.models.ffd as ffd
+
+        vecs, ids, packables = self._problem()
+        want = host_ffd.pack(vecs, ids, packables)
+        unpipelined = ffd.solve_ffd_device(vecs, ids, packables,
+                                           kernel=kernel, chunk_iters=4,
+                                           hedge=False)
+        monkeypatch.setattr(ffd, "_PIPELINE_ELEMS", 1)  # force the path
+        piped = ffd.solve_ffd_device(vecs, ids, packables, kernel=kernel,
+                                     chunk_iters=4, hedge=False)
+        key = lambda r: (r.node_count, sorted(r.unschedulable),
+                         sorted((tuple(p.instance_type_indices),
+                                 p.node_quantity) for p in r.packings))
+        assert piped is not None and unpipelined is not None
+        assert key(piped) == key(want)
+        assert key(piped) == key(unpipelined)
+
+    def test_pipelined_single_chunk_exact(self, monkeypatch):
+        import karpenter_tpu.models.ffd as ffd
+
+        vecs, ids, packables = self._problem(n_pods=40)
+        want = host_ffd.pack(vecs, ids, packables)
+        monkeypatch.setattr(ffd, "_PIPELINE_ELEMS", 1)
+        got = ffd.solve_ffd_device(vecs, ids, packables, kernel="xla",
+                                   hedge=False)
+        assert got is not None and got.node_count == want.node_count
