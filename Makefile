@@ -20,8 +20,16 @@ native: ## Build the C++ FFD kernel explicitly (normally built lazily)
 		-o karpenter_tpu/native/_libktffd.so karpenter_tpu/native/ffd.cc
 
 lint: ## ruff + mypy quality gate (the golangci/gocyclo analog, SURVEY §5.2)
-	ruff check karpenter_tpu tests bench.py __graft_entry__.py
-	mypy karpenter_tpu/solver karpenter_tpu/ops karpenter_tpu/api
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check karpenter_tpu tests bench.py __graft_entry__.py; \
+	else \
+		echo "lint: ruff not installed in this environment; skipping (CI runs it)"; \
+	fi
+	@if command -v mypy >/dev/null 2>&1; then \
+		mypy karpenter_tpu/solver karpenter_tpu/ops karpenter_tpu/api; \
+	else \
+		echo "lint: mypy not installed in this environment; skipping (CI runs it)"; \
+	fi
 
 chart: ## Render the Helm chart with the in-repo renderer (no helm needed)
 	python -m karpenter_tpu.utils.helmlite charts/karpenter-tpu

@@ -97,6 +97,13 @@ class StubHandler(BaseHTTPRequestHandler):
             # real apiserver answers 410 Gone mid-pagination
             return self._send(410, b'{"kind":"Status","code":410}')
         items = self.core.list(kind, namespace=namespace, field=field)
+        if self.behavior:
+            omit = self.behavior.pop("list_omit_once", None)
+            if omit:
+                # stale watch-cache LIST: the real apiserver may serve a
+                # LIST from a cache that has not yet observed a recent
+                # write — the object exists but is missing from this page
+                items = [o for o in items if o.metadata.name != omit]
         if "labelSelector" in qs:
             # equality terms only — enough for the client's match_labels
             # (operator terms are covered by the serialization test)
@@ -633,6 +640,32 @@ class TestRealServerSemantics:
         client.evict_pod("web-0")
         with pytest.raises(NotFound):
             core.get("Pod", "web-0")
+
+    def test_stale_list_converges_via_watch_replay(self, api):
+        """List-newer-than-watch-cache contract (r5 tier): a LIST served
+        from a stale watch cache omits a recent write; the client's
+        informer must still converge because the subsequent watch stream
+        replays/streams the missed object — a stale LIST is a snapshot,
+        never a tombstone."""
+        core, client, behavior = api
+        core.create(ConfigMap(metadata=ObjectMeta(name="fresh"),
+                              data={"k": "v"}))
+        behavior["list_omit_once"] = "fresh"  # the feeder's LIST is stale
+        q = client.watch("ConfigMap")
+        try:
+            deadline = time.time() + 10
+            seen = False
+            while time.time() < deadline and not seen:
+                try:
+                    ev = q.get(timeout=0.5)
+                except queue_mod.Empty:
+                    continue
+                seen = ev.obj.metadata.name == "fresh"
+            assert seen, "object missing from the stale LIST never arrived"
+            # and the informer read path serves it
+            assert client.get("ConfigMap", "fresh").data["k"] == "v"
+        finally:
+            client.stop_watches()
 
     def test_delete_preconditions_over_the_wire(self, api):
         """DELETE with preconditions.resourceVersion: a stale precondition
