@@ -719,6 +719,14 @@ def run_all(degraded: bool, probe_note: str = ""):
             extra[key] = {"error": f"{type(e).__name__}: {e}"}
         _persist_partial(extra)
     extra.pop("headline_times", None)
+    # tail-mitigation evidence: how often the hedged second fetch
+    # (solver/hedge.py) fired across the whole run, and how often the
+    # hedge beat the stuck first attempt
+    from karpenter_tpu.solver.hedge import FETCHER
+
+    extra["hedged_fetches"] = {"fired": FETCHER.hedges_fired,
+                               "won": FETCHER.hedges_won}
+    _persist_partial(extra)  # keep the salvage path's checkpoint complete
     return _metric_line(_stats(headline_times)["p99_ms"], extra)
 
 
