@@ -69,6 +69,22 @@ class HedgedFetcher:
             if len(self._wall) > 4096:  # bounded: keys are compile signatures
                 self._wall.clear()
 
+    def _count_hedge(self, won: bool) -> None:
+        """Counters + Prometheus series (same posture as the solver's
+        executor/breaker metrics — tail mitigation must be observable)."""
+        from karpenter_tpu.metrics.registry import DEFAULT
+
+        with self._lock:
+            if won:
+                self.hedges_won += 1
+            else:
+                self.hedges_fired += 1
+        DEFAULT.counter(
+            "solver_hedged_fetches_total",
+            "hedged device fetches, labeled by outcome "
+            "(fired|hedge_won)").inc(
+            outcome="hedge_won" if won else "fired")
+
     def fetch(self, key: Tuple, fn: Callable):
         """Run ``fn()`` hedged. ``key`` identifies the compiled shape
         (kernel, bucket dims, chunk length) so the delay calibrates to the
@@ -119,8 +135,7 @@ class HedgedFetcher:
         # loser is cancelled if it has not started (a started attempt runs
         # to completion — threads cannot be killed — but the congestion
         # gate above keeps such stragglers from stacking up)
-        with self._lock:
-            self.hedges_fired += 1
+        self._count_hedge(won=False)
         log.debug("device fetch exceeded %.0f ms; hedging", delay * 1e3)
         second = pool.submit(timed)
         pending = {first, second}
@@ -135,8 +150,7 @@ class HedgedFetcher:
                     error = e
                     continue
                 if f is second:
-                    with self._lock:
-                        self.hedges_won += 1
+                    self._count_hedge(won=True)
                 self._record(key, wall)
                 winner = (out,)
                 break

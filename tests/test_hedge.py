@@ -32,6 +32,17 @@ def test_fast_path_never_hedges():
     assert f.hedges_fired == 0
 
 
+def _series_value(outcome: str) -> float:
+    """Current value of the hedged-fetches counter series (0 if absent)."""
+    from karpenter_tpu.metrics.registry import DEFAULT
+
+    needle = f'karpenter_solver_hedged_fetches_total{{outcome="{outcome}"}}'
+    for line in DEFAULT.expose().splitlines():
+        if line.startswith(needle):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
 def test_tail_event_fires_hedge_and_second_attempt_wins():
     f = HedgedFetcher(min_delay_s=0.05, multiplier=2.0)
     f.fetch(("k",), lambda: time.sleep(0.005) or "seed")  # seed ~5 ms ewma
@@ -48,12 +59,18 @@ def test_tail_event_fires_hedge_and_second_attempt_wins():
             return "slow"
         return "fast"
 
+    fired0, won0 = _series_value("fired"), _series_value("hedge_won")
     t0 = time.perf_counter()
     out = f.fetch(("k",), jittery)
     wall = time.perf_counter() - t0
     assert out == "fast"
     assert f.hedges_fired == 1 and f.hedges_won == 1
     assert wall < 0.9  # did not wait out the stuck attempt
+    # Prometheus deltas (same observability posture as the solver's
+    # executor/breaker series) — deltas, not presence, so a regression in
+    # the metric emission cannot hide behind earlier tests' stale series
+    assert _series_value("fired") == fired0 + 1
+    assert _series_value("hedge_won") == won0 + 1
 
 
 def test_first_attempt_winning_after_hedge_is_fine():
@@ -130,3 +147,5 @@ def test_solve_path_respects_device_hedge_flag(monkeypatch):
     res = solve(universe_constraints(catalog), pods, catalog,
                 config=SolverConfig(device_min_pods=1, device_hedge=False))
     assert res.node_count >= 1 and not res.unschedulable
+
+
