@@ -193,10 +193,10 @@ class TestExecutorRouting:
 
 
 class TestHardwareEnvelope:
-    """Per-config envelopes pinned to the DRIVER's r4 capture — run on the
-    real backend only (KARPENTER_HW_ENVELOPE=1; CI forces CPU where the
-    numbers are meaningless). Failing this before a capture means a perf
-    regression shipped since the last round."""
+    """Per-config envelopes pinned to the most recent hardware capture for
+    each config — run on the real backend only (KARPENTER_HW_ENVELOPE=1;
+    CI forces CPU where the numbers are meaningless). Failing this before
+    a capture means a perf regression shipped since that capture."""
 
     def test_headline_p50_within_2x_of_r4_capture(self):
         import json
@@ -223,8 +223,47 @@ class TestHardwareEnvelope:
         times, _ = bench.config_4_headline()
         p50 = bench._stats(times)["p50_ms"]
         assert p50 < 2 * r4_p50, (
-            f"headline p50 {p50:.1f} ms exceeds 2x the r4 driver capture "
+            f"headline p50 {p50:.1f} ms exceeds 2x the r4 capture "
             f"({r4_p50:.1f} ms)")
+
+    def test_8192_bucket_p50_within_2x_of_r5_capture(self):
+        """The rewritten pallas kernel's 8192-shape performance (1.9 s p50,
+        BENCH_r05_builder.json config 6a) must not silently regress toward
+        its 9.5 s past."""
+        import json
+        import os
+
+        import pytest
+
+        if os.environ.get("KARPENTER_HW_ENVELOPE") != "1":
+            pytest.skip("hardware envelope runs only with "
+                        "KARPENTER_HW_ENVELOPE=1 on the real backend")
+        import jax
+
+        if jax.default_backend() != "tpu":
+            pytest.skip("needs the real TPU backend")
+        import bench
+
+        with open(os.path.join(os.path.dirname(bench.__file__),
+                               "BENCH_r05_builder.json")) as f:
+            r5 = json.load(f)
+        cfg = r5["extra"]["config_6_high_shape_cardinality"]
+        r5_p50 = cfg["device_8k_shapes"]["p50_ms"]
+        r5_auto_p50 = cfg["auto_25k_shapes"]["p50_ms"]
+        out = bench.config_6_high_cardinality()
+        assert "error" not in out["device_8k_shapes"], (
+            f"device path declined the 8k-shape problem — routing "
+            f"regression: {out['device_8k_shapes']}")
+        p50 = out["device_8k_shapes"]["p50_ms"]
+        assert p50 < 2 * r5_p50, (
+            f"8192-bucket p50 {p50:.0f} ms exceeds 2x the r5 capture "
+            f"({r5_p50:.0f} ms) — kernel regression")
+        # the 25k-shape half runs anyway inside config_6 — envelope it too
+        # (per-pod C++ auto-route, r5 capture 325.9 ms)
+        auto_p50 = out["auto_25k_shapes"]["p50_ms"]
+        assert auto_p50 < 2 * r5_auto_p50, (
+            f"25k-shape auto-routed p50 {auto_p50:.0f} ms exceeds 2x the "
+            f"r5 capture ({r5_auto_p50:.0f} ms)")
 
 
 class TestGcGuard:
