@@ -19,9 +19,6 @@ from karpenter_tpu.solver.host_ffd import (
     HostSolveResult, MAX_INSTANCE_TYPES, Packable, R_PODS, Vec,
 )
 
-# generous ceiling: every record packs ≥1 pod of some shape, and the
-# fast-forward collapses runs, so records ≤ shapes × types in practice
-_MAX_RECORDS_FACTOR = 4
 
 
 def solve_ffd_native(
@@ -51,14 +48,20 @@ def solve_ffd_native(
     totals = np.ascontiguousarray(enc.totals[:T], np.int64)
     reserved0 = np.ascontiguousarray(enc.reserved0[:T], np.int64)
 
-    # every record commits ≥1 pod, so pods+S bounds records; the S×T term
-    # is the old generous bound, kept for tiny problems
-    max_records = min(_MAX_RECORDS_FACTOR * S * max(T, 1),
-                      len(pod_vecs) + S) + 16
-    if max_records * S * 8 > 512 * 1024 * 1024:
-        # dense (records × S) output would not fit; the per-pod kernel's
-        # sparse ABI is the right executor at this cardinality
-        return None
+    # every record commits >=1 pod and every drop event consumes a shape,
+    # so pods + S is a TRUE upper bound on records. (A min() with an
+    # S*T-derived term used to sit here "for tiny problems" — at tiny
+    # S*T it became a CAP instead of a generosity: 227 pods over 2 shapes
+    # x 2 types need ~115 records but were capped at 32, so the kernel
+    # reported overflow and silently declined. Found by the 2,000-case
+    # fuzz soak, case 1897.) The dense (records x S) output buffer is
+    # clamped to a 512 MiB budget rather than declining upfront: the
+    # fast-forward keeps ACTUAL record counts far below the worst case,
+    # so the kernel usually fits the clamp — and if it genuinely doesn't,
+    # it reports overflow (-1) and the caller's ring falls back, same as
+    # any other decline.
+    budget_records = (512 * 1024 * 1024) // (S * 8)
+    max_records = min(len(pod_vecs) + S, budget_records) + 16
     out_chosen = np.zeros(max_records, np.int64)
     out_qty = np.zeros(max_records, np.int64)
     out_packed = np.zeros((max_records, S), np.int64)

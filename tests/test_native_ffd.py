@@ -146,3 +146,58 @@ class TestPerPodNativeOracle:
         want = host_ffd.pack(vecs, ids, packables)
         assert _result_key(got) == _result_key(want)
         assert got.unschedulable == [0]
+
+
+class TestRecordBufferBound:
+    """Fuzz-soak find (2,000-case run, case 1897): the shape-level C++
+    kernel's record buffer was capped by a min() with an S*T-derived term
+    that was meant as generosity for tiny problems but became a CAP — at
+    2 shapes x 2 types with 227 pods the solve needs ~115 records, the cap
+    allowed 32, the kernel reported overflow and silently declined
+    (production fell through to the per-pod ring; the shape-level executor
+    was just unavailable in a regime it should own). The bound is now
+    pods + S + slack under a memory-budget clamp. This test replays the
+    exact found case from the fuzz RNG stream and ASSERTS the regime still
+    holds, so retuning the fuzz pools cannot quietly turn it into a
+    generic parity check."""
+
+    def test_many_records_at_tiny_shape_type_cardinality(self):
+        import random
+
+        from karpenter_tpu.ops.encode import encode
+        from tests.test_fuzz_parity import (
+            _random_catalog, _random_daemons, _random_pods,
+        )
+
+        rng = random.Random(20260729)  # the fuzz seed
+        for case in range(1898):       # walk the stream to case 1897
+            catalog = _random_catalog(rng)
+            pods = _random_pods(rng)
+            daemons = _random_daemons(rng)
+        constraints = universe_constraints(catalog)
+        packables, _ = build_packables(catalog, constraints, pods, daemons)
+        vecs = [pod_vector(p) for p in pods]
+        ids = list(range(len(pods)))
+        # regime canary: node count exceeding the OLD min(4*S*T, pods+S)+16
+        # cap is what made case 1897 overflow (115 nodes vs cap 32; records
+        # <= nodes, and here the fast-forward collapsed almost nothing). If
+        # the fuzz pools are ever retuned, the RNG stream shifts and this
+        # trips instead of silently degrading into a generic parity check.
+        enc = encode(vecs, ids, packables, pad=False)
+        assert enc is not None
+        S, T = enc.num_shapes, enc.num_types
+        old_cap = min(4 * S * max(T, 1), len(pods) + S) + 16
+        oracle = host_ffd.pack(vecs, ids, packables)
+        total_nodes = sum(p.node_quantity for p in oracle.packings)
+        assert total_nodes > old_cap, (
+            f"fuzz pools retuned: case 1897 no longer exercises the "
+            f"record-cap regime ({total_nodes} nodes <= old cap "
+            f"{old_cap}) — re-derive the case or pin it literally")
+        got = solve_ffd_native(vecs, ids, packables)
+        assert got is not None, (
+            "shape-level kernel declined a tiny-S*T many-record problem "
+            "(record-buffer cap regression)")
+        key = lambda r: (r.node_count, sorted(r.unschedulable),
+                         sorted((tuple(p.instance_type_indices),
+                                 p.node_quantity) for p in r.packings))
+        assert key(got) == key(oracle)
