@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench native dryrun lint chart clean help
+.PHONY: test battletest bench bench-shapes native dryrun lint chart clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -14,6 +14,9 @@ battletest: ## Randomized order + full run (the reference's battletest analog)
 
 bench: ## Run the 5-config benchmark on the available accelerator
 	python bench.py
+
+bench-shapes: ## Shape-cardinality + type-SPMD configs only (compaction regime)
+	python bench.py --only config_6 config_8
 
 native: ## Build the C++ FFD kernel explicitly (normally built lazily)
 	g++ -O3 -std=c++17 -shared -fPIC \

@@ -262,12 +262,17 @@ def _kernel_breakdown(pods, catalog):
     from karpenter_tpu.models.ffd import device_args
     from karpenter_tpu.ops.encode import encode
     from karpenter_tpu.ops.pack import pack_chunk
-    from karpenter_tpu.ops.pack_pallas import pack_chunk_pallas
+    from karpenter_tpu.ops.pack_pallas import (
+        check_counts_within_div_cap, pack_chunk_pallas,
+    )
     from karpenter_tpu.solver.adapter import build_packables, pod_vector
 
     constraints = universe_constraints(catalog)
     packables, _ = build_packables(catalog, constraints, pods, [])
     enc = encode([pod_vector(p) for p in pods], list(range(len(pods))), packables)
+    # counts is still concrete: enforce the pallas DIV_CAP precondition
+    # before timing anything (a clamped kernel would bench garbage)
+    check_counts_within_div_cap(enc.counts)
     args = tuple(jax.device_put(device_args(enc)))
 
     @functools.partial(jax.jit, static_argnames=("which",))
@@ -694,13 +699,33 @@ def _persist_partial(extra):
         pass
 
 
+def _only_set():
+    """`bench.py --only config_6 config_8` → the KARPENTER_BENCH_ONLY env
+    (set in main, inherited by the supervisor's children): run only the
+    named configs. None = everything (the default full line)."""
+    raw = os.environ.get("KARPENTER_BENCH_ONLY", "").strip()
+    if not raw:
+        return None
+    return {t.strip() for t in raw.replace(",", " ").split() if t.strip()}
+
+
+def _selected(key: str, only) -> bool:
+    return only is None or any(key == o or key.startswith(o) for o in only)
+
+
 def run_all(degraded: bool, probe_note: str = ""):
     """Run the five configs; individual failures land in their slot, a
     headline failure propagates (main decides whether to re-exec degraded)."""
-    headline_times, c4 = config_4_headline()   # headline first: fail fast
+    only = _only_set()
+    if _selected("config_4_50k_pods_cost_minimizing", only):
+        headline_times, c4 = config_4_headline()   # headline first: fail fast
+    else:
+        headline_times, c4 = [], {"skipped": "not in --only"}
     extra = {"backend": _backend_name(), "degraded": degraded}
     if probe_note:
         extra["probe"] = probe_note
+    if only is not None:
+        extra["only"] = sorted(only)
     extra["config_4_50k_pods_cost_minimizing"] = c4
     extra["headline_times"] = [round(t, 6) for t in sorted(headline_times)]
     _persist_partial(extra)
@@ -713,6 +738,8 @@ def run_all(degraded: bool, probe_note: str = ""):
         ("config_7_control_plane_10k_pods", config_7_control_plane),
         ("config_8_large_catalog_type_spmd", config_8_large_catalog_type_spmd),
     ):
+        if not _selected(key, only):
+            continue
         try:
             extra[key] = fn()
         except Exception as e:  # ring 2: one config never kills the line
@@ -727,7 +754,8 @@ def run_all(degraded: bool, probe_note: str = ""):
     extra["hedged_fetches"] = {"fired": FETCHER.hedges_fired,
                                "won": FETCHER.hedges_won}
     _persist_partial(extra)  # keep the salvage path's checkpoint complete
-    return _metric_line(_stats(headline_times)["p99_ms"], extra)
+    p99 = _stats(headline_times)["p99_ms"] if headline_times else None
+    return _metric_line(p99, extra)
 
 
 def _metric_line(p99_ms, extra):
@@ -797,6 +825,19 @@ def _run_child(mode: str, deadline_s: float, probe_note: str,
 
 
 def main():
+    # `--only config_6 config_8`: restrict the run to the named configs.
+    # Carried in the environment so the supervisor's child processes (and
+    # their degraded re-execs) inherit the selection without re-parsing.
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--only":
+        if len(argv) < 2:
+            print("usage: bench.py [--only config_N ...]", file=sys.stderr)
+            return 2
+        os.environ["KARPENTER_BENCH_ONLY"] = " ".join(argv[1:])
+    elif argv:
+        print(f"unknown arguments {argv!r}; "
+              "usage: bench.py [--only config_N ...]", file=sys.stderr)
+        return 2
     mode = os.environ.get(_MODE_ENV)
     note = os.environ.get("KARPENTER_BENCH_NOTE", "")
     if mode == "direct":

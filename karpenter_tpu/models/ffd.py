@@ -83,6 +83,7 @@ def solve_ffd_device(
     enc: Optional[EncodedProblem] = None,  # precomputed (possibly unpadded)
     pallas_max_shapes: int = 8192,  # pallas-validated bucket ceiling
     hedge: bool = True,  # tail-mitigating second fetch (solver/hedge.py)
+    compact: bool = True,  # active-shape compaction at chunk boundaries
 ) -> Optional[HostSolveResult]:
     """Solve on device; None when the problem is not device-encodable
     (caller falls back to the host oracle). Pods may arrive unsorted; the
@@ -98,7 +99,14 @@ def solve_ffd_device(
     at high cardinality the chunked record fetches cost a round trip each).
 
     ``enc``: a precomputed encoding (padded or exact-size) so the solve
-    path pays the O(pods) dedupe + GCD scaling once across all rings."""
+    path pays the O(pods) dedupe + GCD scaling once across all rings.
+
+    ``compact``: gather the alive (counts > 0) shapes into a dense prefix
+    at every chunk boundary and re-bucket to the next power-of-two shape
+    bucket (ops/compact.py), so a solve that starts at the 8192+ bucket
+    runs its later chunks on the small-S kernel. Provably a no-op for the
+    packing result (docs/solver.md, "shape compaction & re-bucketing");
+    disable only to compare against the straight-line chunk loop."""
     import jax
 
     from karpenter_tpu.ops.encode import pad_encoding
@@ -178,21 +186,39 @@ def solve_ffd_device(
                                    cost_tiebreak=use_cost)
 
     S, L = enc.shapes.shape[0], chunk_iters
+    T_pad = enc.totals.shape[0]
     # one host→device transfer for the whole problem (tunnel-latency bound)
     dev = jax.device_put(device_args(enc))
-    shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit = dev
+    (shapes_d, counts_d, dropped_d, totals, reserved0, valid, last_valid,
+     pods_unit) = dev
 
-    # the per-chunk dispatch+fetch, optionally hedged: tunnel jitter puts
-    # occasional >200 ms spikes on an otherwise ~72 ms RTT-bound leg; the
-    # hedger re-issues the (deterministic) chunk when a fetch overruns its
-    # own recent wall time and takes whichever lands first
-    hedge_key = (kernel, S, enc.totals.shape[0], chunk_iters, use_cost)
+    # the fast-forward bound depends only on (shapes, totals, reserved0,
+    # valid) — all chunk-invariant — so it is computed ONCE per solve and
+    # passed into every chunk (sliced through compactions below); the
+    # type-spmd kernel computes its own sharded bound per chunk instead
+    # (one local reduce + pmax, no replicated extra input)
+    takes_maxfit = kernel in ("xla", "pallas")
+    maxfit_d = None
+    maxfit_full = np.zeros(S, np.int32)
+    if takes_maxfit:
+        from karpenter_tpu.ops.pack import compute_maxfit
 
-    def fetch_chunk(counts_now, dropped_now):
+        maxfit_d = jax.jit(compute_maxfit)(shapes_d, totals, reserved0,
+                                           valid)
+        maxfit_full = np.asarray(maxfit_d)
+
+    def fetch_chunk(shapes_now, counts_now, dropped_now, maxfit_now, S_now):
+        # the per-chunk dispatch+fetch, optionally hedged: tunnel jitter
+        # puts occasional >200 ms spikes on an otherwise ~72 ms RTT-bound
+        # leg; the hedger re-issues the (deterministic) chunk when a fetch
+        # overruns its own recent wall time and takes whichever lands first
+        hedge_key = (kernel, S_now, T_pad, chunk_iters, use_cost)
+
         def dispatch():
+            kw = {"maxfit": maxfit_now} if takes_maxfit else {}
             return np.asarray(_chunk(
-                shapes, counts_now, dropped_now, totals, reserved0, valid,
-                last_valid, pods_unit, num_iters=chunk_iters))
+                shapes_now, counts_now, dropped_now, totals, reserved0,
+                valid, last_valid, pods_unit, num_iters=chunk_iters, **kw))
 
         if not hedge:
             return dispatch()
@@ -200,29 +226,34 @@ def solve_ffd_device(
 
         return FETCHER.fetch(hedge_key, dispatch)
 
-    records = []  # (chosen, qty, packed-vector)
-    dropped_h = None
-    if S * L >= _PIPELINE_ELEMS:
-        # High-cardinality regime: the (L, S) record buffer is megabytes
-        # and the tunnel moves ~45 MB/s, so the fetch — not the kernel —
-        # bounds the wall time. Pipeline: keep the counts/dropped carry
-        # DEVICE-RESIDENT (sliced from the flat buffer, no host round-trip
-        # between chunks), speculatively dispatch chunk n+1, and overlap
-        # its compute with chunk n's async copy-out. A speculatively
-        # dispatched chunk after `done` is a no-op (the kernel's while
-        # loop exits immediately) and is never fetched. Hedging does not
-        # apply here — these fetches are bandwidth-bound, not jitter-bound
-        # (solver/hedge.py MAX_HEDGEABLE_WALL_S).
-        buf = _chunk(shapes, counts, dropped, totals, reserved0, valid,
-                     last_valid, pods_unit, num_iters=chunk_iters)
+    records = []  # (chosen, qty, packed-vector | sparse [(shape, n), ...])
+    if not compact and S * L >= _PIPELINE_ELEMS:
+        # High-cardinality regime with compaction disabled: the (L, S)
+        # record buffer is megabytes and the tunnel moves ~45 MB/s, so the
+        # fetch — not the kernel — bounds the wall time. Pipeline: keep
+        # the counts/dropped carry DEVICE-RESIDENT (sliced from the flat
+        # buffer, no host round-trip between chunks), speculatively
+        # dispatch chunk n+1, and overlap its compute with chunk n's async
+        # copy-out. A speculatively dispatched chunk after `done` is a
+        # no-op (the kernel's while loop exits immediately) and is never
+        # fetched. With compaction ON (the default) this path is skipped:
+        # shrinking S at each boundary cuts both the kernel and the fetch
+        # for every later chunk, which beats overlapping full-size ones.
+        # Hedging does not apply here — these fetches are bandwidth-bound,
+        # not jitter-bound (solver/hedge.py MAX_HEDGEABLE_WALL_S).
+        kw = {"maxfit": maxfit_d} if takes_maxfit else {}
+        buf = _chunk(shapes_d, counts_d, dropped_d, totals, reserved0,
+                     valid, last_valid, pods_unit, num_iters=chunk_iters,
+                     **kw)
+        dropped_h = None
         for _ in range(MAX_CHUNKS):
             try:
                 buf.copy_to_host_async()
             except Exception:
                 pass  # fetch below still works, just unoverlapped
             next_buf = _chunk(
-                shapes, buf[:S], buf[S:2 * S], totals, reserved0, valid,
-                last_valid, pods_unit, num_iters=chunk_iters)
+                shapes_d, buf[:S], buf[S:2 * S], totals, reserved0, valid,
+                last_valid, pods_unit, num_iters=chunk_iters, **kw)
             counts_h, dropped_h, done, chosen_h, q_h, packed_h = unpack_flat(
                 np.asarray(buf), S, L)
             for i in range(L):
@@ -234,22 +265,52 @@ def solve_ffd_device(
             buf = next_buf
         else:
             return None  # did not converge — impossible by construction
-    else:
-        for _ in range(MAX_CHUNKS):
-            # one device→host fetch per chunk; typical solves need one chunk
-            counts_h, dropped_h, done, chosen_h, q_h, packed_h = unpack_flat(
-                fetch_chunk(counts, dropped), S, L)
-            for i in range(L):
-                if q_h[i] > 0:
-                    records.append(
-                        (int(chosen_h[i]), int(q_h[i]), packed_h[i]))
-            if done:
-                break
-            counts, dropped = jax.device_put((counts_h, dropped_h))
-        else:
-            return None  # did not converge — impossible by construction
+        return _decode(enc, records, dropped_h, packables,
+                       max_instance_types)
 
-    return _decode(enc, records, dropped_h, packables, max_instance_types)
+    # Chunk loop with active-shape compaction at the boundaries
+    # (ops/compact.py): FFD consumes shapes in descending order, so the
+    # alive set shrinks front-to-back; once it fits a smaller power-of-two
+    # bucket, the remaining chunks run the small-S kernel. ``perm`` maps
+    # compacted rows back to original shape indices; ``dropped`` is passed
+    # to the kernel as zeros each chunk and the per-chunk delta is
+    # scattered into the original index space host-side.
+    from karpenter_tpu.ops.compact import (
+        compact_alive, scatter_dropped, sparse_record,
+    )
+
+    shapes_full = np.asarray(enc.shapes)
+    dropped_full = np.zeros(S, np.int64)
+    perm = None
+    S_cur = S
+    for _ in range(MAX_CHUNKS):
+        # one device→host fetch per chunk; typical solves need one chunk
+        counts_h, dropped_h, done, chosen_h, q_h, packed_h = unpack_flat(
+            fetch_chunk(shapes_d, counts_d, dropped_d, maxfit_d, S_cur),
+            S_cur, L)
+        for i in range(L):
+            if q_h[i] > 0:
+                rec = (packed_h[i] if perm is None
+                       else sparse_record(packed_h[i], perm))
+                records.append((int(chosen_h[i]), int(q_h[i]), rec))
+        scatter_dropped(dropped_full, dropped_h, perm)
+        if done:
+            break
+        c = (compact_alive(counts_h, perm, shapes_full, maxfit_full)
+             if compact else None)
+        if c is not None:
+            perm, S_cur = c.perm, c.num_shapes
+            shapes_d, counts_d, dropped_d = jax.device_put(
+                (c.shapes, c.counts, np.zeros(S_cur, np.int32)))
+            maxfit_d = (jax.device_put(c.maxfit) if takes_maxfit else None)
+        else:
+            counts_d, dropped_d = jax.device_put(
+                (counts_h, np.zeros_like(counts_h)))
+    else:
+        return None  # did not converge — impossible by construction
+
+    return _decode(enc, records, dropped_full, packables,
+                   max_instance_types)
 
 
 def solve_ffd_numpy(

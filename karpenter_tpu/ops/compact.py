@@ -1,0 +1,105 @@
+"""Active-shape compaction: re-bucket the alive shapes between chunks.
+
+FFD consumes shapes in descending order, so after the first committed
+nodes the overwhelming majority of a high-cardinality problem's shape rows
+have ``counts == 0`` — and a ``count == 0`` shape is a provable no-op in
+the kernel's ``one_shape`` step (``active`` is False, so ``k == 0`` and
+the ``reserved``/``stopped``/``npacked`` carry is untouched). Gathering
+the alive shapes into a dense prefix therefore cannot change any packing
+decision; it only lets the next chunk run the kernel compiled for a
+smaller static SHAPE_BUCKET. The gather is a stable ascending-index take
+(``np.flatnonzero``), which preserves the descending FFD visit order
+bit-for-bit — docs/solver.md ("shape compaction & re-bucketing") carries
+the full argument, including why the fast-forward bound survives:
+``maxfit`` depends only on (shapes, totals, reserved0, valid), so the
+compacted problem's bound is exactly ``maxfit_full[perm]``.
+
+The permutation ``perm`` maps compacted row → ORIGINAL (padded) shape
+index; the chunk loop uses it to decode ``packed`` record rows and
+``dropped`` deltas back to the original index space before
+models/ffd._decode materializes pod ids.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from karpenter_tpu.ops.encode import SHAPE_BUCKETS, bucket
+
+
+class Compaction(NamedTuple):
+    perm: np.ndarray      # (n_alive,) int64: compacted row → original index
+    shapes: np.ndarray    # (S_new, R) int32, alive prefix + zero padding
+    counts: np.ndarray    # (S_new,) int32
+    maxfit: np.ndarray    # (S_new,) int32 (padding rows irrelevant: k==0)
+    num_shapes: int       # S_new (the new, smaller bucket)
+
+
+def compact_alive(
+    counts_now: np.ndarray,        # (S_cur,) current chunk-boundary counts
+    perm: Optional[np.ndarray],    # current compaction, None = identity
+    shapes_full: np.ndarray,       # (S_orig, R) the ORIGINAL padded shapes
+    maxfit_full: np.ndarray,       # (S_orig,) the once-per-solve bound
+) -> Optional[Compaction]:
+    """Decide whether re-bucketing the alive shapes pays off; None when the
+    alive set still needs the current bucket (or no shapes remain alive —
+    the chunk loop is about to exit anyway)."""
+    S_cur = counts_now.shape[0]
+    alive = np.flatnonzero(counts_now > 0)  # ascending: stable, order-safe
+    if alive.size == 0:
+        return None
+    S_new = bucket(int(alive.size), SHAPE_BUCKETS)
+    if S_new is None or S_new >= S_cur:
+        return None
+    new_perm = alive if perm is None else perm[alive]
+    R = shapes_full.shape[1]
+    shapes_c = np.zeros((S_new, R), np.int32)
+    shapes_c[:alive.size] = shapes_full[new_perm]
+    counts_c = np.zeros((S_new,), np.int32)
+    counts_c[:alive.size] = counts_now[alive]
+    maxfit_c = np.zeros((S_new,), np.int32)
+    maxfit_c[:alive.size] = maxfit_full[new_perm]
+    return Compaction(new_perm, shapes_c, counts_c, maxfit_c, S_new)
+
+
+def sparse_record(packed_row: np.ndarray, perm: np.ndarray):
+    """A compacted ``packed`` record row → the sparse [(original_shape,
+    count), ...] form models/ffd._decode already accepts (the native
+    per-pod kernel's ABI). Padding rows past len(perm) are structurally
+    zero (count == 0 shapes pack nothing), so the slice is exact."""
+    row = np.asarray(packed_row[:perm.size])
+    return [(int(perm[s]), int(row[s])) for s in np.flatnonzero(row)]
+
+
+def compact_rows(counts_rows: np.ndarray, perms: list,
+                 shapes_full_rows: np.ndarray, S_new: int):
+    """Batched variant for solver/batch_solve.py: every problem row is
+    compacted to the SAME target bucket ``S_new`` (the batch tensors must
+    stay uniform; the caller picks the bucket of the LARGEST alive set).
+    ``perms`` holds one per-problem permutation (None = identity) and is
+    returned updated; rows past ``len(perms)`` are mesh padding (all-zero
+    counts) and compact to zero rows. ``shapes_full_rows`` is the ORIGINAL
+    (B, S_orig, R) host copy."""
+    Bpad, R = counts_rows.shape[0], shapes_full_rows.shape[2]
+    shapes_c = np.zeros((Bpad, S_new, R), np.int32)
+    counts_c = np.zeros((Bpad, S_new), np.int32)
+    new_perms = list(perms)
+    for b in range(len(perms)):
+        alive = np.flatnonzero(counts_rows[b] > 0)
+        perm_b = alive if perms[b] is None else perms[b][alive]
+        new_perms[b] = perm_b
+        shapes_c[b, :alive.size] = shapes_full_rows[b][perm_b]
+        counts_c[b, :alive.size] = counts_rows[b][alive]
+    return new_perms, shapes_c, counts_c
+
+
+def scatter_dropped(dropped_full: np.ndarray, dropped_delta: np.ndarray,
+                    perm: Optional[np.ndarray]) -> None:
+    """Accumulate a chunk's ``dropped`` delta (in the chunk's compacted
+    index space) into the original-index accumulator, in place."""
+    if perm is None:
+        dropped_full[:dropped_delta.shape[0]] += dropped_delta
+    else:
+        np.add.at(dropped_full, perm, dropped_delta[:perm.size])

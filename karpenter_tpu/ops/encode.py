@@ -25,10 +25,13 @@ INT32_LIMIT = 2**31 - 1
 
 # Pad shapes/types to these static sizes so XLA compiles one executable per
 # bucket pair instead of one per batch (SURVEY.md §7 "ragged shapes").
-# The 8192 bucket serves heterogeneous clusters (50k pods with thousands of
-# distinct request vectors); the kernel's shape scan is block-tiled
-# (ops/pack.py) so the longer sequential axis stays scan-overhead-efficient.
-SHAPE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+# The 8192+ buckets serve heterogeneous clusters (50k pods with thousands
+# of distinct request vectors); the kernel's shape walk is block-tiled and
+# early-terminating (ops/pack.py), and the chunk loop compacts the alive
+# shapes down to smaller buckets as FFD consumes them (ops/compact.py), so
+# the big buckets only price the FIRST chunks of a solve, not all of them.
+SHAPE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
+                 16384, 32768)
 # 2048/4096: the "catalog is large" regime the type-axis SPMD kernel
 # exists for (parallel/type_sharded.py) — a real cloud catalog with every
 # size × family × generation easily exceeds 1024 distinct types

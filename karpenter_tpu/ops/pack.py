@@ -64,6 +64,7 @@ def pack_chunk(
     num_iters: int,
     prices: jax.Array = None,      # (T,) int32 effective micro-$/h, optional
     cost_tiebreak: bool = False,
+    maxfit: jax.Array = None,      # (S,) int32, optional precomputed bound
 ):
     """Run up to ``num_iters`` node-packing iterations; host loops chunks
     until ``done``. Returns (counts, dropped, done, chosen[L], qty[L],
@@ -72,7 +73,12 @@ def pack_chunk(
     ``cost_tiebreak``: when several types achieve max-pods, pick the one
     with the lowest effective price (capacity order breaks price ties)
     instead of Go's smallest-capacity-first. Parity mode (default) ignores
-    ``prices`` entirely — Go semantics bit-for-bit."""
+    ``prices`` entirely — Go semantics bit-for-bit.
+
+    ``maxfit``: the fast-forward bound depends only on (shapes, totals,
+    reserved0, valid), all chunk-invariant, so callers that loop chunks
+    (models/ffd.solve_ffd_device) compute it once per solve and pass it in;
+    when omitted it is computed here, once per chunk."""
     S, R = shapes.shape
     T = totals.shape[0]
     pods_one = jnp.zeros((R,), jnp.int32).at[R_PODS].set(pods_unit)
@@ -81,15 +87,15 @@ def pack_chunk(
     # reservation (reserved only grows during a node pack). Fast-forward
     # validity needs counts to stay STRICTLY above this on every repeated
     # round — see the derivation in docs/solver.md.
-    maxfit = compute_maxfit(shapes, totals, reserved0, valid)  # (S,)
+    if maxfit is None:
+        maxfit = compute_maxfit(shapes, totals, reserved0, valid)  # (S,)
 
-    # Block-tile the sequential shape axis: scan over S/B blocks with B
-    # steps unrolled inside each. Semantics are identical (the shapes are
-    # still consumed strictly in order); the tiling only amortizes per-step
-    # scan overhead, which dominates at the large shape buckets. Every
+    # Block-tile the sequential shape axis: B shape steps unrolled per
+    # block. Semantics are identical (the shapes are still consumed
+    # strictly in order); the tiling only amortizes per-step loop
+    # overhead, which dominates at the large shape buckets. Every
     # SHAPE_BUCKET is a multiple of 8.
     BLK = 8 if S % 8 == 0 else 1
-    n_blocks = S // BLK
 
     def node_iter(carry, _):
         counts, dropped, done = carry
@@ -116,21 +122,46 @@ def pack_chunk(
             stopped = stopped | (failure & (full | (npacked == 0)))
             return (reserved, stopped, npacked), k
 
-        def block_step(c2, b):
+        # Two-level early-terminating walk over shape blocks. A dense scan
+        # over all S/BLK blocks pays the full shape axis on every node
+        # iteration, but at high cardinality almost all of it is provable
+        # no-ops: a count == 0 shape leaves one_shape's carry untouched
+        # (active=False → k=0), and once every type is stopped, so does
+        # every later shape. So the while_loop (a) starts at the block
+        # holding the largest remaining shape, (b) exits after the block
+        # holding the smallest remaining shape, and (c) exits as soon as
+        # ``stopped`` is all-true across types. k rows for skipped blocks
+        # stay 0, exactly what one_shape would have returned — the record
+        # stream is bit-for-bit identical to the dense scan's.
+        first_b = largest_idx // BLK
+        last_b = smallest_idx // BLK
+
+        def block_cond(state):
+            b, _, stopped, _, _ = state
+            return (b <= last_b) & ~jnp.all(stopped)
+
+        def block_body(state):
+            b, reserved, stopped, npacked, k_all = state
             base = b * BLK
             blk_shapes = jax.lax.dynamic_slice(shapes, (base, 0), (BLK, R))
             blk_counts = jax.lax.dynamic_slice(counts, (base,), (BLK,))
+            c2 = (reserved, stopped, npacked)
             ks = []
             for j in range(BLK):  # unrolled: one fused kernel per block
                 c2, k = one_shape(c2, blk_shapes[j], blk_counts[j])
                 ks.append(k)
-            return c2, jnp.stack(ks)  # (BLK, T)
+            k_all = jax.lax.dynamic_update_slice(k_all, jnp.stack(ks),
+                                                 (base, 0))
+            reserved, stopped, npacked = c2
+            return (b + 1, reserved, stopped, npacked, k_all)
 
-        # inits derive from inputs so varying-axis types line up under shard_map
-        init = (reserved0, ~valid, jnp.zeros_like(totals[:, 0]))
-        (_, _, npacked), k_blocks = jax.lax.scan(
-            block_step, init, jnp.arange(n_blocks))
-        k_all = k_blocks.reshape(S, T)
+        # inits derive from inputs so varying-axis types line up under
+        # shard_map; folding ``done`` into the stopped init makes node
+        # iterations after chunk completion cost O(T), not O(S·T)
+        init = (first_b, reserved0, ~valid | done,
+                jnp.zeros_like(totals[:, 0]), jnp.zeros((S, T), jnp.int32))
+        _, _, _, npacked, k_all = jax.lax.while_loop(
+            block_cond, block_body, init)
         # k_all: (S, T) pods of each shape packed per candidate type
 
         max_pods = npacked[last_valid]
@@ -181,7 +212,7 @@ def pack_chunk(
 @functools.partial(jax.jit, static_argnames=("num_iters", "cost_tiebreak"))
 def pack_chunk_flat(
     shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
-    num_iters: int, prices=None, cost_tiebreak: bool = False,
+    num_iters: int, prices=None, cost_tiebreak: bool = False, maxfit=None,
 ):
     """pack_chunk with all outputs flattened into ONE int32 buffer so a solve
     costs exactly one device→host fetch. The TPU here sits behind a tunnel
@@ -191,7 +222,7 @@ def pack_chunk_flat(
     return flatten_chunk_outputs(*pack_chunk(
         shapes, counts, dropped, totals, reserved0, valid, last_valid,
         pods_unit, num_iters=num_iters, prices=prices,
-        cost_tiebreak=cost_tiebreak))
+        cost_tiebreak=cost_tiebreak, maxfit=maxfit))
 
 
 def flatten_chunk_outputs(counts_f, dropped_f, done_f, chosen_seq, q_seq,

@@ -72,9 +72,10 @@ DIV_CAP = 1 << 18
 
 def _floordiv_small(a, b):
     """floor(a/b) for b >= 1: exact while the true quotient < DIV_CAP-2,
-    clamped (monotone, >= DIV_CAP-2) above. Negative ``a`` returns a value
-    <= 0 — the clip consumers treat it identically to the true negative
-    floor."""
+    clamped (monotone, >= DIV_CAP-2) above. Negative ``a`` returns exactly
+    -1 (the 0-clamped estimate plus one downward correction; NOT the true
+    floor, which may be more negative) — the clip consumers treat any
+    value <= -1 identically to the true negative floor."""
     qf = a.astype(jnp.float32) / b.astype(jnp.float32)
     q = jnp.minimum(qf, jnp.float32(DIV_CAP)).astype(jnp.int32)
     q = jnp.maximum(q, 0)
@@ -273,8 +274,9 @@ def _pack_kernel(
         # exact fast-forward (ops/pack.py, proof in docs/solver.md): every
         # packed shape must stay STRICTLY above maxfit through all repeats.
         # Negative numerators (count already at/below the bound) must yield
-        # a negative term so q stays 1 — _floordiv_small returns 0 for
-        # them, hence the explicit -1 branch.
+        # a negative term so q stays 1 — _floordiv_small returns -1 for
+        # them (not the true, possibly more negative floor), which would
+        # already suffice; the explicit -1 branch states the intent.
         numer = counts - maxfit_in[:] - 1
         terms = jnp.where(
             packed > 0,
@@ -329,6 +331,7 @@ def pack_chunk_pallas(
     interpret: bool = False,
     prices=None,               # (T,) int32 micro-$/h (models/ffd.encode_prices)
     cost_tiebreak: bool = False,
+    maxfit=None,               # (S,) int32 precomputed fast-forward bound
 ):
     """Same contract as ops.pack.pack_chunk (up to the junk-row caveat:
     iterations past `done` or with q == 0 report chosen=-1/q=0/packed=0
@@ -336,7 +339,19 @@ def pack_chunk_pallas(
     consume q > 0 rows). Re-layouts at the boundary (XLA-side, cheap): the
     kernel runs blocked (n_b, R, B) on the shape axis and (R, lanes) for
     capacity tensors. ``cost_tiebreak`` matches ops.pack.pack_chunk:
-    cheapest max-pods type wins, capacity order breaks price ties."""
+    cheapest max-pods type wins, capacity order breaks price ties.
+
+    PRECONDITION: every entry of ``counts`` must stay below ``DIV_CAP - 2``
+    (1 << 18, minus the two correction rounds) — the kernel's divisions are
+    exact float32 only while true quotients stay under that cap, and a
+    fast-forward quotient can reach the largest per-shape count. Callers
+    holding concrete counts should call ``check_counts_within_div_cap``;
+    the auto-router (models/ffd.py, solver/batch_solve.py) demotes such
+    problems to the XLA scan instead.
+
+    ``maxfit``: chunk-invariant fast-forward bound; passed in by chunk
+    loops that compute it once per solve (models/ffd.solve_ffd_device),
+    computed here (once per chunk) when omitted."""
     from karpenter_tpu.ops.pack import compute_maxfit
 
     S, R = shapes.shape
@@ -351,8 +366,9 @@ def pack_chunk_pallas(
     shapes32 = shapes.astype(jnp.int32)
     # [b, r, j] = shapes[b*B + j, r]
     shapes_blocked = shapes32.T.reshape(R, n_b, B).transpose(1, 0, 2)
-    maxfit = compute_maxfit(shapes32, totals.astype(jnp.int32),
-                            reserved0.astype(jnp.int32), valid)
+    if maxfit is None:
+        maxfit = compute_maxfit(shapes32, totals.astype(jnp.int32),
+                                reserved0.astype(jnp.int32), valid)
 
     outs = pl.pallas_call(
         functools.partial(_pack_kernel, cost_tiebreak=cost_tiebreak),
@@ -417,14 +433,33 @@ def pack_chunk_pallas_flat(
     interpret: bool = False,
     prices=None,
     cost_tiebreak: bool = False,
+    maxfit=None,
 ):
     """Flattened single-buffer variant in ops.pack's shared layout
     (flatten_chunk_outputs / unpack_flat) so a solve costs exactly one
     device→host fetch (see pack_chunk_flat's rationale — the tunnel RTT
-    dwarfs the kernel)."""
+    dwarfs the kernel). Same ``counts < DIV_CAP - 2`` precondition as
+    pack_chunk_pallas."""
     from karpenter_tpu.ops.pack import flatten_chunk_outputs
 
     return flatten_chunk_outputs(*pack_chunk_pallas(
         shapes, counts, dropped, totals, reserved0, valid,
         last_valid, pods_unit, num_iters=num_iters, interpret=interpret,
-        prices=prices, cost_tiebreak=cost_tiebreak))
+        prices=prices, cost_tiebreak=cost_tiebreak, maxfit=maxfit))
+
+
+def check_counts_within_div_cap(counts) -> None:
+    """Host-side guard for the DIV_CAP precondition, for call sites where
+    ``counts`` is still concrete (tests, bench, direct kernel users). The
+    jitted wrappers above only ever see tracers, so they cannot enforce
+    this themselves; the production routers (models/ffd.py,
+    solver/batch_solve.py) demote violating problems to the XLA scan
+    instead of raising."""
+    import numpy as np
+
+    m = int(np.asarray(counts).max(initial=0))
+    if m >= DIV_CAP - 2:
+        raise ValueError(
+            f"pack_chunk_pallas precondition violated: max per-shape count "
+            f"{m} >= DIV_CAP-2 ({DIV_CAP - 2}); the kernel's float32 "
+            f"division is only exact below that — route to the XLA scan")

@@ -1,0 +1,24 @@
+"""jax API compatibility for the parallel layer.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to a top-level
+``jax.shard_map`` export (and its replication-checking kwarg was renamed
+``check_rep`` → ``check_vma``) across the jax 0.4 → 0.5 series. The
+sharded kernels are written against the new-style API; this shim lets the
+same call sites run on either series.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_CHECK_KW: check_vma})

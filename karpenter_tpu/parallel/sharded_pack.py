@@ -18,10 +18,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from karpenter_tpu.ops.pack import pack_chunk, pack_chunk_flat, unpack_flat
+from karpenter_tpu.parallel.compat import shard_map
 
 
 def _pack_one_problem(shapes, counts, dropped, totals, reserved0, valid,
@@ -50,10 +50,14 @@ def pack_batch_sharded(
         functools.partial(_pack_one_problem, num_iters=num_iters),
         in_axes=(0, 0, 0, 0, 0, 0, 0, 0))
     spec = P("batch")
+    # check_vma=False: problems are independent per shard (nothing is
+    # claimed replicated), and the kernel's early-terminating inner
+    # while_loop (ops/pack.py) has no static replication rule
     return shard_map(
         vmapped, mesh=mesh,
         in_specs=(spec,) * 8,
         out_specs=(spec,) * 6,
+        check_vma=False,
     )(shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit)
 
 

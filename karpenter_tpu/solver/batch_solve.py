@@ -32,7 +32,8 @@ from karpenter_tpu.solver.adapter import (
 )
 from karpenter_tpu.solver import solve as solve_module
 from karpenter_tpu.solver.solve import (
-    SolveResult, SolverConfig, materialize, solve_with_packables,
+    SolveResult, SolverConfig, materialize, resolved_device_max_shapes,
+    solve_with_packables,
 )
 from karpenter_tpu.utils.gcguard import gc_deferred
 from karpenter_tpu.utils.profiling import trace
@@ -110,7 +111,8 @@ def _solve_batch(problems: Sequence[Problem],
             # same cardinality routing as the solo path (models/ffd.py:106):
             # beyond the largest device bucket the per-pod native kernel is
             # the built-for-it executor — keep such problems out of the batch
-            if enc is not None and enc.num_shapes <= config.device_max_shapes:
+            if enc is not None and \
+                    enc.num_shapes <= resolved_device_max_shapes(config):
                 penc = pad_encoding(enc)
                 if penc is not None:
                     batch_idx.append(i)
@@ -213,16 +215,17 @@ def _device_batch(encs, packables_list, prices_list, config: SolverConfig):
             if pr is not None:
                 prices_arr[b] = encode_prices(pr, T)
     # one transfer for the invariants (tunnel-latency bound, models/ffd.py)
-    shapes, totals, reserved0, valid, last_valid, pods_unit = jax.device_put(
+    shapes_host = shapes  # original (B, S, R) — compaction gathers from it
+    shapes_d, totals, reserved0, valid, last_valid, pods_unit = jax.device_put(
         (shapes, totals, reserved0, valid, last_valid, pods_unit))
     if prices_arr is not None:
         prices_arr = jax.device_put(prices_arr)
     counts_d, dropped_d = jax.device_put((counts, dropped))
 
-    def run(kern):
+    def run(kern, shapes_now, counts_now, dropped_now):
         def dispatch():
             return np.asarray(pack_batch_sharded_flat(
-                shapes, counts_d, dropped_d, totals, reserved0, valid,
+                shapes_now, counts_now, dropped_now, totals, reserved0, valid,
                 last_valid, pods_unit, num_iters=L, mesh=mesh,
                 kernel=kern, interpret=kern == "pallas" and not on_tpu,
                 prices=prices_arr, cost_tiebreak=use_cost))
@@ -233,35 +236,60 @@ def _device_batch(encs, packables_list, prices_list, config: SolverConfig):
         # fetch is equally tunnel-RTT-bound and equally deterministic
         from karpenter_tpu.solver.hedge import FETCHER
 
-        key = ("batch", kern, shapes.shape, totals.shape[1], L, use_cost)
+        key = ("batch", kern, shapes_now.shape, totals.shape[1], L, use_cost)
         return FETCHER.fetch(key, dispatch)
 
+    # batch-level active-shape compaction (ops/compact.py): the batch
+    # tensors must keep ONE static S, so chunk boundaries re-bucket to the
+    # bucket of the LARGEST alive set across problems. dropped is
+    # accumulated host-side per problem (each resume ships zero rows) so
+    # deltas scatter through each problem's permutation exactly.
+    from karpenter_tpu.ops.compact import (
+        compact_rows, scatter_dropped, sparse_record,
+    )
+    from karpenter_tpu.ops.encode import SHAPE_BUCKETS, bucket
+
     records: List[list] = [[] for _ in range(len(encs))]
-    dropped_rows = None
+    dropped_full = [np.zeros(S, np.int64) for _ in range(len(encs))]
+    perms: List[Optional[np.ndarray]] = [None] * len(encs)
+    S_cur = S
     for _ in range(MAX_CHUNKS):
         try:
-            buf = run(kernel)
+            buf = run(kernel, shapes_d, counts_d, dropped_d)
         except Exception:
             if kernel == "xla":
                 raise
             log.exception("pallas batch kernel failed; retrying with xla")
             kernel = "xla"
-            buf = run(kernel)
-        counts_f, dropped_f, done, chosen, q, packed = unpack_batch_flat(buf, S, L)
+            buf = run(kernel, shapes_d, counts_d, dropped_d)
+        counts_f, dropped_f, done, chosen, q, packed = unpack_batch_flat(
+            buf, S_cur, L)
         for b in range(len(encs)):
+            perm = perms[b]
             for i in range(L):
                 if q[b, i] > 0:
-                    records[b].append(
-                        (int(chosen[b, i]), int(q[b, i]), packed[b, i]))
-        dropped_rows = dropped_f
+                    rec = (packed[b, i] if perm is None
+                           else sparse_record(packed[b, i], perm))
+                    records[b].append((int(chosen[b, i]), int(q[b, i]), rec))
+            scatter_dropped(dropped_full[b], dropped_f[b], perm)
         if done.all():
             break
-        counts_d, dropped_d = jax.device_put((counts_f, dropped_f))
+        alive_max = int((counts_f > 0).sum(axis=1).max(initial=0))
+        S_new = bucket(max(alive_max, 1), SHAPE_BUCKETS)
+        if S_new is not None and S_new < S_cur:
+            perms, shapes_c, counts_c = compact_rows(
+                counts_f, perms, shapes_host, S_new)
+            S_cur = S_new
+            shapes_d, counts_d, dropped_d = jax.device_put(
+                (shapes_c, counts_c, np.zeros_like(counts_c)))
+        else:
+            counts_d, dropped_d = jax.device_put(
+                (counts_f, np.zeros_like(counts_f)))
     else:
         raise RuntimeError("batched solve did not converge")
 
     return [
-        _decode(enc, records[b], dropped_rows[b], packables_list[b],
+        _decode(enc, records[b], dropped_full[b], packables_list[b],
                 config.max_instance_types)
         for b, enc in enumerate(encs)
     ]

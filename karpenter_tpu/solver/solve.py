@@ -229,13 +229,14 @@ class SolverConfig:
     # (tens of ms over the transport vs sub-ms native solve); the native/
     # host executors answer instead — same result, differential-tested
     device_min_pods: int = 512
-    # above this many DISTINCT pod shapes the device path declines: the
-    # fast-forward rarely collapses nodes at high cardinality, so a solve
-    # needs many record chunks — each a tunnel round trip — while the
+    # above this many DISTINCT pod shapes the device path declines and the
     # per-pod C++ kernel (skip list + cpu-jump) answers in one host pass.
-    # The kernel itself supports up to the 8192-shape bucket; raise this on
-    # local-TPU deployments where the round trip is cheap.
-    device_max_shapes: int = 4096
+    # None = auto: 32768 (the largest shape bucket) when a real TPU
+    # backend answers — the two-level early-terminating scan plus
+    # active-shape compaction (ops/pack.py + ops/compact.py) keep the
+    # 8k–25k-shape regime on device — and 4096 elsewhere, where the
+    # kernels run on degraded CPU emulation and the native pass wins.
+    device_max_shapes: Optional[int] = None
     # largest shape bucket the fused pallas VMEM kernel is routed to;
     # requests above it take the block-tiled XLA scan. 8192 validated on
     # hardware r4: exact vs the per-pod C++ oracle at 5k and 8k distinct
@@ -259,6 +260,53 @@ class SolverConfig:
     # tunnel-jitter p99 reduction at the cost of one duplicate dispatch on
     # tail events only. Self-disables for cold compiles and long solves.
     device_hedge: bool = True
+    # auto-select the type-SPMD kernel (device_kernel=None) only when the
+    # padded type bucket reaches this size AND the mesh has more than one
+    # device: below it, the per-node collective round-trips cost more than
+    # the (T_local × S) fill they parallelize, and the single-device
+    # kernels win (BENCH config_8: the standard kernel beats a 1-device
+    # type-SPMD even at the 2048-type bucket). An explicit
+    # device_kernel="type-spmd" bypasses this gate.
+    type_spmd_min_types: int = 4096
+
+
+def resolved_device_max_shapes(config: SolverConfig) -> int:
+    """The effective shape-cardinality ceiling for the device ring.
+    Explicit settings win; the auto default keys off the backend: the
+    largest shape bucket (32768) on real TPU, where compaction + the
+    two-level scan keep high-cardinality solves in the hundreds of
+    milliseconds, and 4096 elsewhere (CPU emulation), where the native
+    per-pod C++ pass answers faster."""
+    if config.device_max_shapes is not None:
+        return config.device_max_shapes
+    from karpenter_tpu.models.ffd import default_kernel
+    from karpenter_tpu.ops.encode import SHAPE_BUCKETS
+
+    return SHAPE_BUCKETS[-1] if default_kernel() == "pallas" else 4096
+
+
+def _maybe_type_spmd(config: SolverConfig, enc) -> Optional[str]:
+    """Auto-router gate for the type-SPMD kernel: select it only where it
+    actually wins — a padded type bucket of at least type_spmd_min_types,
+    sharded across a REAL multi-device mesh that divides it. Everywhere
+    else None is returned and solve_ffd_device's default kernel applies
+    (its per-node decisions need no collectives at all)."""
+    if enc is None:
+        return None
+    from karpenter_tpu.ops.encode import TYPE_BUCKETS, bucket
+
+    t_pad = bucket(enc.num_types, TYPE_BUCKETS)
+    if t_pad is None or t_pad < config.type_spmd_min_types:
+        return None
+    try:
+        import jax
+
+        n = len(jax.devices())
+    except Exception:
+        return None
+    if n <= 1 or t_pad % n != 0:
+        return None
+    return "type-spmd"
 
 
 @dataclass
@@ -346,14 +394,19 @@ def solve_with_packables(
     t_ring = time.perf_counter()
     if config.use_device and len(pods) >= config.device_min_pods and \
             enc is not None and not _WATCHDOG.tripped():
+        # auto kernel routing: an explicit device_kernel always wins; with
+        # None, the type-SPMD gate may claim large-catalog problems on a
+        # multi-device mesh, else solve_ffd_device's default applies
+        kernel = config.device_kernel or _maybe_type_spmd(config, enc)
+
         def _device_solve():
             return solve_ffd_device(
                 pod_vecs, pod_ids, packables,
                 max_instance_types=config.max_instance_types,
                 chunk_iters=config.chunk_iters,
-                kernel=config.device_kernel,
+                kernel=kernel,
                 prices=prices, cost_tiebreak=prices is not None,
-                max_shapes=config.device_max_shapes, enc=enc,
+                max_shapes=resolved_device_max_shapes(config), enc=enc,
                 pallas_max_shapes=config.pallas_max_shapes,
                 hedge=config.device_hedge)
 

@@ -44,6 +44,7 @@ N_CASES = int(os.environ.get("KARPENTER_FUZZ_CASES", "150"))
 PALLAS_EVERY = 25          # pallas interpret is debug-speed; sample cases
 TYPE_SHARDED_EVERY = 20    # SPMD path recompiles per bucket pair; sample
 COST_EVERY = 10            # cost-mode differential on a sampled subset
+COMPACT_EVERY = 15         # chunk_iters=2 compaction stress on a subset
 
 
 def _type_sharded_signature(vecs, ids, packables, prices=None):
@@ -172,6 +173,7 @@ class TestExecutorQuartetFuzz:
         compared = 0
         pallas_checked = 0
         type_sharded_checked = 0
+        compact_checked = 0
         cost_checked = 0
         cost_pallas_checked = 0
         cost_ts_checked = 0
@@ -215,6 +217,20 @@ class TestExecutorQuartetFuzz:
                 assert result is not None, f"{ctx}: pallas returned None"
                 assert _signature(result, vecs) == oracle_sig, f"{ctx}: pallas"
                 pallas_checked += 1
+
+            # compaction stress: chunk_iters=2 maximizes chunk boundaries,
+            # so the alive-set re-bucketing + permutation decode path
+            # (ops/compact.py) runs dozens of times per case — any drift
+            # between compacted and original index spaces breaks the
+            # signature against the oracle
+            if compact_checked < compared // COMPACT_EVERY + 3 \
+                    and len(pods) >= 30:
+                result = solve_ffd_device(vecs, ids, packables,
+                                          kernel="xla", chunk_iters=2)
+                assert result is not None, f"{ctx}: compaction run None"
+                assert _signature(result, vecs) == oracle_sig, \
+                    f"{ctx}: chunk_iters=2 compaction"
+                compact_checked += 1
 
             if type_sharded_checked < compared // TYPE_SHARDED_EVERY + 3:
                 ts_result = _type_sharded_signature(vecs, ids, packables)
@@ -269,6 +285,7 @@ class TestExecutorQuartetFuzz:
         print(f"\nfuzz summary: {N_CASES} cases, {compared} quartet-compared, "
               f"{pallas_checked} pallas-checked, "
               f"{type_sharded_checked} type-sharded-checked, "
+              f"{compact_checked} compaction-checked, "
               f"{cost_checked} cost-compared "
               f"({cost_pallas_checked} pallas, {cost_ts_checked} type-spmd), "
               f"encode-fallback rate {rate:.1%}")
@@ -281,6 +298,7 @@ class TestExecutorQuartetFuzz:
             "adversarial pools need retuning")
         assert pallas_checked >= 3
         assert type_sharded_checked >= 3
+        assert compact_checked >= 3
         assert cost_checked >= 5
         assert cost_pallas_checked >= 3 and cost_ts_checked >= 3
 
@@ -384,3 +402,36 @@ class TestHighCardinalityAdversarial:
         full = solve(constraints, pods, catalog)
         assert full.node_count == oracle.node_count
         assert len(full.unschedulable) == len(oracle.unschedulable)
+
+    @pytest.mark.slow
+    def test_8k_shapes_device_xla_exact(self):
+        """The DEVICE path at the 8192 bucket (the tentpole regime):
+        two-level scan + chunk-boundary compaction must reproduce the host
+        oracle exactly. Slow-marked: ~7s of compile+solve on CPU — but that
+        is down from ~3 minutes per chunk before compaction (BENCH_r05
+        config_6), which is the point."""
+        rng = random.Random(11)
+        catalog = [
+            make_instance_type(
+                name=f"hc-{i}", cpu=str(2 ** (i + 1)),
+                memory=f"{2 ** (i + 2)}Gi", pods=str(30 * (i + 1)),
+                offerings=[Offering("on-demand", "test-zone-1")])
+            for i in range(6)
+        ]
+        constraints = universe_constraints(catalog)
+        shapes = set()
+        while len(shapes) < 8_100:
+            shapes.add((1000 + len(shapes) % 3000,
+                        64 + rng.randint(0, 4096)))
+        shapes = sorted(shapes)
+        pods = [_make_pod({"cpu": f"{c}m", "memory": f"{m}Mi"})
+                for i in range(8_300)
+                for c, m in (shapes[i % len(shapes)],)]
+        packables, _ = build_packables(catalog, constraints, pods, [])
+        vecs = [pod_vector(p) for p in pods]
+        ids = list(range(len(pods)))
+        oracle = host_ffd.pack(vecs, ids, packables)
+        device = solve_ffd_device(vecs, ids, packables, kernel="xla",
+                                  chunk_iters=256, max_shapes=8192)
+        assert device is not None, "8k-shape problem must stay on device"
+        assert self._signature_pp(device) == self._signature_pp(oracle)

@@ -35,6 +35,11 @@ def encode_pods(pods, catalog):
 def run_both(enc, num_iters=64):
     import jax.numpy as jnp
 
+    from karpenter_tpu.ops.pack_pallas import check_counts_within_div_cap
+
+    # counts is still concrete here — enforce the kernel's DIV_CAP
+    # precondition instead of silently comparing clamped outputs
+    check_counts_within_div_cap(enc.counts)
     args = (
         jnp.asarray(enc.shapes), jnp.asarray(enc.counts),
         jnp.zeros_like(jnp.asarray(enc.counts)), jnp.asarray(enc.totals),

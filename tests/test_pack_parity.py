@@ -129,6 +129,125 @@ class TestParitySmoke:
         assert_parity(host, device, 500)
 
 
+class TestCompaction:
+    """Active-shape compaction at chunk boundaries (ops/compact.py): the
+    alive set must actually re-bucket downward mid-solve, and the permuted
+    record stream must decode back to the exact host-oracle packing."""
+
+    @staticmethod
+    def _distinct_shape_pods(n):
+        # every pod a distinct shape: counts hit zero fast, so the alive
+        # set shrinks chunk over chunk
+        return [make_pod({"cpu": f"{100 + i}m",
+                          "memory": f"{64 + (i % 7)}Mi"}) for i in range(n)]
+
+    def test_mid_solve_compaction_exact(self, monkeypatch):
+        """chunk_iters=2 forces many chunk boundaries; a spy proves the
+        bucket actually shrinks and parity stays exact through the
+        permutation decode."""
+        from karpenter_tpu.ops import compact as compact_mod
+
+        events = []
+        orig = compact_mod.compact_alive
+
+        def spy(counts_now, perm, shapes_full, maxfit_full):
+            c = orig(counts_now, perm, shapes_full, maxfit_full)
+            if c is not None:
+                events.append((counts_now.shape[0], c.num_shapes))
+            return c
+
+        monkeypatch.setattr(compact_mod, "compact_alive", spy)
+        pods = self._distinct_shape_pods(300)
+        catalog = instance_types(10)
+        constraints = allow_all_constraints(catalog)
+        packables, _ = build_packables(catalog, constraints, pods, ())
+        vecs = [pod_vector(p) for p in pods]
+        ids = list(range(len(pods)))
+        host = host_ffd.pack(vecs, ids, packables)
+        device = solve_ffd_device(vecs, ids, packables, chunk_iters=2)
+        assert device is not None
+        assert events, "compaction never fired on a 512-bucket problem"
+        assert all(new < cur for cur, new in events)
+        assert_parity(host, device, len(pods))
+
+    def test_compact_off_matches_on(self):
+        pods = self._distinct_shape_pods(200)
+        catalog = instance_types(8)
+        constraints = allow_all_constraints(catalog)
+        packables, _ = build_packables(catalog, constraints, pods, ())
+        vecs = [pod_vector(p) for p in pods]
+        ids = list(range(len(pods)))
+        on = solve_ffd_device(vecs, ids, packables, chunk_iters=4)
+        off = solve_ffd_device(vecs, ids, packables, chunk_iters=4,
+                               compact=False)
+        assert on is not None and off is not None
+        assert on.node_count == off.node_count
+        key = lambda r: sorted(  # noqa: E731
+            (tuple(p.instance_type_indices), p.node_quantity,
+             tuple(sorted(tuple(sorted(n)) for n in p.pod_ids)))
+            for p in r.packings)
+        assert key(on) == key(off)
+        assert sorted(on.unschedulable) == sorted(off.unschedulable)
+
+    def test_permutation_round_trip(self):
+        """compact_alive/sparse_record/scatter_dropped unit round-trip:
+        perm always maps compacted rows to ORIGINAL indices, including
+        across a second-level compaction (perm composition)."""
+        import numpy as np
+
+        from karpenter_tpu.ops.compact import (
+            compact_alive, scatter_dropped, sparse_record,
+        )
+
+        rng = np.random.default_rng(0)
+        S = 64
+        counts = np.zeros(S, np.int32)
+        alive_idx = np.sort(rng.choice(S, size=9, replace=False))
+        counts[alive_idx] = rng.integers(1, 5, size=9).astype(np.int32)
+        shapes_full = rng.integers(1, 100, size=(S, 5)).astype(np.int32)
+        maxfit_full = rng.integers(0, 9, size=S).astype(np.int32)
+
+        c = compact_alive(counts, None, shapes_full, maxfit_full)
+        assert c is not None and c.num_shapes == 16  # 9 alive → bucket 16
+        assert np.array_equal(c.perm, alive_idx)  # ascending → order-stable
+        assert np.array_equal(c.shapes[:9], shapes_full[alive_idx])
+        assert np.array_equal(c.maxfit[:9], maxfit_full[alive_idx])
+        assert np.array_equal(c.counts[:9], counts[alive_idx])
+        assert not c.shapes[9:].any() and not c.counts[9:].any()
+
+        # sparse records land on ORIGINAL shape indices
+        packed = np.zeros(c.num_shapes, np.int32)
+        packed[2] = 3
+        assert sparse_record(packed, c.perm) == [(int(alive_idx[2]), 3)]
+
+        # dropped deltas scatter into the original accumulator
+        full = np.zeros(S, np.int64)
+        delta = np.zeros(c.num_shapes, np.int32)
+        delta[0] = 2
+        scatter_dropped(full, delta, c.perm)
+        assert full[alive_idx[0]] == 2 and full.sum() == 2
+
+        # second-level compaction composes permutations
+        counts2 = c.counts.copy()
+        counts2[[1, 3, 5, 6, 7, 8]] = 0  # 3 alive → bucket 8 < 16
+        c2 = compact_alive(counts2, c.perm, shapes_full, maxfit_full)
+        assert c2 is not None and c2.num_shapes == 8
+        assert np.array_equal(c2.perm, alive_idx[[0, 2, 4]])
+        assert np.array_equal(c2.shapes[:3], shapes_full[c2.perm])
+
+        # no-op cases: empty alive set, or bucket cannot shrink (8 is the
+        # smallest SHAPE_BUCKET)
+        assert compact_alive(np.zeros(S, np.int32), None,
+                             shapes_full, maxfit_full) is None
+        dense = np.ones(8, np.int32)
+        assert compact_alive(dense, None, shapes_full[:8],
+                             maxfit_full[:8]) is None
+        three = np.zeros(8, np.int32)
+        three[:3] = 1
+        assert compact_alive(three, None, shapes_full[:8],
+                             maxfit_full[:8]) is None
+
+
 class TestParityFuzz:
     @pytest.mark.parametrize("seed", range(12))
     def test_random_problems(self, seed):
