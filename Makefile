@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes native dryrun lint chart clean help
+.PHONY: test battletest bench bench-shapes native dryrun lint chart chaos-soak clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -46,6 +46,9 @@ soak: ## Extended differential soak: 500 fuzz cases + repeated chaos/races
 	python -m pytest tests/test_chaos.py tests/test_races.py -q --count=5 \
 		2>/dev/null || for i in 1 2 3 4 5; do \
 		python -m pytest tests/test_chaos.py tests/test_races.py -q; done
+
+chaos-soak: ## Seeded fault-injection soak (slow); prints seed, replay via KARPENTER_CHAOS_SEED=<n>
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -s -m slow
 
 cardinality-diff: ## One-off full-size 50k×25k-shape differential (hours)
 	python tools/full_cardinality_diff.py

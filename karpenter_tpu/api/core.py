@@ -347,7 +347,12 @@ class StorageClass:
 class PodDisruptionBudget:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: Optional[LabelSelector] = None
-    min_available: Optional[int] = None
+    # IntOrString, like the real API: an integer count or a percentage
+    # string ("50%") resolved against the PDB's expectedPods at eviction
+    # time (runtime/kubecore.py evict_pod). Setting both is the same
+    # misconfiguration it is upstream and 500s the eviction.
+    min_available: Optional[object] = None  # int | "N%"
+    max_unavailable: Optional[object] = None  # int | "N%"
     kind: str = "PodDisruptionBudget"
 
 

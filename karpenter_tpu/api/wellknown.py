@@ -30,6 +30,10 @@ DO_NOT_EVICT_ANNOTATION = KARPENTER_DOMAIN + "/do-not-evict"
 EMPTINESS_TIMESTAMP_ANNOTATION = KARPENTER_DOMAIN + "/emptiness-timestamp"
 TERMINATION_FINALIZER = KARPENTER_DOMAIN + "/termination"
 LABEL_CAPACITY_TYPE = KARPENTER_DOMAIN + "/capacity-type"
+# provider tag stamped atomically at launch (before any Node exists) so a
+# leaked instance is attributable to the exact launch that leaked it —
+# the GC controller logs it when terminating orphans
+LAUNCH_NONCE_TAG = KARPENTER_DOMAIN + "/launch-nonce"
 
 CAPACITY_TYPE_SPOT = "spot"
 CAPACITY_TYPE_ON_DEMAND = "on-demand"

@@ -1,0 +1,11 @@
+"""Deterministic fault injection for crash-safety testing.
+
+The :mod:`karpenter_tpu.chaos.inject` module holds a seeded
+:class:`~karpenter_tpu.chaos.inject.FaultPlan` plus thin shims for the three
+trust boundaries the control plane crosses — the kube apiserver
+(:class:`~karpenter_tpu.chaos.inject.ChaosKube`), the cloud SDK
+(:class:`~karpenter_tpu.chaos.inject.ChaosEC2`), and the device solver (a
+hook inside the solver watchdog). Production code only ever touches the
+module through :func:`~karpenter_tpu.chaos.inject.active_fault`, which is a
+single ``None`` check when no plan is installed.
+"""

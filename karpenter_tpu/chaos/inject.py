@@ -1,0 +1,364 @@
+"""Seeded, deterministic fault injection across the control plane's
+trust boundaries.
+
+A :class:`FaultPlan` compiles a list of :class:`FaultSpec` triples
+(boundary × op × fault kind, with a trigger count) into per-stream fire
+maps: for every call stream — a ``(boundary, op)`` pair such as
+``("provider", "create")`` or ``("kube", "patch")`` — the plan draws the
+call indices at which each fault fires from ``random.Random(seed)``.
+
+The determinism contract: **the decision for the N-th call of a stream is
+a pure function of (seed, specs)**. Concurrent controllers may interleave
+differently from run to run, which permutes *which concrete operation*
+lands on index N, but the sequence of fault decisions per stream — and
+therefore the number and kind of injected faults — is reproducible from
+the seed alone. That is what lets a chaos soak print one integer and be
+re-run bit-for-bit.
+
+Boundaries and the fault kinds their shims understand:
+
+========== ============== ==========================================
+boundary   op             kinds
+========== ============== ==========================================
+kube       create/update/ ``conflict`` (409 before the write lands),
+           patch/delete/  ``timeout`` (generic ApiError — request
+           bind_pods/     lost before the server applied it)
+           evict_pod
+kube       watch          ``drop`` (a Pod MODIFIED event vanishes;
+                          ADDED/DELETED and non-Pod kinds are never
+                          dropped — see :class:`_DroppingWatch`)
+provider   create         ``ice`` (launch refused), ``crash-before-
+                          bind`` (capacity launched, controller dies
+                          before the Node write — the GC leak case)
+ec2        create_fleet   ``ice``, ``throttle``, ``partial`` (one
+                          unit ICEs, the rest launch),
+                          ``crash-before-bind`` (fleet launched,
+                          response lost)
+device     solve          ``watchdog-trip`` (forced solver timeout →
+                          breaker opens → host-FFD fallback)
+========== ============== ==========================================
+
+Production call sites consult :func:`active_fault`; with no plan
+installed that is one global read and a ``None`` return.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import random
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger("karpenter.chaos")
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """``count`` triggers of ``kind`` on the ``(boundary, op)`` stream."""
+
+    boundary: str
+    op: str
+    kind: str
+    count: int = 1
+
+
+@dataclass(frozen=True)
+class FiredFault:
+    """One injection that actually happened (for post-soak assertions)."""
+
+    boundary: str
+    op: str
+    index: int
+    kind: str
+
+
+class FaultPlan:
+    """Compiled fault schedule; thread-safe; install with :func:`install`.
+
+    ``window`` bounds how deep into each stream faults may land: fire
+    indices are sampled from ``range(window)``, so a stream that receives
+    at least ``window`` calls is guaranteed to absorb every planned fault.
+    Keep it small relative to the soak's call volume (default 32) or tail
+    faults may never fire.
+    """
+
+    def __init__(self, seed: int, specs: List[FaultSpec], window: int = 32):
+        self.seed = seed
+        self.specs = list(specs)
+        self.window = window
+        self._lock = threading.Lock()
+        self._calls: Dict[Tuple[str, str], int] = {}
+        self._fired: List[FiredFault] = []
+        # compile: one shared RNG, specs consumed in list order, collisions
+        # within a stream avoided by sampling from the remaining indices —
+        # all deterministic given (seed, specs, window)
+        rng = random.Random(seed)
+        self._fire: Dict[Tuple[str, str], Dict[int, str]] = {}
+        free: Dict[Tuple[str, str], List[int]] = {}
+        for spec in self.specs:
+            if spec.count < 1:
+                continue
+            stream = (spec.boundary, spec.op)
+            pool = free.setdefault(stream, list(range(window)))
+            if spec.count > len(pool):
+                raise ValueError(
+                    f"stream {stream}: {spec.count} triggers do not fit in "
+                    f"the remaining window ({len(pool)} of {window} free)")
+            picked = rng.sample(pool, spec.count)
+            for idx in picked:
+                pool.remove(idx)
+                self._fire.setdefault(stream, {})[idx] = spec.kind
+
+    # -- decision -----------------------------------------------------------
+    def decide(self, boundary: str, op: str) -> Optional[str]:
+        """Advance the ``(boundary, op)`` counter and return the fault kind
+        planned for this index, if any."""
+        stream = (boundary, op)
+        with self._lock:
+            idx = self._calls.get(stream, 0)
+            self._calls[stream] = idx + 1
+            kind = self._fire.get(stream, {}).get(idx)
+            if kind is not None:
+                self._fired.append(FiredFault(boundary, op, idx, kind))
+        if kind is not None:
+            log.info("chaos: injecting %s at %s/%s call #%d",
+                     kind, boundary, op, idx)
+        return kind
+
+    # -- introspection (for soak assertions) --------------------------------
+    def fired(self) -> List[FiredFault]:
+        with self._lock:
+            return list(self._fired)
+
+    def fired_counts(self) -> Dict[Tuple[str, str, str], int]:
+        counts: Dict[Tuple[str, str, str], int] = {}
+        for f in self.fired():
+            key = (f.boundary, f.op, f.kind)
+            counts[key] = counts.get(key, 0) + 1
+        return counts
+
+    def calls(self, boundary: str, op: str) -> int:
+        with self._lock:
+            return self._calls.get((boundary, op), 0)
+
+    def pending(self) -> int:
+        """Planned triggers that have not fired yet (streams too short)."""
+        planned = sum(len(m) for m in self._fire.values())
+        with self._lock:
+            return planned - len(self._fired)
+
+
+# ---------------------------------------------------------------------------
+# Global hook — the only thing production code touches
+# ---------------------------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+
+
+def install(plan: FaultPlan) -> None:
+    global _PLAN
+    _PLAN = plan
+
+
+def uninstall() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def installed() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def active_fault(boundary: str, op: str) -> Optional[str]:
+    """Consult the installed plan; no plan → no fault, one global read."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    return plan.decide(boundary, op)
+
+
+# ---------------------------------------------------------------------------
+# Kube boundary shim
+# ---------------------------------------------------------------------------
+
+
+class _DroppingWatch:
+    """Queue proxy that consults the plan per Pod MODIFIED event and may
+    swallow it.
+
+    Only Pod MODIFIED is ever droppable: the selection controller re-
+    verifies every in-flight pod on a 5 s requeue, so a lost pod update is
+    recovered by level-triggered reconciliation. A dropped ADDED would lose
+    a pod forever (KubeCore has no re-list), and a dropped Node MODIFIED
+    could swallow a deletionTimestamp and wedge termination — neither is a
+    fault this codebase claims to survive, so the injector refuses to
+    create it.
+    """
+
+    def __init__(self, inner: "queue.Queue"):
+        self._inner = inner
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        while True:
+            event = self._inner.get(block=block, timeout=timeout)
+            obj = event.obj
+            if (event.type == "MODIFIED"
+                    and getattr(obj, "kind", "") == "Pod"
+                    and active_fault("kube", "watch") == "drop"):
+                continue
+            return event
+
+    def put(self, item, block: bool = True, timeout: Optional[float] = None):
+        self._inner.put(item, block=block, timeout=timeout)
+
+    def qsize(self) -> int:
+        return self._inner.qsize()
+
+    def empty(self) -> bool:
+        return self._inner.empty()
+
+
+class ChaosKube:
+    """KubeCore wrapper injecting apiserver-shaped failures on the write
+    path. Reads (get/scan/read/list) pass through untouched — the faults
+    modeled are lost/rejected writes and dropped watch events, which is
+    what an optimistic-concurrency control plane actually has to survive.
+
+    Injection happens BEFORE delegation: the request dies on the wire, the
+    server never applied it. That is the harder failure for callers (a
+    post-apply error would leave the write visible on the next read).
+    """
+
+    _FAULTED_OPS = ("create", "update", "patch", "delete",
+                    "bind_pods", "evict_pod")
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def _maybe_raise(self, op: str) -> None:
+        from karpenter_tpu.runtime.kubecore import ApiError, Conflict
+
+        kind = active_fault("kube", op)
+        if kind == "conflict":
+            raise Conflict(f"injected conflict on {op}")
+        if kind == "timeout":
+            raise ApiError(f"injected timeout on {op}")
+
+    def create(self, obj):
+        self._maybe_raise("create")
+        return self._inner.create(obj)
+
+    def update(self, obj):
+        self._maybe_raise("update")
+        return self._inner.update(obj)
+
+    def patch(self, kind, name, namespace, fn):
+        self._maybe_raise("patch")
+        return self._inner.patch(kind, name, namespace, fn)
+
+    def delete(self, kind, name, namespace="default", precondition_rv=None):
+        self._maybe_raise("delete")
+        return self._inner.delete(kind, name, namespace,
+                                  precondition_rv=precondition_rv)
+
+    def bind_pods(self, pods, node_name):
+        self._maybe_raise("bind_pods")
+        return self._inner.bind_pods(pods, node_name)
+
+    def evict_pod(self, name, namespace="default"):
+        self._maybe_raise("evict_pod")
+        return self._inner.evict_pod(name, namespace)
+
+    def watch(self, kind=None, meta_only=False):
+        return _DroppingWatch(self._inner.watch(kind, meta_only=meta_only))
+
+    def unwatch(self, q):
+        self._inner.unwatch(q._inner if isinstance(q, _DroppingWatch) else q)
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return getattr(self._inner, item)
+
+
+# ---------------------------------------------------------------------------
+# EC2 boundary shim
+# ---------------------------------------------------------------------------
+
+
+class ChaosEC2:
+    """EC2API wrapper injecting CreateFleet failure modes against the fake
+    (or any) EC2 implementation. Every other API passes through.
+
+    - ``ice``: the whole fleet is refused — the inner call never happens,
+      every override reports InsufficientInstanceCapacity, and the
+      provider's offering cache gets poisoned for all of them.
+    - ``throttle``: RequestLimitExceeded before the inner call — transient,
+      retried by the Retryer on the real client and surfaced as a launch
+      error on the fake.
+    - ``partial``: one unit of target capacity ICEs (first override), the
+      rest launch for real — the partial-fulfillment path end to end.
+    - ``crash-before-bind``: the inner CreateFleet RUNS — capacity exists
+      provider-side, tagged and attributable — then the response is lost.
+      The caller sees a failed launch; the instances are leaked until the
+      GC controller reaps them. This is the crash window the launch-nonce
+      tag exists for.
+    """
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def create_fleet(self, request):
+        from karpenter_tpu.cloudprovider.aws import sdk
+
+        kind = active_fault("ec2", "create_fleet")
+        if kind == "throttle":
+            raise sdk.EC2Error("RequestLimitExceeded",
+                               "injected CreateFleet throttle")
+        if kind == "ice":
+            return self._full_ice(request)
+        if kind == "partial":
+            first = next(
+                (o for c in request.launch_template_configs
+                 for o in c.overrides), None)
+            if first is not None and request.total_target_capacity > 1:
+                import copy
+
+                shrunk = copy.deepcopy(request)
+                shrunk.total_target_capacity -= 1
+                response = self._inner.create_fleet(shrunk)
+                response.errors.append(sdk.CreateFleetError(
+                    error_code=sdk.INSUFFICIENT_CAPACITY_ERROR_CODE,
+                    error_message="injected partial ICE",
+                    instance_type=first.instance_type,
+                    availability_zone=first.availability_zone))
+                return response
+            # single-unit fleet: a partial IS a full ICE
+            return self._full_ice(request)
+        if kind == "crash-before-bind":
+            self._inner.create_fleet(request)
+            raise sdk.EC2Error(
+                "RequestTimeout",
+                "injected connection loss after CreateFleet launched")
+        return self._inner.create_fleet(request)
+
+    @staticmethod
+    def _full_ice(request):
+        from karpenter_tpu.cloudprovider.aws import sdk
+
+        errors = [
+            sdk.CreateFleetError(
+                error_code=sdk.INSUFFICIENT_CAPACITY_ERROR_CODE,
+                error_message="injected full ICE",
+                instance_type=o.instance_type,
+                availability_zone=o.availability_zone)
+            for c in request.launch_template_configs for o in c.overrides
+        ]
+        return sdk.CreateFleetResponse(instance_ids=[], errors=errors)
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return getattr(self._inner, item)

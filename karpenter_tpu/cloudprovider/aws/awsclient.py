@@ -356,6 +356,21 @@ def parse_ec2_error(status: int, body: bytes) -> AwsApiError:
                        status)
 
 
+def _launch_unix(iso: str) -> float:
+    """EC2 launchTime (ISO8601 UTC, optional fractional seconds) → unix
+    seconds; 0.0 when absent/unparseable (reads as infinitely old, which
+    errs toward GC eligibility only after the grace window anyway)."""
+    if not iso:
+        return 0.0
+    import calendar
+
+    base = iso.split(".")[0].rstrip("Z")
+    try:
+        return float(calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S")))
+    except ValueError:
+        return 0.0
+
+
 def _tagset(el: Optional[ET.Element]) -> Dict[str, str]:
     tags = {}
     if el is not None:
@@ -571,16 +586,34 @@ class Ec2Client(sdk.EC2API):
         out = []
         for root in self._paged("DescribeInstances",
                                 {"InstanceId": list(instance_ids)}):
-            for item in root.findall(".//reservationSet/item/instancesSet/item"):
-                out.append(sdk.Instance(
-                    instance_id=_text(item.find("instanceId")),
-                    instance_type=_text(item.find("instanceType")),
-                    availability_zone=_text(item.find("placement/availabilityZone")),
-                    private_dns_name=_text(item.find("privateDnsName")),
-                    image_id=_text(item.find("imageId")),
-                    architecture=_text(item.find("architecture"), "x86_64"),
-                    spot_instance_request_id=_text(
-                        item.find("spotInstanceRequestId")) or None))
+            out.extend(self._parse_instances(root))
+        return out
+
+    def describe_instances_by_tags(
+            self, tag_filters: Dict[str, str]) -> List[sdk.Instance]:
+        params = {"Filter": self._tag_filters(tag_filters),
+                  "MaxResults": 1000}
+        out = []
+        for root in self._paged("DescribeInstances", params):
+            out.extend(self._parse_instances(root))
+        return out
+
+    @staticmethod
+    def _parse_instances(root: ET.Element) -> List[sdk.Instance]:
+        out = []
+        for item in root.findall(".//reservationSet/item/instancesSet/item"):
+            out.append(sdk.Instance(
+                instance_id=_text(item.find("instanceId")),
+                instance_type=_text(item.find("instanceType")),
+                availability_zone=_text(item.find("placement/availabilityZone")),
+                private_dns_name=_text(item.find("privateDnsName")),
+                image_id=_text(item.find("imageId")),
+                architecture=_text(item.find("architecture"), "x86_64"),
+                spot_instance_request_id=_text(
+                    item.find("spotInstanceRequestId")) or None,
+                tags=_tagset(item.find("tagSet")),
+                launch_time=_launch_unix(_text(item.find("launchTime"))),
+                state=_text(item.find("instanceState/name"), "running")))
         return out
 
     def terminate_instances(self, instance_ids: List[str]) -> None:

@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from karpenter_tpu.cloudprovider.aws import sdk
+from karpenter_tpu.utils import clock
 
 _counter = itertools.count(1)
 
@@ -218,6 +219,12 @@ class FakeEC2API(sdk.EC2API):
                     spot_instance_request_id=(
                         f"sir-{next(_counter):06d}"
                         if capacity_type == "spot" else None),
+                    # fleet TagSpecifications land on the instances (real
+                    # CreateFleet semantics) — the GC enumeration keys off
+                    # these; launch time reads the injectable clock so
+                    # grace-window tests can time-travel
+                    tags=dict(request.tags),
+                    launch_time=clock.now(),
                 )
                 self._instances[instance.instance_id] = instance
                 response.instance_ids.append(instance.instance_id)
@@ -240,6 +247,12 @@ class FakeEC2API(sdk.EC2API):
         if self.behavior.describe_instances_output is not None:
             return list(self.behavior.describe_instances_output)
         return [self._instances[i] for i in instance_ids if i in self._instances]
+
+    def describe_instances_by_tags(
+            self, tag_filters: Dict[str, str]) -> List[sdk.Instance]:
+        self._record("describe_instances_by_tags", dict(tag_filters))
+        return [i for i in self._instances.values()
+                if _matches(i.tags, tag_filters)]
 
     def terminate_instances(self, instance_ids: List[str]) -> None:
         self._record("terminate_instances", list(instance_ids))

@@ -92,12 +92,29 @@ class InstanceProvider:
 
     def terminate(self, node: Node) -> None:
         """Terminate by providerID; NotFound is success (instance.go:92-106)."""
-        instance_id = get_instance_id(node)
+        self.terminate_by_id(get_instance_id(node))
+
+    def terminate_by_id(self, instance_id: str) -> None:
+        """Terminate raw capacity with no Node to parse — the GC orphan
+        path. NotFound is success (already gone)."""
         try:
             self.ec2api.terminate_instances([instance_id])
         except sdk.EC2Error as e:
             if not e.is_not_found:
                 raise
+
+    # -- enumerate (upstream instance garbage collection) --------------------
+    LIVE_STATES = ("pending", "running")
+
+    def list_cluster_instances(self) -> List[sdk.Instance]:
+        """All live instances this cluster's launches created, enumerated by
+        the cluster ownership tag (DescribeInstances by tag filter, paged by
+        the client, retried by the shared Retryer). Terminated/shutting-down
+        instances are dropped here: they linger in DescribeInstances for up
+        to an hour and must not read as leaked capacity."""
+        described = self.ec2api.describe_instances_by_tags(
+            {f"kubernetes.io/cluster/{self.cluster_name}": "owned"})
+        return [i for i in described if i.state in self.LIVE_STATES]
 
     # -- launch (instance.go:108-149) ---------------------------------------
     def _launch_instances(
@@ -111,6 +128,11 @@ class InstanceProvider:
         capacity_type = self._get_capacity_type(constraints, instance_types)
         configs = self._launch_template_configs(
             constraints, provider, instance_types, capacity_type)
+        # the nonce tag rides the CreateFleet TagSpecification, so it is on
+        # the instances from birth — a crash anywhere after this call
+        # leaves capacity that list_instances() can enumerate and attribute
+        import uuid
+
         request = sdk.CreateFleetRequest(
             launch_template_configs=configs,
             total_target_capacity=quantity,
@@ -120,7 +142,8 @@ class InstanceProvider:
                 if capacity_type == CAPACITY_TYPE_SPOT else "lowest-price"),
             tags=merge_tags(
                 provisioner_name, provider.tags,
-                {f"kubernetes.io/cluster/{self.cluster_name}": "owned"}),
+                {f"kubernetes.io/cluster/{self.cluster_name}": "owned",
+                 wellknown.LAUNCH_NONCE_TAG: uuid.uuid4().hex}),
         )
         self.fleet_limiter.acquire()
         response = self.ec2api.create_fleet(request)

@@ -105,6 +105,30 @@ class AWSCloudProvider(CloudProvider):
             return f"terminating instance {node.metadata.name}, {e}"
         return None
 
+    def list_instances(self) -> List[spi.CapacityRecord]:
+        """Provider-side capacity enumeration for the GC controller:
+        DescribeInstances by the cluster ownership tag, converted to
+        CapacityRecords carrying the attribution tags stamped at launch."""
+        records = []
+        for inst in self.instance_provider.list_cluster_instances():
+            records.append(spi.CapacityRecord(
+                instance_id=inst.instance_id,
+                provisioner_name=inst.tags.get(
+                    wellknown.PROVISIONER_NAME_LABEL, ""),
+                launch_nonce=inst.tags.get(wellknown.LAUNCH_NONCE_TAG, ""),
+                created_unix=inst.launch_time,
+                zone=inst.availability_zone,
+                instance_type=inst.instance_type,
+            ))
+        return records
+
+    def delete_instance(self, instance_id: str) -> Optional[str]:
+        try:
+            self.instance_provider.terminate_by_id(instance_id)
+        except Exception as e:  # noqa: BLE001
+            return f"terminating instance {instance_id}, {e}"
+        return None
+
     def get_instance_types(self, constraints: Constraints) -> List[InstanceType]:
         """Full viable catalog; Requirements filtering happens in the solver's
         feasibility mask, not here (cloudprovider.go:133-140)."""

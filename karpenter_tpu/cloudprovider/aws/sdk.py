@@ -148,6 +148,14 @@ class Instance:
     image_id: str = ""
     architecture: str = "x86_64"
     spot_instance_request_id: Optional[str] = None
+    # garbage-collection fields: the tags CreateFleet stamped at launch
+    # (provisioner name + launch nonce), the launch time the grace window
+    # is measured against, and the lifecycle state (terminated instances
+    # still appear in DescribeInstances for ~an hour and must not read as
+    # live capacity)
+    tags: Dict[str, str] = field(default_factory=dict)
+    launch_time: float = 0.0
+    state: str = "running"  # pending | running | shutting-down | terminated
 
 
 # ---------------------------------------------------------------------------
@@ -189,6 +197,15 @@ class EC2API(abc.ABC):
     @abc.abstractmethod
     def describe_instances(self, instance_ids: List[str]) -> List[Instance]:
         ...
+
+    @abc.abstractmethod
+    def describe_instances_by_tags(
+            self, tag_filters: Dict[str, str]) -> List[Instance]:
+        """DescribeInstances with tag filters instead of ids — the
+        garbage-collection enumeration path (upstream's ListByTags). Same
+        '*'-means-tag-key-wildcard convention as describe_subnets. Paged to
+        exhaustion by implementations; includes non-running instances (the
+        caller filters by state)."""
 
     @abc.abstractmethod
     def terminate_instances(self, instance_ids: List[str]) -> None:

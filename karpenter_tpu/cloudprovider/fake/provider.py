@@ -10,13 +10,18 @@ from __future__ import annotations
 
 import itertools
 import threading
-from typing import List, Optional, Sequence
+import uuid
+from typing import Dict, List, Optional, Sequence
 
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.constraints import Constraints
 from karpenter_tpu.api.core import Node, NodeCondition, NodeSpec, NodeStatus, ObjectMeta
+from karpenter_tpu.chaos import inject
 from karpenter_tpu.cloudprovider import spi
-from karpenter_tpu.cloudprovider.spi import CloudProvider, InstanceType, Offering
+from karpenter_tpu.cloudprovider.spi import (
+    CapacityRecord, CloudProvider, InstanceType, Offering,
+)
+from karpenter_tpu.utils import clock
 from karpenter_tpu.utils.resources import Quantity, parse_resource_list
 
 _DEFAULT_OFFERINGS = [
@@ -101,10 +106,17 @@ class FakeCloudProvider(CloudProvider):
         # fault injection: zero-capacity (name, zone, capacity_type) triples,
         # analog of the AWS fake's InsufficientCapacityPools
         self.insufficient_capacity: set = set()
+        # provider-side capacity ledger: instance id (= node name) → record.
+        # Registered BEFORE bind runs, exactly like the AWS path's
+        # CreateFleet tags, so a crash between launch and node create
+        # leaves an enumerable, attributable orphan for the GC controller.
+        self._capacity: Dict[str, CapacityRecord] = {}
         self._lock = threading.Lock()
 
     def create(self, constraints, instance_types_, quantity, bind):
         errs: List[Optional[str]] = []
+        provisioner_name = constraints.labels.get(
+            wellknown.PROVISIONER_NAME_LABEL, "default")
         for _ in range(quantity):
             n = next(_name_counter)
             name = f"fake-node-{n}"
@@ -116,8 +128,28 @@ class FakeCloudProvider(CloudProvider):
                 if o.capacity_type in cts and o.zone in zones:
                     zone, capacity_type = o.zone, o.capacity_type
                     break
-            if (instance.name, zone, capacity_type) in self.insufficient_capacity:
+            # one fault draw per unit of capacity: ICE prevents the launch,
+            # crash-before-bind leaks it (see below)
+            fault = inject.active_fault("provider", "create")
+            if ((instance.name, zone, capacity_type) in self.insufficient_capacity
+                    or fault == "ice"):
                 errs.append(f"insufficient capacity for {instance.name} in {zone}")
+                continue
+            # capacity exists from this point on — the ledger entry is the
+            # fake analog of a launched EC2 instance
+            with self._lock:
+                self._capacity[name] = CapacityRecord(
+                    instance_id=name,
+                    provisioner_name=provisioner_name,
+                    launch_nonce=uuid.uuid4().hex,
+                    created_unix=clock.now(),
+                    zone=zone,
+                    instance_type=instance.name,
+                )
+            if fault == "crash-before-bind":
+                # controller dies between the launch and the node write:
+                # the instance above is now leaked until GC reaps it
+                errs.append(f"injected crash before bind of {name}")
                 continue
             node = Node(
                 metadata=ObjectMeta(
@@ -156,7 +188,22 @@ class FakeCloudProvider(CloudProvider):
     def delete(self, node: Node) -> Optional[str]:
         with self._lock:
             self.deleted.append(node.metadata.name)
+            # fake providerID is fake:///<instance-id>/<zone>; the instance
+            # id doubles as the node name
+            parts = (node.spec.provider_id or "").split("/")
+            instance_id = parts[3] if len(parts) > 3 else node.metadata.name
+            self._capacity.pop(instance_id, None)
         return None
+
+    def list_instances(self) -> List[CapacityRecord]:
+        with self._lock:
+            return list(self._capacity.values())
+
+    def delete_instance(self, instance_id: str) -> Optional[str]:
+        with self._lock:
+            if self._capacity.pop(instance_id, None) is not None:
+                self.deleted.append(instance_id)
+        return None  # not-found is success: the capacity is gone either way
 
     def get_instance_types(self, constraints: Constraints) -> List[InstanceType]:
         if self.catalog is not None:

@@ -49,6 +49,17 @@ class MeteredCloudProvider(CloudProvider):
         with self._timer("Delete"):
             return self._inner.delete(node)
 
+    def list_instances(self):
+        # GC enumeration latency matters operationally (a paged
+        # DescribeInstances sweep across a big cluster) — metered like the
+        # rest of the SPI surface
+        with self._timer("ListInstances"):
+            return self._inner.list_instances()
+
+    def delete_instance(self, instance_id: str) -> Optional[str]:
+        with self._timer("DeleteInstance"):
+            return self._inner.delete_instance(instance_id)
+
     def get_instance_types(self, constraints: Constraints) -> List[InstanceType]:
         with self._timer("GetInstanceTypes"):
             return self._inner.get_instance_types(constraints)

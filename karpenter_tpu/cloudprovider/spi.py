@@ -26,6 +26,32 @@ class Offering:
     zone: str
 
 
+@dataclass(frozen=True)
+class CapacityRecord:
+    """Provider-side view of one unit of live capacity, as enumerated by
+    :meth:`CloudProvider.list_instances`.
+
+    This is the raw material of crash recovery: the garbage-collection
+    controller (controllers/gc.py) cross-references these records against
+    Node objects to find capacity the control plane paid for but lost track
+    of (a crash between Create and the node write, a bind failure) and
+    Nodes whose backing capacity was terminated out-of-band.
+
+    ``instance_id`` must appear verbatim as a path segment of the
+    providerID the provider stamps on Nodes it creates (aws:///<zone>/<id>,
+    fake:///<id>/<zone>) — that containment is the ownership test GC uses.
+    ``launch_nonce`` is stamped as a provider tag at launch time, BEFORE
+    any node object exists, so an orphan is attributable to the launch
+    that leaked it."""
+
+    instance_id: str
+    provisioner_name: str = ""
+    launch_nonce: str = ""
+    created_unix: float = 0.0
+    zone: str = ""
+    instance_type: str = ""
+
+
 @dataclass
 class InstanceType:
     """Concrete instance type description (types.go:55-69).
@@ -75,6 +101,23 @@ class CloudProvider(abc.ABC):
     @abc.abstractmethod
     def get_instance_types(self, constraints: Constraints) -> List[InstanceType]:
         """The catalog viable for these constraints (cached by providers)."""
+
+    def list_instances(self) -> List[CapacityRecord]:
+        """Enumerate the provider-side capacity this control plane launched
+        (upstream Karpenter's DescribeInstances-by-tag garbage-collection
+        input). The default returns nothing, which degrades the GC
+        controller to a no-op for providers that cannot enumerate — it must
+        NEVER be implemented by returning a partial view, because records
+        missing here read as out-of-band terminations and get their Nodes
+        reaped."""
+        return []
+
+    def delete_instance(self, instance_id: str) -> Optional[str]:
+        """Terminate capacity by provider instance id — for orphans that
+        never got a Node object, where :meth:`delete` has nothing to work
+        from. NotFound-equivalent outcomes are success (the capacity is
+        gone either way). None means terminated."""
+        return f"provider {self.name()} cannot terminate by instance id"
 
     def default(self, constraints: Constraints) -> None:
         """Defaulting webhook hook (registry/register.go:25-31)."""

@@ -40,6 +40,10 @@ class Options:
     batch_max_items: int = 50_000
     # solver
     solver_use_device: bool = True
+    # capacity garbage collection (controllers/gc.py): sweep cadence and the
+    # both-directions grace window; 0 interval disables the controller
+    gc_interval_seconds: float = 120.0
+    gc_grace_seconds: float = 600.0
     # AWS provider (options.go:45-49)
     aws_node_name_convention: str = "ip-name"  # ip-name | resource-name
     aws_eni_limited_pod_density: bool = True
@@ -57,6 +61,8 @@ class Options:
                 errs.append(f"{name} out of range: {port}")
         if self.kube_backend not in ("memory", "in-cluster"):
             errs.append(f"kube-backend invalid: {self.kube_backend}")
+        if self.gc_interval_seconds < 0 or self.gc_grace_seconds < 0:
+            errs.append("gc-interval-seconds/gc-grace-seconds must be >= 0")
         if self.aws_node_name_convention not in ("ip-name", "resource-name"):
             errs.append(
                 f"aws-node-name-convention invalid: {self.aws_node_name_convention}")
@@ -108,6 +114,10 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                    default=_env("batch-max-items", defaults.batch_max_items))
     p.add_argument("--solver-use-device", action=argparse.BooleanOptionalAction,
                    default=_env("solver-use-device", defaults.solver_use_device))
+    p.add_argument("--gc-interval-seconds", type=float,
+                   default=_env("gc-interval-seconds", defaults.gc_interval_seconds))
+    p.add_argument("--gc-grace-seconds", type=float,
+                   default=_env("gc-grace-seconds", defaults.gc_grace_seconds))
     p.add_argument("--aws-node-name-convention",
                    choices=["ip-name", "resource-name"],
                    default=_env("aws-node-name-convention",

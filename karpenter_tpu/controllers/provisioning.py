@@ -21,7 +21,9 @@ from karpenter_tpu.api.provisioner import Provisioner, set_condition
 from karpenter_tpu.api.requirements import Requirements
 from karpenter_tpu.cloudprovider.spi import CloudProvider, InstanceType
 from karpenter_tpu.metrics.registry import HISTOGRAMS
-from karpenter_tpu.runtime.kubecore import AlreadyExists, KubeCore, NotFound
+from karpenter_tpu.runtime.kubecore import (
+    AlreadyExists, ApiError, KubeCore, NotFound,
+)
 from karpenter_tpu.scheduling.batcher import Batcher
 from karpenter_tpu.scheduling.scheduler import Scheduler
 from karpenter_tpu.solver.batch_solve import Problem, solve_batch
@@ -217,14 +219,35 @@ class ProvisionerWorker:
                 self.kube.create(node)
             except AlreadyExists:
                 pass  # self-registered first — idempotent (provisioner.go:177-186)
+            except ApiError as e:
+                # no Node object: the pods stay pending and re-enter the
+                # next batch; the launched capacity (if any) is the GC
+                # controller's problem, not silently orphaned state
+                return f"creating node object {node.metadata.name}: {e}"
             # one locked pass for the node's whole pod set (provisioner.go
             # binds sequentially; per-pod lock round-trips dominated the
             # 10k-pod flood on a contended host)
-            errs = self.kube.bind_pods(pods, node.metadata.name)
+            try:
+                errs = self.kube.bind_pods(pods, node.metadata.name)
+            except ApiError as e:
+                errs = [str(e)] * len(pods)
+            # an already-bound pod is success, not failure: informer-cache
+            # lag over the wire can re-batch a pod whose earlier bind
+            # landed, and treating that as an error would relaunch capacity
+            # for it every window until the cache catches up
+            errs = [e for e in errs
+                    if "already bound" not in e and "already exists" not in e]
             for e in errs:
                 log.error("failed to bind to %s: %s", node.metadata.name, e)
             log.info("bound %d pod(s) to node %s",
                      len(pods) - len(errs), node.metadata.name)
+            # propagate instead of swallowing: the joined error surfaces
+            # through CloudProvider.create → _launch → the provision loop's
+            # error log, and the unbound pods remain provisionable so the
+            # selection requeue / next batch retries them
+            if errs:
+                return (f"binding {len(errs)} pod(s) to "
+                        f"{node.metadata.name}: " + "; ".join(errs))
             return None
 
 

@@ -18,6 +18,7 @@ from karpenter_tpu.cloudprovider.fake import provider as _fake  # noqa: F401 —
 from karpenter_tpu.config.options import Options, parse
 from karpenter_tpu.controllers.consolidation import ConsolidationController
 from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.gc import GarbageCollection
 from karpenter_tpu.controllers.logging_config import LoggingConfigController
 from karpenter_tpu.controllers.metrics_controllers import (
     NodeMetricsController, PodMetricsController,
@@ -81,6 +82,11 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
     manager.register(TerminationController(kube, cloud_provider),
                      workers=adaptive_workers(10))
     manager.register(CounterController(kube))
+    if options.gc_interval_seconds > 0:
+        manager.register(GarbageCollection(
+            kube, cloud_provider,
+            interval_seconds=options.gc_interval_seconds,
+            grace_seconds=options.gc_grace_seconds))
     manager.register(ConsolidationController(kube))
     manager.register(PVCController(kube))
     manager.register(NodeMetricsController(kube))

@@ -26,6 +26,7 @@ import http.client
 import json
 import logging
 import queue
+import random
 import ssl
 import threading
 import time
@@ -50,6 +51,27 @@ class ResourceExpired(ApiError):
     resourceVersion fell out of the server's watch cache (the most common
     real-apiserver watch failure). Recovery = re-list + re-watch from the
     fresh resourceVersion; the watch loop does that immediately."""
+
+
+WATCH_BACKOFF_BASE_S = 1.0
+WATCH_BACKOFF_CAP_S = 30.0
+
+
+def _reconnect_delay(attempt: int, rand=None) -> float:
+    """Equal-jitter exponential backoff for watch reconnects: ceiling =
+    min(cap, base·2^(attempt−1)), delay uniform in [ceiling/2, ceiling].
+
+    A fixed 1 s pause meant every watcher of a crashed apiserver
+    reconnected in lockstep at 1 Hz forever — a reconnect stampede on
+    recovery and no deference during a long outage. Equal jitter (vs full
+    jitter's [0, ceiling]) keeps a floor of half the ceiling, so attempt 1
+    still retries within 0.5–1 s — a transient blip stays cheap — while a
+    persistent outage decays to ~15–30 s probes. The first successful
+    re-list resets the attempt counter. ``rand`` is injectable so tests
+    pin the jitter."""
+    ceiling = min(WATCH_BACKOFF_CAP_S,
+                  WATCH_BACKOFF_BASE_S * (2 ** max(0, attempt - 1)))
+    return (rand or random).uniform(ceiling / 2, ceiling)
 
 # kind → (api prefix, plural, cluster-scoped)
 ROUTES: Dict[str, Tuple[str, str, bool]] = {
@@ -706,9 +728,11 @@ class KubeApiClient:
 
     def _watch_loop(self, kind: str, q: "queue.Queue[Event]") -> None:
         path = self._collection(kind, None)
+        attempt = 0
         while self._watch_active(q):
             try:
                 raw_items, rv = self._list_pages(path, {})
+                attempt = 0  # fresh snapshot landed: the server is back
                 objs = [_decode(kind, item) for item in raw_items]
                 # feeder only: seed/refresh the read cache from the LIST
                 # snapshot and mark the kind cache-served (readers never
@@ -744,8 +768,11 @@ class KubeApiClient:
                 # events for the kind
                 if not self._watch_active(q):
                     return
-                log.debug("watch %s reconnecting: %s", kind, e)
-                self._watch_stop.wait(1.0)
+                attempt += 1
+                delay = _reconnect_delay(attempt)
+                log.debug("watch %s reconnecting in %.2fs (attempt %d): %s",
+                          kind, delay, attempt, e)
+                self._watch_stop.wait(delay)
 
     def _stream(self, kind: str, path: str, rv: str,
                 q: "queue.Queue[Event]") -> None:

@@ -62,6 +62,25 @@ class InternalError(ApiError):
     'found more than one PodDisruptionBudget' error)."""
 
 
+def _scaled_int_or_percent(value, expected: int, pdb_name: str) -> int:
+    """apimachinery's GetScaledValueFromIntOrPercent with roundUp=true:
+    integers pass through; "N%" resolves to ceil(N × expected / 100).
+    Anything else is a malformed PDB → 500 (server-side validation would
+    have rejected it; the in-memory store has no admission chain)."""
+    if isinstance(value, bool):  # bool is an int subclass; reject explicitly
+        raise InternalError(f"PDB {pdb_name}: invalid IntOrString {value!r}")
+    if isinstance(value, int):
+        return value
+    if isinstance(value, str) and value.endswith("%"):
+        try:
+            percent = int(value[:-1])
+        except ValueError:
+            raise InternalError(
+                f"PDB {pdb_name}: invalid percentage {value!r}")
+        return -((-percent * expected) // 100)  # ceil for non-negative
+    raise InternalError(f"PDB {pdb_name}: invalid IntOrString {value!r}")
+
+
 @dataclass
 class Event:
     type: str  # ADDED | MODIFIED | DELETED
@@ -423,11 +442,13 @@ class KubeCore:
         two sequential evictions against minAvailable=N cannot both pass by
         double-counting a half-gone pod.
 
-        Modeling note: ``min_available`` is supported as an INTEGER only.
-        The real API also accepts percentages ("50%") resolved against the
-        PDB's expectedPods; nothing in this codebase provisions percentage
-        PDBs, so that resolution (and maxUnavailable) is intentionally out
-        of scope here.
+        ``minAvailable`` and ``maxUnavailable`` are IntOrString, like the
+        real API: an integer count, or a percentage ("50%") resolved
+        against expectedPods — here the number of selector-matched pods in
+        the namespace — with the same round-up the apiserver applies
+        (GetScaledValueFromIntOrPercent, roundUp=true). maxUnavailable
+        translates to desiredHealthy = expectedPods − resolved. Setting
+        both on one PDB is the upstream validation error and 500s.
 
         Both the PDB lookup and the healthy count walk the namespace
         indexes (``_pdbs_by_namespace`` / ``_pods_by_namespace``) — this
@@ -448,15 +469,31 @@ class KubeCore:
                         f"pod {namespace}/{name}: found more than one "
                         f"PodDisruptionBudget ({len(matching)}) — "
                         "misconfigured")
-                if matching and matching[0].min_available is not None:
+                min_a = matching[0].min_available if matching else None
+                max_u = getattr(matching[0], "max_unavailable", None) \
+                    if matching else None
+                if min_a is not None and max_u is not None:
+                    raise InternalError(
+                        f"pod {namespace}/{name}: PDB "
+                        f"{matching[0].metadata.name} sets both minAvailable "
+                        "and maxUnavailable — misconfigured")
+                if min_a is not None or max_u is not None:
                     pdb = matching[0]
-                    healthy = 0
+                    expected = healthy = 0
                     for pk in self._pods_by_namespace.get(namespace, ()):
                         o = self._objects[pk]
+                        if not pdb.selector.matches(o.metadata.labels):
+                            continue
+                        expected += 1
                         if getattr(o.spec, "node_name", None) \
-                                and o.metadata.deletion_timestamp is None \
-                                and pdb.selector.matches(o.metadata.labels):
+                                and o.metadata.deletion_timestamp is None:
                             healthy += 1
+                    if min_a is not None:
+                        desired = _scaled_int_or_percent(
+                            min_a, expected, pdb.metadata.name)
+                    else:
+                        desired = expected - _scaled_int_or_percent(
+                            max_u, expected, pdb.metadata.name)
                     # the eviction only reduces the healthy count if the
                     # evicted pod is itself counted (scheduled and not
                     # already terminating): evicting an unscheduled or
@@ -464,11 +501,11 @@ class KubeCore:
                     loss = 1 if (getattr(pod.spec, "node_name", None)
                                  and pod.metadata.deletion_timestamp is None) \
                         else 0
-                    if healthy - loss < pdb.min_available:
+                    if healthy - loss < desired:
                         raise TooManyRequests(
                             f"pod {namespace}/{name}: eviction would "
                             f"violate PDB {pdb.metadata.name} "
-                            f"({healthy}/{pdb.min_available} available)")
+                            f"({healthy} healthy, {desired} required)")
             # delete INSIDE the lock (RLock re-entry): releasing between the
             # PDB check and the delete would let two concurrent evictions
             # both pass the check and jointly breach minAvailable

@@ -22,7 +22,9 @@ log = logging.getLogger("karpenter.manager")
 
 
 class Controller(Protocol):
-    def kind(self) -> str: ...
+    # None = no primary watch: the controller is time-driven and MUST
+    # provide seeds() (see below) or it will never reconcile.
+    def kind(self) -> Optional[str]: ...
 
     def reconcile(self, name: str, namespace: str = "default") -> Optional[float]: ...
 
@@ -31,6 +33,12 @@ class Controller(Protocol):
     # (e.g. node/controller.go:125-149 maps Pod and Provisioner events onto
     # node reconciles).
     # def mappings(self) -> List[Tuple[str, Callable]]: ...
+
+    # Optional: initial keys enqueued once at start — the controller-runtime
+    # "source.Func that fires at startup" pattern. A time-driven controller
+    # (e.g. the capacity GC sweep) seeds one synthetic key and keeps itself
+    # alive by returning a requeue interval from reconcile().
+    # def seeds(self) -> List[Tuple[str, str]]: ...
 
 
 class _WorkQueue:
@@ -130,9 +138,14 @@ class Manager:
         for controller, workers in self._controllers:
             wq = _WorkQueue()
             self._queues.append(wq)
-            # the primary pump only enqueues (name, namespace) keys, so it
-            # subscribes meta-only: no per-event deep copy (kubecore.MetaObj)
-            watch_q = self.kube.watch(controller.kind(), meta_only=True)
+            # initial synthetic keys (time-driven controllers; see Controller)
+            for item in getattr(controller, "seeds", lambda: [])():
+                wq.add(item)
+            watch_q = None
+            if controller.kind() is not None:
+                # the primary pump only enqueues (name, namespace) keys, so it
+                # subscribes meta-only: no per-event deep copy (kubecore.MetaObj)
+                watch_q = self.kube.watch(controller.kind(), meta_only=True)
 
             def pump(watch_q=watch_q, wq=wq):
                 while not self._stop.is_set():
@@ -223,13 +236,15 @@ class Manager:
                     if requeue is not None:
                         wq.add_after(item, requeue)
 
-            t = threading.Thread(target=pump, daemon=True,
-                                 name=f"pump-{controller.kind()}")
-            t.start()
-            self._threads.append(t)
+            cname = controller.kind() or type(controller).__name__
+            if watch_q is not None:
+                t = threading.Thread(target=pump, daemon=True,
+                                     name=f"pump-{cname}")
+                t.start()
+                self._threads.append(t)
             for i in range(workers):
                 t = threading.Thread(target=work, daemon=True,
-                                     name=f"work-{controller.kind()}-{i}")
+                                     name=f"work-{cname}-{i}")
                 t.start()
                 self._threads.append(t)
 

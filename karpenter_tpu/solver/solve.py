@@ -94,6 +94,20 @@ class _DeviceWatchdog:
         breaker-worthy."""
         from concurrent.futures import TimeoutError as FutureTimeout
 
+        from karpenter_tpu.chaos import inject
+
+        if inject.active_fault("device", "solve") == "watchdog-trip":
+            # forced trip: identical observable contract to a real hang —
+            # breaker opens for breaker_s, TimeoutError sends the caller
+            # down its fallback ring (native, then host FFD). The pool is
+            # left alone: no thread is actually wedged.
+            with self._lock:
+                self._open_until = time.monotonic() + breaker_s
+                _set_breaker_gauge(1)
+            log.error("device solve watchdog tripped by fault injection — "
+                      "circuit open for %.0fs", breaker_s)
+            raise TimeoutError("injected device watchdog trip")
+
         started = threading.Event()
 
         def wrapped():

@@ -14,6 +14,7 @@ then asserts global invariants rather than specific outcomes:
 - no pod is bound twice / no duplicate node names.
 """
 
+import os
 import random
 import threading
 import time
@@ -22,6 +23,7 @@ import pytest
 
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.provisioner import Provisioner
+from karpenter_tpu.chaos import inject
 from karpenter_tpu.cloudprovider.fake.provider import FakeCloudProvider, instance_types
 from karpenter_tpu.cloudprovider.metrics import decorate
 from karpenter_tpu.controllers.provisioning import ProvisioningController
@@ -32,6 +34,10 @@ from karpenter_tpu.scheduling.batcher import Batcher
 from tests.expectations import unschedulable_pod
 
 CHAOS_SECONDS = 6.0
+
+# One integer reproduces the whole fault sequence (inject.FaultPlan's
+# determinism contract); override to replay a failure from CI output.
+CHAOS_SEED = int(os.environ.get("KARPENTER_CHAOS_SEED", "20260805"))
 
 
 @pytest.fixture()
@@ -210,3 +216,350 @@ class TestMappingFaults:
             assert ctrl.map_calls >= 4
         finally:
             manager.stop()
+
+
+class TestFaultPlan:
+    """The determinism contract of chaos/inject.py: the N-th call of any
+    (boundary, op) stream gets the same decision on every run with the same
+    seed, regardless of how threads interleave the streams."""
+
+    SPECS = [
+        inject.FaultSpec("kube", "patch", "conflict", 3),
+        inject.FaultSpec("kube", "bind_pods", "timeout", 2),
+        inject.FaultSpec("provider", "create", "ice", 2),
+    ]
+    STREAMS = [("kube", "patch"), ("kube", "bind_pods"),
+               ("provider", "create")]
+
+    def _drain(self, plan, order):
+        """Exhaust every stream past the window in the given interleaving;
+        return the per-stream decision sequences."""
+        out = {s: [] for s in self.STREAMS}
+        for stream in order:
+            out[stream].append(plan.decide(*stream))
+        return out
+
+    def _round_robin(self, rounds=40):
+        return [s for _ in range(rounds) for s in self.STREAMS]
+
+    def test_same_seed_reproduces_the_sequence(self):
+        a = inject.FaultPlan(7, self.SPECS)
+        b = inject.FaultPlan(7, self.SPECS)
+        assert self._drain(a, self._round_robin()) == \
+            self._drain(b, self._round_robin())
+        assert a.fired_counts() == b.fired_counts()
+        assert sum(a.fired_counts().values()) == 7
+        assert a.pending() == 0
+
+    def test_different_seed_differs(self):
+        a = self._drain(inject.FaultPlan(1, self.SPECS), self._round_robin())
+        b = self._drain(inject.FaultPlan(2, self.SPECS), self._round_robin())
+        assert a != b
+
+    def test_interleaving_cannot_change_per_stream_decisions(self):
+        """Scrambling WHICH stream is polled when must not move any
+        stream's own fire indices — that is what makes the plan replayable
+        under thread nondeterminism."""
+        rr = self._drain(inject.FaultPlan(7, self.SPECS), self._round_robin())
+        scrambled_order = self._round_robin()
+        random.Random(99).shuffle(scrambled_order)
+        scrambled = self._drain(inject.FaultPlan(7, self.SPECS),
+                                scrambled_order)
+        assert rr == scrambled
+
+    def test_window_overflow_raises(self):
+        with pytest.raises(ValueError, match="do not fit"):
+            inject.FaultPlan(1, [
+                inject.FaultSpec("kube", "patch", "conflict", 5)], window=4)
+
+    def test_pending_counts_unfired_triggers(self):
+        plan = inject.FaultPlan(3, [
+            inject.FaultSpec("kube", "patch", "conflict", 2)], window=8)
+        assert plan.pending() == 2
+        for _ in range(8):
+            plan.decide("kube", "patch")
+        assert plan.pending() == 0
+        assert plan.calls("kube", "patch") == 8
+
+
+class TestDeviceFault:
+    def test_injected_watchdog_trip_opens_breaker_and_falls_back(
+            self, monkeypatch):
+        """A planned device fault must behave exactly like a real hung
+        transport: breaker opens, the host rings answer, the result is
+        unchanged."""
+        from karpenter_tpu.controllers.provisioning import universe_constraints
+        from karpenter_tpu.solver import solve as solve_mod
+        from karpenter_tpu.solver.solve import SolverConfig, solve
+
+        wd = solve_mod._DeviceWatchdog()
+        monkeypatch.setattr(solve_mod, "_WATCHDOG", wd)
+        catalog = instance_types(6)
+        constraints = universe_constraints(catalog)
+        pods = [unschedulable_pod(requests={"cpu": "500m", "memory": "256Mi"})
+                for _ in range(40)]
+        want = solve(constraints, pods, catalog,
+                     config=SolverConfig(use_device=False))
+
+        plan = inject.FaultPlan(11, [
+            inject.FaultSpec("device", "solve", "watchdog-trip", 1)],
+            window=1)
+        inject.install(plan)
+        try:
+            got = solve(constraints, pods, catalog, config=SolverConfig(
+                device_min_pods=1, device_timeout_s=5.0,
+                device_breaker_seconds=60.0))
+        finally:
+            inject.uninstall()
+        assert got.node_count == want.node_count
+        assert wd.tripped(), "injected trip did not open the breaker"
+        assert plan.fired_counts() == {
+            ("device", "solve", "watchdog-trip"): 1}
+        # success on a later solve closes the breaker again (half-open probe)
+        wd._open_until = 0.0
+        solve(constraints, pods, catalog,
+              config=SolverConfig(use_device=False))
+        assert not wd.tripped()
+
+
+class TestPartialFleet:
+    def test_partial_fulfillment_poisons_offering_and_next_loop_resolves(self):
+        """Satellite of the GC tentpole: one unit of a two-node CreateFleet
+        ICEs. The launched unit binds; the ICE'd offering lands in the
+        45 s unavailable cache; the NEXT provisioning pass re-solves with
+        that offering excluded and places the leftover pod in another zone
+        — the instancetypes unavailable-TTL path end to end, driven through
+        the real ProvisionerWorker hot loop."""
+        from karpenter_tpu.api.constraints import Constraints
+        from karpenter_tpu.api.core import NodeSelectorRequirement as Req
+        from karpenter_tpu.api.requirements import Requirements
+        from karpenter_tpu.cloudprovider.aws.fake import FakeEC2API, FakeSSMAPI
+        from karpenter_tpu.cloudprovider.aws.provider import AWSCloudProvider
+        from karpenter_tpu.controllers.provisioning import (
+            ProvisionerWorker, global_requirements,
+        )
+
+        kube = KubeCore()
+        ec2 = FakeEC2API()
+        provider = AWSCloudProvider(
+            ec2, FakeSSMAPI(), cluster_name="test-cluster",
+            cluster_endpoint="https://test-cluster",
+            describe_retry_delay=0.0)
+        provider.instance_provider.ec2api = inject.ChaosEC2(ec2)
+
+        prov = Provisioner()
+        prov.metadata.name = "partial"
+        prov.spec.constraints = Constraints(
+            labels={wellknown.PROVISIONER_NAME_LABEL: "partial"},
+            requirements=Requirements([
+                Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In",
+                    values=["test-zone-1a", "test-zone-1b", "test-zone-1c"]),
+                Req(key=wellknown.LABEL_INSTANCE_TYPE, operator="In",
+                    values=["t3.large"]),
+                Req(key=wellknown.LABEL_CAPACITY_TYPE, operator="In",
+                    values=["on-demand"]),
+            ]),
+            provider={
+                "instanceProfile": "test-instance-profile",
+                "subnetSelector": {"Name": "*"},
+                "securityGroupSelector": {"Name": "*"},
+            },
+        )
+        # the universe injection the provisioning controller performs before
+        # handing constraints to a worker (controller.go:141-162): the solver
+        # treats unconstrained arch/OS as "nothing allowed"
+        prov.spec.constraints.requirements = (
+            prov.spec.constraints.requirements.add(*global_requirements(
+                provider.get_instance_types(prov.spec.constraints)).items))
+        kube.create(prov)
+        worker = ProvisionerWorker(
+            prov, kube, provider,
+            batcher=Batcher(idle_seconds=0.05, max_seconds=0.5))
+
+        # 1500m each on a 2-vCPU type: one pod per node, so the batch needs
+        # a two-unit fleet — the shape a partial fulfillment can split
+        pods = [unschedulable_pod(requests={"cpu": "1500m", "memory": "1Gi"},
+                                  name=f"partial-{i}") for i in range(2)]
+        for p in pods:
+            kube.create(p)
+            worker.add(p, key=(p.metadata.namespace, p.metadata.name))
+
+        inject.install(inject.FaultPlan(5, [
+            inject.FaultSpec("ec2", "create_fleet", "partial", 1)],
+            window=1))
+        try:
+            worker.provision()
+        finally:
+            inject.uninstall()
+
+        bound = {p.metadata.name: kube.get(
+            "Pod", p.metadata.name).spec.node_name for p in pods}
+        placed = [n for n in bound.values() if n]
+        assert len(placed) == 1, f"expected exactly one bound pod: {bound}"
+        first_zone = kube.get("Node", placed[0], "").metadata.labels[
+            wellknown.LABEL_TOPOLOGY_ZONE]
+
+        # the ICE'd offering — that (capacityType, zone) pair, not the whole
+        # zone — is gone from the catalog for the TTL window
+        catalog = provider.get_instance_types(prov.spec.constraints)
+        t3 = next(it for it in catalog if it.name == "t3.large")
+        assert ("on-demand", first_zone) not in {
+            (o.capacity_type, o.zone) for o in t3.offerings}, (
+            "ICE'd offering still in the catalog — unavailable cache "
+            "not poisoned")
+
+        # next loop: the leftover pod re-solves around the poisoned offering
+        leftover = next(p for p in pods if not bound[p.metadata.name])
+        worker.add(leftover,
+                   key=(leftover.metadata.namespace, leftover.metadata.name))
+        worker.provision()
+        second_node = kube.get("Pod", leftover.metadata.name).spec.node_name
+        assert second_node, "leftover pod never re-provisioned"
+        second_zone = kube.get("Node", second_node, "").metadata.labels[
+            wellknown.LABEL_TOPOLOGY_ZONE]
+        assert second_zone != first_zone, (
+            "re-solve placed capacity in the zone the cache marked "
+            "unavailable")
+
+
+# ---------------------------------------------------------------------------
+# Seeded fault-plan soak: the full manager stack over ChaosKube + GC
+# ---------------------------------------------------------------------------
+
+SMOKE_SPECS = [
+    inject.FaultSpec("kube", "create", "conflict", 1),
+    inject.FaultSpec("kube", "bind_pods", "timeout", 1),
+    inject.FaultSpec("kube", "watch", "drop", 1),
+    inject.FaultSpec("provider", "create", "ice", 1),
+    inject.FaultSpec("provider", "create", "crash-before-bind", 1),
+]
+
+SOAK_SPECS = [
+    inject.FaultSpec("kube", "create", "conflict", 2),
+    inject.FaultSpec("kube", "create", "timeout", 1),
+    inject.FaultSpec("kube", "patch", "conflict", 2),
+    inject.FaultSpec("kube", "bind_pods", "timeout", 2),
+    inject.FaultSpec("kube", "delete", "timeout", 1),
+    inject.FaultSpec("kube", "watch", "drop", 3),
+    inject.FaultSpec("provider", "create", "ice", 2),
+    inject.FaultSpec("provider", "create", "crash-before-bind", 2),
+]
+
+
+def _run_faulted_soak(specs, window, pods_total, burst_gap_s, settle_s,
+                      seed=CHAOS_SEED):
+    """Drive the full controller stack behind ChaosKube with a seeded
+    FaultPlan and a fast-interval GC controller, then assert the crash-safe
+    invariants: every surviving provisionable pod binds, leaked capacity
+    converges to zero, no capacity-less Node persists, and the control
+    plane stays healthy. Prints the seed so any failure replays exactly
+    (KARPENTER_CHAOS_SEED)."""
+    import functools
+
+    from karpenter_tpu.controllers.counter import CounterController
+    from karpenter_tpu.controllers.gc import GarbageCollection
+    from karpenter_tpu.controllers.node import NodeController
+    from karpenter_tpu.controllers.termination import TerminationController
+
+    print(f"chaos soak: seed={seed} "
+          "(replay with KARPENTER_CHAOS_SEED=<seed>)")
+    core = KubeCore()
+    kube = inject.ChaosKube(core)
+    provider = decorate(FakeCloudProvider(catalog=instance_types(8)))
+    plan = inject.FaultPlan(seed, specs, window=window)
+    provisioning = ProvisioningController(
+        kube, provider,
+        batcher_factory=functools.partial(
+            Batcher, idle_seconds=0.05, max_seconds=0.5))
+    manager = Manager(kube)
+    manager.register(provisioning, workers=2)
+    manager.register(SelectionController(kube, provisioning), workers=16)
+    manager.register(NodeController(kube), workers=4)
+    manager.register(TerminationController(kube, provider), workers=4)
+    manager.register(CounterController(kube))
+    # wall-clock grace: leak-to-reap latency in the soak. Far above the
+    # ms-scale launch→bind window of the fake provider, far below settle_s.
+    manager.register(GarbageCollection(kube, provider,
+                                       interval_seconds=0.25,
+                                       grace_seconds=2.0))
+    prov = Provisioner()
+    prov.metadata.name = "chaos"
+    core.create(prov)  # driver setup bypasses injection; faults start below
+
+    inject.install(plan)
+    manager.start()
+    rng = random.Random(seed)
+    created = []
+    try:
+        for i in range(pods_total):
+            pod = unschedulable_pod(
+                requests={"cpu": f"{rng.choice([100, 500, 1500])}m",
+                          "memory": f"{rng.choice([64, 512])}Mi"},
+                name=f"soak-{i}")
+            try:
+                kube.create(pod)
+            except Exception:
+                continue  # injected fault: the request died on the wire
+            created.append(pod.metadata.name)
+            time.sleep(burst_gap_s)
+
+        deadline = time.monotonic() + settle_s
+        unbound, leaked, ghosts = created, [], []
+        while time.monotonic() < deadline:
+            unbound = []
+            for name in created:
+                try:
+                    if not core.read("Pod", name, "default",
+                                     lambda p: p.spec.node_name):
+                        unbound.append(name)
+                except NotFound:
+                    pass  # evicted/cleaned up by a controller — fine
+            records = provider.list_instances()
+            live = {r.instance_id for r in records}
+            node_info = core.scan("Node", lambda n: (
+                n.metadata.name, n.spec.provider_id or "",
+                n.metadata.deletion_timestamp))
+            backing = set()
+            for _, pid, _ in node_info:
+                backing.update(s for s in pid.split("/") if s)
+            leaked = [r.instance_id for r in records
+                      if r.instance_id not in backing]
+            ghosts = [nm for nm, pid, dts in node_info
+                      if pid.startswith("fake://") and dts is None
+                      and not ({s for s in pid.split("/") if s} & live)]
+            if not unbound and not leaked and not ghosts:
+                break
+            time.sleep(0.25)
+
+        assert not unbound, (
+            f"seed={seed}: {len(unbound)}/{len(created)} surviving pods "
+            f"never bound (e.g. {unbound[:5]})")
+        assert not leaked, (
+            f"seed={seed}: leaked capacity never reaped: {leaked[:5]}")
+        assert not ghosts, (
+            f"seed={seed}: capacity-less Nodes persist: {ghosts[:5]}")
+        assert manager.healthz(), (
+            f"seed={seed}: a reconcile worker died during the soak")
+        assert plan.fired(), (
+            f"seed={seed}: no fault ever fired — the soak was vacuous")
+        print(f"chaos soak: seed={seed} fired={plan.fired_counts()} "
+              f"pending={plan.pending()}")
+        return plan
+    finally:
+        inject.uninstall()
+        manager.stop()
+
+
+class TestFaultPlanSoak:
+    def test_seeded_smoke_converges(self):
+        """Tier-1 smoke: a handful of injected faults across the kube and
+        provider boundaries; the cluster must converge anyway."""
+        _run_faulted_soak(SMOKE_SPECS, window=4, pods_total=12,
+                          burst_gap_s=0.08, settle_s=30.0)
+
+    @pytest.mark.slow
+    def test_seeded_soak_converges(self):
+        """The long soak behind `make chaos-soak`: more pods, more faults,
+        same invariants."""
+        _run_faulted_soak(SOAK_SPECS, window=8, pods_total=60,
+                          burst_gap_s=0.03, settle_s=60.0)

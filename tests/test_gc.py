@@ -1,0 +1,325 @@
+"""Capacity garbage collection: leaked instances and ghost nodes.
+
+Covers controllers/gc.py both directions (orphaned provider capacity with
+no Node; Nodes whose backing instance vanished), the grace windows, the
+fail-safe on provider enumeration errors, the launch-nonce attribution
+round trip through the AWS layer (DescribeInstances by tag), and the
+time-driven controller wiring (Manager seeds + self-requeue).
+"""
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.api.core import NodeSelectorRequirement as Req
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.chaos import inject
+from karpenter_tpu.cloudprovider.fake.provider import (
+    FakeCloudProvider, instance_types,
+)
+from karpenter_tpu.controllers.gc import GarbageCollection
+from karpenter_tpu.metrics.registry import DEFAULT as REGISTRY
+from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
+from karpenter_tpu.utils import clock
+
+GRACE = 60.0
+T0 = 1_700_000_000.0
+
+
+def make_constraints(provisioner="unit"):
+    return Constraints(
+        labels={wellknown.PROVISIONER_NAME_LABEL: provisioner},
+        requirements=Requirements([
+            Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In",
+                values=["test-zone-1"]),
+            Req(key=wellknown.LABEL_CAPACITY_TYPE, operator="In",
+                values=["on-demand"]),
+        ]),
+    )
+
+
+def counter_total(name):
+    metric = REGISTRY.counter(name)
+    return sum(metric.collect().values())
+
+
+@pytest.fixture()
+def env():
+    clock.DEFAULT.set(T0)
+    kube = KubeCore()
+    provider = FakeCloudProvider(catalog=instance_types(4))
+    gc = GarbageCollection(kube, provider,
+                           interval_seconds=0.05, grace_seconds=GRACE)
+    try:
+        yield kube, provider, gc
+    finally:
+        clock.DEFAULT.reset()
+        inject.uninstall()
+
+
+def leak_instance(provider):
+    """Launch one unit of capacity whose bind never runs (the provisioning
+    controller crashed between CloudProvider.create and the Node write)."""
+    inject.install(inject.FaultPlan(seed=1, specs=[
+        inject.FaultSpec("provider", "create", "crash-before-bind", 1)],
+        window=1))
+    errs = provider.create(make_constraints(), provider.catalog, 1,
+                           lambda n: pytest.fail("bind ran despite crash"))
+    inject.uninstall()
+    assert errs and "injected crash before bind" in errs[0]
+    records = provider.list_instances()
+    assert len(records) == 1
+    return records[0]
+
+
+def create_backed(kube, provider):
+    """Normal launch: the bind callback writes the Node, capacity is backed."""
+    bound = []
+
+    def bind(node):
+        kube.create(node)
+        bound.append(node)
+        return None
+
+    errs = provider.create(make_constraints(), provider.catalog, 1, bind)
+    assert errs == [None]
+    return bound[0]
+
+
+class TestOrphanedInstances:
+    def test_leak_reaped_after_grace(self, env):
+        kube, provider, gc = env
+        record = leak_instance(provider)
+        before = counter_total("gc_instances_terminated_total")
+
+        clock.DEFAULT.set(T0 + GRACE + 1)
+        assert gc.reconcile("capacity-gc", "") == gc.interval_seconds
+        assert provider.list_instances() == []
+        assert record.instance_id in provider.deleted
+        assert counter_total("gc_instances_terminated_total") == before + 1
+
+    def test_young_leak_spared(self, env):
+        kube, provider, gc = env
+        leak_instance(provider)
+        gc.reconcile("capacity-gc", "")
+        # younger than the grace window: could be mid-bind, must survive
+        assert len(provider.list_instances()) == 1
+
+    def test_record_attribution_survives_to_the_ledger(self, env):
+        _, provider, _ = env
+        record = leak_instance(provider)
+        assert record.provisioner_name == "unit"
+        assert record.launch_nonce  # stamped before any Node could exist
+        assert record.created_unix == T0
+        assert record.zone == "test-zone-1"
+
+    def test_backed_instance_untouched(self, env):
+        kube, provider, gc = env
+        node = create_backed(kube, provider)
+        clock.DEFAULT.set(T0 + GRACE + 1)
+        gc.reconcile("capacity-gc", "")
+        assert len(provider.list_instances()) == 1
+        kube.get("Node", node.metadata.name, "")  # still present
+
+
+class TestGhostNodes:
+    def test_ghost_deleted_after_grace(self, env):
+        kube, provider, gc = env
+        node = create_backed(kube, provider)
+        # the instance vanishes out-of-band (console terminate, spot reclaim)
+        record = provider.list_instances()[0]
+        provider.delete_instance(record.instance_id)
+        before = counter_total("gc_nodes_removed_total")
+
+        gc.reconcile("capacity-gc", "")
+        kube.get("Node", node.metadata.name, "")  # young node: spared
+
+        clock.DEFAULT.set(T0 + GRACE + 1)
+        gc.reconcile("capacity-gc", "")
+        with pytest.raises(NotFound):
+            kube.get("Node", node.metadata.name, "")
+        assert counter_total("gc_nodes_removed_total") == before + 1
+
+    def test_foreign_provider_nodes_invisible(self, env):
+        kube, provider, gc = env
+        from karpenter_tpu.api.core import Node, NodeSpec, ObjectMeta
+
+        kube.create(Node(metadata=ObjectMeta(name="alien", namespace=""),
+                         spec=NodeSpec(provider_id="gce:///zone-x/alien-1")))
+        clock.DEFAULT.set(T0 + GRACE + 1)
+        gc.reconcile("capacity-gc", "")
+        kube.get("Node", "alien", "")  # not ours: never touched
+
+    def test_enumeration_failure_skips_sweep(self, env):
+        kube, provider, gc = env
+        node = create_backed(kube, provider)
+
+        def boom():
+            raise RuntimeError("provider API down")
+        provider.list_instances = boom
+
+        clock.DEFAULT.set(T0 + GRACE + 1)
+        # an empty-looking provider must never read as "every node is a
+        # ghost" — the sweep is skipped wholesale and retried next interval
+        assert gc.reconcile("capacity-gc", "") == gc.interval_seconds
+        kube.get("Node", node.metadata.name, "")
+
+
+class TestAwsLayer:
+    @pytest.fixture()
+    def aws(self):
+        from karpenter_tpu.cloudprovider.aws.fake import FakeEC2API, FakeSSMAPI
+        from karpenter_tpu.cloudprovider.aws.provider import AWSCloudProvider
+
+        ec2 = FakeEC2API()
+        provider = AWSCloudProvider(
+            ec2, FakeSSMAPI(), cluster_name="test-cluster",
+            cluster_endpoint="https://test-cluster",
+            describe_retry_delay=0.0)
+        yield ec2, provider
+        inject.uninstall()
+
+    def _aws_constraints(self):
+        c = Constraints(
+            labels={wellknown.PROVISIONER_NAME_LABEL: "aws-prov"},
+            requirements=Requirements([
+                Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In",
+                    values=["test-zone-1a", "test-zone-1b", "test-zone-1c"]),
+                Req(key=wellknown.LABEL_CAPACITY_TYPE, operator="In",
+                    values=["on-demand"]),
+            ]),
+            provider={
+                "instanceProfile": "test-instance-profile",
+                "subnetSelector": {"Name": "*"},
+                "securityGroupSelector": {"Name": "*"},
+            },
+        )
+        return c
+
+    def test_launch_nonce_rides_create_fleet_tags(self, aws):
+        ec2, provider = aws
+        constraints = self._aws_constraints()
+        catalog = provider.get_instance_types(constraints)
+        catalog.sort(key=lambda it: (it.cpu.value(), it.memory.value()))
+        bound = []
+        errs = provider.create(constraints, catalog, 1,
+                               lambda n: bound.append(n) or None)
+        assert errs == [None]
+
+        records = provider.list_instances()
+        assert len(records) == 1
+        record = records[0]
+        assert record.provisioner_name == "aws-prov"
+        assert record.launch_nonce  # tagged at CreateFleet, pre-Node
+        assert record.created_unix > 0
+        # the GC ownership test: instance id is a providerID path segment
+        assert record.instance_id in bound[0].spec.provider_id.split("/")
+
+    def test_delete_instance_and_not_found_is_success(self, aws):
+        ec2, provider = aws
+        constraints = self._aws_constraints()
+        catalog = provider.get_instance_types(constraints)
+        provider.create(constraints, catalog, 1, lambda n: None)
+        record = provider.list_instances()[0]
+
+        assert provider.delete_instance(record.instance_id) is None
+        assert provider.list_instances() == []
+        assert record.instance_id in ec2.terminated
+        # already-gone capacity: NotFound is success, not an error string
+        assert provider.delete_instance("i-00000000deadbeef") is None
+
+    def test_ec2_crash_after_create_fleet_leaks_then_gc_reaps(self, aws):
+        """The crash window at the EC2 boundary: CreateFleet launches, the
+        response is lost, no Node is ever written — and the GC sweep can
+        still find and terminate the capacity via its tags."""
+        ec2, provider = aws
+        provider.instance_provider.ec2api = inject.ChaosEC2(ec2)
+        inject.install(inject.FaultPlan(seed=3, specs=[
+            inject.FaultSpec("ec2", "create_fleet", "crash-before-bind", 1)],
+            window=1))
+
+        constraints = self._aws_constraints()
+        catalog = provider.get_instance_types(constraints)
+        errs = provider.create(constraints, catalog, 1,
+                               lambda n: pytest.fail("bind ran"))
+        inject.uninstall()
+        assert errs and errs[0] is not None and "injected" in errs[0]
+
+        # leaked but attributable
+        records = provider.list_instances()
+        assert len(records) == 1
+        assert records[0].launch_nonce
+
+        kube = KubeCore()
+        clock.DEFAULT.set(clock.now() + GRACE + 1)
+        try:
+            gc = GarbageCollection(kube, provider, grace_seconds=GRACE)
+            gc.reconcile("capacity-gc", "")
+        finally:
+            clock.DEFAULT.reset()
+        assert provider.list_instances() == []
+        assert ec2.terminated
+
+
+class TestTimeDrivenWiring:
+    def test_seeded_controller_reconciles_periodically(self):
+        """A kind()=None controller must run from its seed key and keep
+        itself alive via the returned requeue interval — no watch events."""
+        from karpenter_tpu.runtime.manager import Manager
+
+        class CountingGC(GarbageCollection):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self.runs = 0
+                self.ran_twice = threading.Event()
+
+            def reconcile(self, name, namespace="default"):
+                out = super().reconcile(name, namespace)
+                self.runs += 1
+                if self.runs >= 2:
+                    self.ran_twice.set()
+                return out
+
+        kube = KubeCore()
+        provider = FakeCloudProvider(catalog=instance_types(2))
+        gc = CountingGC(kube, provider, interval_seconds=0.05,
+                        grace_seconds=GRACE)
+        manager = Manager(kube)
+        manager.register(gc)
+        manager.start()
+        try:
+            assert gc.ran_twice.wait(timeout=10.0), (
+                f"time-driven GC ran {gc.runs}x; seeds()/requeue wiring broken")
+        finally:
+            manager.stop()
+
+    def test_end_to_end_leak_converges_under_manager(self):
+        """Crash-leaked capacity disappears with NO watch event ever firing
+        for it — the whole point of a time-driven sweep."""
+        from karpenter_tpu.runtime.manager import Manager
+
+        clock.DEFAULT.set(T0)
+        kube = KubeCore()
+        provider = FakeCloudProvider(catalog=instance_types(2))
+        try:
+            leak_instance(provider)
+            manager = Manager(kube)
+            manager.register(GarbageCollection(
+                kube, provider, interval_seconds=0.05, grace_seconds=GRACE))
+            manager.start()
+            try:
+                clock.DEFAULT.set(T0 + GRACE + 1)
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    if not provider.list_instances():
+                        break
+                    time.sleep(0.05)
+                assert provider.list_instances() == [], "leak never reaped"
+            finally:
+                manager.stop()
+        finally:
+            clock.DEFAULT.reset()
+            inject.uninstall()

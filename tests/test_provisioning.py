@@ -235,3 +235,42 @@ class TestStatusConditions:
         back = provisioner_from_manifest(manifest)
         cond = get_condition(back.status.conditions, "Active")
         assert cond.status == "True" and cond.reason == "WorkerRunning"
+
+
+class TestBindErrors:
+    """_bind must propagate real bind failures (provisioner.go:159-198 logs
+    and drops them; here the joined error rides CloudProvider.create back to
+    the provision loop) while treating already-bound pods as success."""
+
+    def _worker(self, kube):
+        from karpenter_tpu.controllers.provisioning import ProvisionerWorker
+
+        provider = FakeCloudProvider(catalog=instance_types(4))
+        return ProvisionerWorker(make_provisioner(), kube, provider)
+
+    def test_missing_pod_error_propagates_joined(self):
+        from karpenter_tpu.api.core import Node, Pod
+
+        kube = KubeCore()
+        worker = self._worker(kube)
+        ghost = Pod(metadata=ObjectMeta(name="never-created"))
+        err = worker._bind(Node(metadata=ObjectMeta(name="n1", namespace="")),
+                           [ghost])
+        assert err is not None and "not found" in err
+        # the failed pod count and node name survive into the message
+        assert "1 pod(s)" in err and "n1" in err
+
+    def test_already_bound_pod_is_idempotent_success(self):
+        from karpenter_tpu.api.core import Node
+
+        kube = KubeCore()
+        worker = self._worker(kube)
+        pod = unschedulable_pod(name="bound-once")
+        kube.create(pod)
+        kube.bind_pods([pod], "elsewhere")
+        # a stale provisionable read re-batched it: binding again must not
+        # surface an error (it would relaunch capacity every window)
+        err = worker._bind(Node(metadata=ObjectMeta(name="n2", namespace="")),
+                           [pod])
+        assert err is None
+        assert kube.get("Pod", "bound-once").spec.node_name == "elsewhere"

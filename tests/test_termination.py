@@ -270,3 +270,124 @@ class TestEvictionBackoff:
             with pytest.raises(NotFound):
                 kube.get("Node", node.metadata.name, "")
         eventually(gone, timeout=10.0)
+
+
+class TestPdbIntOrString:
+    """minAvailable/maxUnavailable as IntOrString (kubecore.evict_pod):
+    percentages resolve against expectedPods with the apiserver's round-up;
+    maxUnavailable translates to desiredHealthy = expected − resolved.
+
+    Pods carry a finalizer so an eviction leaves them terminating instead
+    of gone: expectedPods stays constant across sequential evictions (the
+    real disruption controller counts terminating pods in expected but not
+    in healthy), which is what makes the budgets below exact."""
+
+    def _guarded_pods(self, kube, n):
+        from karpenter_tpu.api.core import LabelSelector, PodDisruptionBudget  # noqa: F401
+
+        for i in range(n):
+            kube.create(Pod(
+                metadata=ObjectMeta(name=f"guarded-{i}",
+                                    labels={"app": "quorum"},
+                                    finalizers=["test/block-deletion"]),
+                spec=PodSpec(node_name="node-1")))
+
+    def _pdb(self, kube, **kwargs):
+        from karpenter_tpu.api.core import LabelSelector, PodDisruptionBudget
+
+        kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="budget"),
+            selector=LabelSelector(match_labels={"app": "quorum"}),
+            **kwargs))
+
+    def test_min_available_percentage_rounds_up(self):
+        """75% of 4 rounds to desiredHealthy=3 (not floor's 2): the first
+        eviction passes (4→3 healthy), the second would leave 2 < 3."""
+        from karpenter_tpu.runtime.kubecore import TooManyRequests
+
+        kube = KubeCore()
+        self._guarded_pods(kube, 4)
+        self._pdb(kube, min_available="75%")
+        kube.evict_pod("guarded-0")
+        with pytest.raises(TooManyRequests, match="3 required"):
+            kube.evict_pod("guarded-1")
+
+    def test_min_available_half_allows_down_to_the_budget(self):
+        """50% of 4 → desiredHealthy=2: exactly two evictions pass."""
+        from karpenter_tpu.runtime.kubecore import TooManyRequests
+
+        kube = KubeCore()
+        self._guarded_pods(kube, 4)
+        self._pdb(kube, min_available="50%")
+        kube.evict_pod("guarded-0")
+        kube.evict_pod("guarded-1")
+        with pytest.raises(TooManyRequests):
+            kube.evict_pod("guarded-2")
+
+    def test_min_available_hundred_percent_blocks_all(self):
+        from karpenter_tpu.runtime.kubecore import TooManyRequests
+
+        kube = KubeCore()
+        self._guarded_pods(kube, 2)
+        self._pdb(kube, min_available="100%")
+        with pytest.raises(TooManyRequests):
+            kube.evict_pod("guarded-0")
+
+    def test_max_unavailable_zero_blocks_all(self):
+        from karpenter_tpu.runtime.kubecore import TooManyRequests
+
+        kube = KubeCore()
+        self._guarded_pods(kube, 3)
+        self._pdb(kube, max_unavailable=0)
+        with pytest.raises(TooManyRequests):
+            kube.evict_pod("guarded-0")
+
+    def test_max_unavailable_int_allows_exactly_n(self):
+        from karpenter_tpu.runtime.kubecore import TooManyRequests
+
+        kube = KubeCore()
+        self._guarded_pods(kube, 4)
+        self._pdb(kube, max_unavailable=2)
+        kube.evict_pod("guarded-0")
+        kube.evict_pod("guarded-1")
+        with pytest.raises(TooManyRequests):
+            kube.evict_pod("guarded-2")
+
+    def test_max_unavailable_percentage_rounds_up_the_loss_budget(self):
+        """maxUnavailable=25% of 4 → resolved=1 → desiredHealthy=3: one
+        eviction passes, the second is blocked."""
+        from karpenter_tpu.runtime.kubecore import TooManyRequests
+
+        kube = KubeCore()
+        self._guarded_pods(kube, 4)
+        self._pdb(kube, max_unavailable="25%")
+        kube.evict_pod("guarded-0")
+        with pytest.raises(TooManyRequests):
+            kube.evict_pod("guarded-1")
+
+    def test_setting_both_fields_is_a_500(self):
+        from karpenter_tpu.runtime.kubecore import InternalError
+
+        kube = KubeCore()
+        self._guarded_pods(kube, 2)
+        self._pdb(kube, min_available=1, max_unavailable=1)
+        with pytest.raises(InternalError, match="both"):
+            kube.evict_pod("guarded-0")
+
+    def test_malformed_int_or_string_is_a_500(self):
+        from karpenter_tpu.runtime.kubecore import InternalError
+
+        kube = KubeCore()
+        self._guarded_pods(kube, 2)
+        self._pdb(kube, min_available="half")
+        with pytest.raises(InternalError, match="invalid"):
+            kube.evict_pod("guarded-0")
+
+    def test_evicting_terminating_pod_never_moves_the_budget(self):
+        """A pod already terminating is not healthy, so re-evicting it
+        costs nothing even at the budget's edge."""
+        kube = KubeCore()
+        self._guarded_pods(kube, 3)
+        self._pdb(kube, min_available=2)
+        kube.evict_pod("guarded-0")  # 3→2 healthy: allowed, now terminating
+        kube.evict_pod("guarded-0")  # loss=0: still allowed
