@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes native dryrun lint chart chaos-soak clean help
+.PHONY: test battletest bench bench-shapes bench-control native dryrun lint chart chaos-soak clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -17,6 +17,9 @@ bench: ## Run the 5-config benchmark on the available accelerator
 
 bench-shapes: ## Shape-cardinality + type-SPMD configs only (compaction regime)
 	python bench.py --only config_6 config_8
+
+bench-control: ## Control-plane config only (columnar filter regime, filter_ms breakdown)
+	python bench.py --only config_7
 
 native: ## Build the C++ FFD kernel explicitly (normally built lazily)
 	g++ -O3 -std=c++17 -shared -fPIC \

@@ -570,11 +570,40 @@ def config_7_control_plane():
     selection reconciles (selection/controller.go:181); this measures the
     Python plane sustaining the same pod count end-to-end.
 
-    Reported: pods-bound/sec over the whole run and pending→bound latency
-    percentiles (per pod: bind observed at poll t → latency ≈ t - create).
+    Reported: pods-bound/sec over the whole run, pending→bound latency
+    percentiles (per pod: bind observed at poll t → latency ≈ t - create),
+    and a filter_ms breakdown — time spent in the columnar feasibility
+    filter (ops/feasibility.py) per stage plus any scalar fallbacks — so
+    control-plane wins are attributable.
     """
     import functools
     import time as _time
+
+    from karpenter_tpu.metrics.filter import (
+        FILTER_BATCH_SECONDS, FILTER_FALLBACK_TOTAL,
+    )
+
+    def _filter_snapshot():
+        hist = {lv: (s, total) for lv, (_, s, total)
+                in FILTER_BATCH_SECONDS.collect().items()}
+        return hist, dict(FILTER_FALLBACK_TOTAL.collect())
+
+    def _filter_delta(before, after):
+        hist0, fb0 = before
+        hist1, fb1 = after
+        out = {}
+        for lv, (s1, n1) in hist1.items():
+            s0, n0 = hist0.get(lv, (0.0, 0))
+            stage = dict(lv).get("stage", "?")
+            out[f"{stage}_total_ms"] = round((s1 - s0) * 1000, 2)
+            out[f"{stage}_batches"] = n1 - n0
+        fallbacks = {}
+        for lv, v1 in fb1.items():
+            d = v1 - fb0.get(lv, 0.0)
+            if d:
+                fallbacks[dict(lv).get("reason", "?")] = d
+        out["fallbacks"] = fallbacks
+        return out
 
     from karpenter_tpu.api.provisioner import Provisioner
     from karpenter_tpu.cloudprovider.fake.provider import FakeCloudProvider
@@ -627,6 +656,7 @@ def config_7_control_plane():
 
         shapes = MIXED_SHAPES
         created_at = {}
+        filter_before = _filter_snapshot()
         t_start = _time.perf_counter()
         for i in range(N):
             c, m = shapes[i % len(shapes)]
@@ -652,6 +682,7 @@ def config_7_control_plane():
                              lambda p: bool(p.spec.node_name)):
                     bound_at[name] = _time.perf_counter()
         t_done = _time.perf_counter()
+        filter_after = _filter_snapshot()
         kube.unwatch(watch_q)
     finally:
         manager.stop()
@@ -667,6 +698,7 @@ def config_7_control_plane():
         "wall_s": round(total_s, 2),
         "pods_bound_per_sec": round(bound / total_s) if total_s > 0 else 0,
         "nodes_created": len(kube.list("Node")),
+        "filter_ms": _filter_delta(filter_before, filter_after),
         "selection_workers": sel_workers,
         "stack": f"watch → selection({sel_workers}w adaptive, non-blocking)"
                  " → batcher → batched sharded solve → launch → "

@@ -7,7 +7,8 @@ assignment problem solved with JAX/XLA on TPU.
 
 Layout:
 - api/            Provisioner CRD types + constraint algebra (host reference)
-- ops/            device kernels: encode/interning, feasibility, pack
+- ops/            device kernels + columnar filters: encode/interning, pack,
+                  compact, feasibility (interned-bitset constraint engine)
 - models/         solver formulations (FFD-parity, cost-minimizing, consolidation)
 - parallel/       device mesh + pods-axis sharding (shard_map)
 - solver/         end-to-end solve orchestration + host oracle + C++ fallback

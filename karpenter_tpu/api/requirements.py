@@ -4,8 +4,10 @@ Host reference implementation of the set semantics in
 pkg/apis/provisioning/v1alpha5/requirements.go. A requirement list evaluates,
 per key, to ``(∩ of all In sets) ∖ (∪ of all NotIn sets)``; ``None`` means
 "unconstrained". The vectorized (interned bitset) twin of this algebra is
-karpenter_tpu/ops/feasibility.py, property-tested against this module; any
-semantic change here must be mirrored there.
+karpenter_tpu/ops/feasibility.py, property-tested against this module in
+tests/test_feasibility.py; this module is the oracle, and any semantic
+change here must be mirrored there (docs/scheduling.md specifies the
+encoding and its quirk-preservation obligations).
 """
 
 from __future__ import annotations

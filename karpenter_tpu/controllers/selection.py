@@ -17,6 +17,7 @@ from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.core import (
     Affinity, NodeAffinity, NodeSelectorRequirement, NodeSelectorTerm, Pod,
 )
+from karpenter_tpu.ops import feasibility
 from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
 from karpenter_tpu.utils import clock
 from karpenter_tpu.utils import pod as podutil
@@ -251,7 +252,12 @@ class SelectionController:
         errs = []
         chosen = None
         for worker in workers:
-            err = worker.provisioner.spec.constraints.validate_pod(pod)
+            # columnar: the compiled bitset engine is cached on the worker's
+            # long-lived constraints object, so the 10k-reconcile flood pays
+            # a memoized signature lookup per (provisioner, pod shape)
+            # instead of the full scalar requirement walk per reconcile
+            err = feasibility.validate_pod_fast(
+                worker.provisioner.spec.constraints, pod)
             if err is None:
                 chosen = worker
                 break

@@ -1,0 +1,26 @@
+"""Metrics for the columnar constraint filter (ops/feasibility.py).
+
+Three series, all on the process-wide registry (exposed with the
+``karpenter_`` prefix by registry.expose()):
+
+- ``karpenter_filter_batch_seconds``   histogram, ``stage`` label
+  ("schedule" = one scheduler window, "catalog" = one catalog mask build)
+- ``karpenter_filter_fallback_total``  counter, ``reason`` label — every
+  time the engine hands a decision back to the scalar path
+- ``karpenter_filter_intern_table_size`` gauge — live values in the
+  global key→value intern table (drops to 0 on a generation reset)
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.metrics.registry import DEFAULT
+
+FILTER_BATCH_SECONDS = DEFAULT.histogram(
+    "filter_batch_seconds",
+    "Columnar feasibility filter time per batch (stage=schedule|catalog)")
+FILTER_FALLBACK_TOTAL = DEFAULT.counter(
+    "filter_fallback_total",
+    "Scalar-path fallbacks taken by the feasibility engine, by reason")
+FILTER_INTERN_TABLE_SIZE = DEFAULT.gauge(
+    "filter_intern_table_size",
+    "Interned label values held by the feasibility engine's vocab table")

@@ -1,0 +1,628 @@
+"""Columnar constraint filter: the interned-bitset twin of the scalar
+requirement algebra (api/requirements.py, api/constraints.py).
+
+The control plane's hot loop is "constraint filter + bin-packing". The
+packing half runs on device; this module makes the filter half columnar.
+Label values are interned into dense bit positions per key, each key's
+``(∩ In) ∖ (∪ NotIn)`` set becomes a packed bitmask (a Python int of
+arbitrary width — one bit per interned value), and the three per-pod hot
+loops evaluate as mask algebra:
+
+- pod × provisioner validation  (``validate_pod_fast`` /
+  ``CompiledConstraints.validate``) — Scheduler._get_schedules and
+  SelectionController._select_provisioner
+- constraint tightening signatures (``CompiledConstraints.schedule_entry``)
+  — ``tighten()`` runs once per signature instead of once per pod, and the
+  schedule group key is exactly ``scheduler._constraints_key`` of the
+  tightened result (scheduler.go:100-110 SlicesAsSets semantics)
+- pod-set × instance-type feasibility (``catalog_feasibility_mask``) — the
+  whole catalog validated as numpy (optionally JAX) boolean columns,
+  memoized by catalog generation + allowed sets
+
+Exactness contract (same as ops/encode.py): exactness is never traded for
+speed. Quirks of the scalar algebra are preserved bit-for-bit:
+
+- NotIn-without-In collapses to the empty set, not "unconstrained"
+  (requirements.go:189-194 — Go's nil.Difference returns non-nil empty);
+  modeled by ``has_notin`` forcing ``(r or 0) & ~notin`` even when no In
+  row exists, including a NotIn with an empty values list.
+- Alias keys (wellknown.NORMALIZED_LABELS) are normalized on the POD side
+  (mirroring pod_requirements' add()) but looked up literally on the
+  constraint side (mirroring requirement(key)'s literal match) — a raw
+  un-normalized constraint row keeps failing exactly as it does today.
+- Operators other than In/NotIn on constraint rows are skipped (they never
+  reach ``requirement()``'s loops); on pod rows, Exists/DoesNotExist
+  contribute key presence only, and anything else (Gt/Lt/unknown) sends
+  the pod to the scalar path — counted in karpenter_filter_fallback_total.
+- Go's sets.Has(nil) == false: an unconstrained allowed-set REJECTS every
+  catalog entry (the provisioning controller always injects the universe
+  first, adapter._validate's note).
+- Taint toleration replays core/v1 ToleratesTaint exactly, including the
+  "Exists tolerations must not carry a value" rule.
+
+Any verdict the engine cannot produce (unsupported operator, compile
+failure, >64 operating systems in one catalog) falls back to the scalar
+path. When the engine says "fail" it re-runs the scalar validator for the
+exact error string — if the scalar path disagrees and passes, the scalar
+answer wins (self-healing; counted as reason="verdict-mismatch"), so a
+divergence can never reject a schedulable pod in production. The fuzz
+suite (tests/test_feasibility.py) compares the RAW engine verdict against
+the scalar oracle to keep that guarantee honest.
+
+Interning is global, generation-bounded (KARPENTER_FEASIBILITY_INTERN_MAX,
+default 65536 values) like the adapter's shape intern table: crossing the
+cap REBINDS the vocab (never mutates the per-key dicts), so compiled
+objects holding the old dicts stay internally consistent forever and new
+compiles start a fresh generation.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.api.core import Pod
+from karpenter_tpu.api.requirements import IN, NOT_IN
+from karpenter_tpu.metrics.filter import (
+    FILTER_BATCH_SECONDS, FILTER_FALLBACK_TOTAL, FILTER_INTERN_TABLE_SIZE,
+)
+from karpenter_tpu.utils import resources as res
+
+log = logging.getLogger("karpenter.feasibility")
+
+_PRESENCE_OPS = ("Exists", "DoesNotExist")
+
+# -- global value intern table ----------------------------------------------
+#
+# {key: {value: single-bit mask}}. Bit positions are dense per key and
+# append-only within a dict's lifetime. On overflow the TOP-LEVEL dict is
+# rebound (never cleared): compiled constraints keep references to the
+# per-key dicts they interned against, so their masks stay valid across
+# generations; only sharing with future compiles is lost.
+
+
+def _intern_max_from_env() -> int:
+    raw = os.environ.get("KARPENTER_FEASIBILITY_INTERN_MAX", "")
+    if not raw.strip():
+        return 1 << 16
+    try:
+        return max(1, int(raw.strip()))
+    except ValueError:
+        log.warning("KARPENTER_FEASIBILITY_INTERN_MAX=%r is not an integer; "
+                    "using default %d", raw, 1 << 16)
+        return 1 << 16
+
+
+_INTERN_MAX = _intern_max_from_env()
+_INTERN_LOCK = threading.Lock()
+_VOCAB: Dict[str, Dict[str, int]] = {}
+_VOCAB_SIZE = 0
+_VOCAB_GEN = 0
+
+
+def _intern_value(vocab: Dict[str, int], value: str) -> int:
+    """Single-bit mask for ``value`` in this key's vocab; caller holds
+    _INTERN_LOCK. A dict handed out before a generation reset keeps
+    growing privately — correct, just unshared."""
+    global _VOCAB, _VOCAB_SIZE, _VOCAB_GEN
+    m = vocab.get(value)
+    if m is None:
+        if _VOCAB_SIZE >= _INTERN_MAX:
+            _VOCAB = {}
+            _VOCAB_SIZE = 0
+            _VOCAB_GEN += 1
+            FILTER_FALLBACK_TOTAL.inc(reason="intern-reset")
+        m = 1 << len(vocab)
+        vocab[value] = m
+        _VOCAB_SIZE += 1
+    return m
+
+
+def intern_table_stats() -> Tuple[int, int]:
+    """(live size, generation) — tests and diagnostics."""
+    with _INTERN_LOCK:
+        return _VOCAB_SIZE, _VOCAB_GEN
+
+
+def reset_intern_table() -> None:
+    """Force a generation reset (tests)."""
+    global _VOCAB, _VOCAB_SIZE, _VOCAB_GEN
+    with _INTERN_LOCK:
+        _VOCAB = {}
+        _VOCAB_SIZE = 0
+        _VOCAB_GEN += 1
+    FILTER_INTERN_TABLE_SIZE.set(0)
+
+
+# -- compiled constraints ----------------------------------------------------
+
+
+class _KeyFilter:
+    """One key's constraint-side state: vocab ref + In/NotIn masks + the
+    precomputed own-requirement result (None=unconstrained, int=mask)."""
+
+    __slots__ = ("vocab", "in_mask", "notin_mask", "has_notin", "own")
+
+    def __init__(self, vocab: Dict[str, int]):
+        self.vocab = vocab
+        self.in_mask: Optional[int] = None
+        self.notin_mask = 0
+        self.has_notin = False
+        self.own: Optional[int] = None
+
+
+_MISSING = object()
+_CACHE_CAP = 16384
+
+
+class CompiledConstraints:
+    """Bitset form of one Constraints object. Attached to the object's
+    ``__dict__`` (the pod ``_marshal`` precedent) and shared, never copied:
+    ``__deepcopy__`` returns self, and the identity fingerprint mismatches
+    on the copy, forcing a fresh compile there."""
+
+    __slots__ = ("fingerprint", "cref", "filters", "taints",
+                 "_val_cache", "_sched_cache")
+
+    def __init__(self, fingerprint, cref: Constraints,
+                 filters: Dict[str, _KeyFilter], taints: tuple):
+        self.fingerprint = fingerprint
+        self.cref = cref
+        self.filters = filters
+        self.taints = taints
+        self._val_cache: dict = {}
+        self._sched_cache: dict = {}
+
+    def __deepcopy__(self, memo):
+        return self
+
+    def __copy__(self):
+        return self
+
+    # -- raw bitset verdict (the fuzz-tested core) --------------------------
+    def _raw_ok(self, sig) -> bool:
+        """True iff the pod signature passes — the mask-algebra mirror of
+        Constraints.validate_pod's three stages. Boolean only; error
+        strings always come from the scalar path."""
+        rows, tols, _gpus = sig
+        for taint in self.taints:
+            tolerated = False
+            for tk, top, tv, te in tols:
+                if te and te != taint.effect:
+                    continue
+                if tk and tk != taint.key:
+                    continue
+                if top == "Exists":
+                    if tv == "":
+                        tolerated = True
+                        break
+                elif top == "" or top == "Equal":
+                    if tv == taint.value:
+                        tolerated = True
+                        break
+            if not tolerated:
+                return False
+        if not rows:
+            return True
+        filters = self.filters
+        order: List[str] = []
+        grouped: Dict[str, list] = {}
+        for key, op, vals in rows:
+            g = grouped.get(key)
+            if g is None:
+                g = grouped[key] = []
+                order.append(key)
+            g.append((op, vals))
+        for key in order:
+            kf = filters.get(key)
+            if kf is None or not kf.own:
+                # own requirement None (unconstrained) or empty: loop 1 of
+                # validate_pod rejects either way
+                return False
+            r = kf.in_mask
+            notin = kf.notin_mask
+            has_notin = kf.has_notin
+            vocab = kf.vocab
+            for op, vals in grouped[key]:
+                if op == IN:
+                    m = 0
+                    for v in vals:
+                        b = vocab.get(v)
+                        if b is not None:
+                            # a value the constraint never interned cannot
+                            # be in any constraint set: dropping it from the
+                            # In mask is exact, and bounds vocab growth
+                            m |= b
+                    r = m if r is None else (r & m)
+                elif op == NOT_IN:
+                    for v in vals:
+                        b = vocab.get(v)
+                        if b is not None:  # subtracting unknown is a no-op
+                            notin |= b
+                    has_notin = True
+                # Exists/DoesNotExist assert key presence only:
+                # requirement() never reads them (requirements.go:176-195)
+            if has_notin:
+                r = (r if r is not None else 0) & ~notin
+            if not r:
+                return False
+        return True
+
+    # -- validation with exact scalar error strings -------------------------
+    def validate(self, pod: Pod) -> Optional[str]:
+        """Drop-in for ``constraints.validate_pod(pod)``: same verdict, same
+        error strings, memoized per pod signature."""
+        sig = pod_signature(pod)
+        if sig is None:
+            return self.cref.validate_pod(pod)
+        hit = self._val_cache.get(sig, _MISSING)
+        if hit is not _MISSING:
+            return hit
+        if self._raw_ok(sig):
+            out = None
+        else:
+            out = self.cref.validate_pod(pod)
+            if out is None:
+                FILTER_FALLBACK_TOTAL.inc(reason="verdict-mismatch")
+        if len(self._val_cache) >= _CACHE_CAP:
+            self._val_cache.clear()
+        self._val_cache[sig] = out
+        return out
+
+    # -- scheduler entry: validate + memoized tighten + group key -----------
+    def schedule_entry(self, pod: Pod):
+        """(err, tightened, group_key) for one pod. ``tighten()`` runs once
+        per signature; the key equals
+        ``_constraints_key(cref.tighten(pod), res.gpu_limits_for(pod))``
+        because the GPU-request axis is part of the signature and the rest
+        is a pure function of it."""
+        sig = pod_signature(pod)
+        if sig is None:
+            c = self.cref
+            err = c.validate_pod(pod)
+            if err is not None:
+                return err, None, None
+            tightened = c.tighten(pod)
+            gpus = tuple(sorted(
+                (k, q.nano) for k, q in res.gpu_limits_for(pod).items()))
+            return None, tightened, constraints_key_parts(tightened) + (gpus,)
+        hit = self._sched_cache.get(sig)
+        if hit is None:
+            if self._raw_ok(sig):
+                err = None
+            else:
+                err = self.cref.validate_pod(pod)
+                if err is None:
+                    FILTER_FALLBACK_TOTAL.inc(reason="verdict-mismatch")
+            if err is not None:
+                hit = (err, None, None)
+            else:
+                tightened = self.cref.tighten(pod)
+                hit = (None, tightened, constraints_key_parts(tightened))
+            if len(self._sched_cache) >= _CACHE_CAP:
+                self._sched_cache.clear()
+            self._sched_cache[sig] = hit
+        err, tightened, parts = hit
+        if err is not None:
+            return err, None, None
+        return None, tightened, parts + (sig[2],)
+
+
+class _CompileFailed:
+    """Negative-cache marker so a constraints object that failed to compile
+    is not re-attempted per pod."""
+
+    __slots__ = ("fingerprint",)
+
+    def __deepcopy__(self, memo):
+        return self
+
+
+def _fingerprint(c: Constraints) -> tuple:
+    # Identity + length: every in-repo mutation of a live constraints object
+    # (topology.inject appending hostname rows) changes a length; wholesale
+    # replacement changes an id. Copies (fastcopy/deepcopy) always get fresh
+    # ids, so a shared CompiledConstraints can never serve a copy stale.
+    return (id(c.requirements), len(c.requirements.items),
+            id(c.taints), len(c.taints))
+
+
+def compile_constraints(c: Constraints) -> Optional[CompiledConstraints]:
+    """Compile (or fetch the cached compile of) a Constraints object.
+    None means the scalar path must be used for every decision."""
+    fp = _fingerprint(c)
+    cached = c.__dict__.get("_feas_compiled")
+    if cached is not None and cached.fingerprint == fp:
+        return cached if type(cached) is CompiledConstraints else None
+    try:
+        cc = _compile(c, fp)
+    except Exception:
+        log.warning("feasibility compile failed; using scalar path",
+                    exc_info=True)
+        FILTER_FALLBACK_TOTAL.inc(reason="compile-error")
+        failed = _CompileFailed()
+        failed.fingerprint = fp
+        c.__dict__["_feas_compiled"] = failed
+        return None
+    c.__dict__["_feas_compiled"] = cc
+    return cc
+
+
+def _compile(c: Constraints, fp: tuple) -> CompiledConstraints:
+    filters: Dict[str, _KeyFilter] = {}
+    with _INTERN_LOCK:
+        for r in c.requirements.items:
+            op = r.operator
+            if op != IN and op != NOT_IN:
+                # requirement() ignores these rows entirely; their keys only
+                # matter via keys(), which validation never consults on the
+                # constraint side
+                continue
+            kf = filters.get(r.key)
+            if kf is None:
+                vocab = _VOCAB.get(r.key)
+                if vocab is None:
+                    vocab = _VOCAB[r.key] = {}
+                kf = filters[r.key] = _KeyFilter(vocab)
+            m = 0
+            for v in r.values:
+                m |= _intern_value(kf.vocab, v)
+            if op == IN:
+                kf.in_mask = m if kf.in_mask is None else (kf.in_mask & m)
+            else:
+                kf.notin_mask |= m
+                kf.has_notin = True
+        size = _VOCAB_SIZE
+    FILTER_INTERN_TABLE_SIZE.set(size)
+    for kf in filters.values():
+        own = kf.in_mask
+        if kf.has_notin:
+            own = (own if own is not None else 0) & ~kf.notin_mask
+        kf.own = own
+    return CompiledConstraints(fp, c, filters, tuple(c.taints))
+
+
+# -- pod signatures ----------------------------------------------------------
+
+
+def pod_signature(pod: Pod):
+    """(filter rows, tolerations, gpu requests) — the pod's entire input to
+    validation + grouping, as a hashable value. Rows mirror
+    pod_requirements' extraction exactly: nodeSelector (normalized, In),
+    then the heaviest preferred term, then required[0]. None means an
+    operator outside {In, NotIn, Exists, DoesNotExist} appeared — scalar
+    fallback. Never cached on the Pod: topology injection and preference
+    relaxation mutate pod specs between calls."""
+    normalized = wellknown.NORMALIZED_LABELS
+    rows = []
+    for key, value in pod.spec.node_selector.items():
+        rows.append((normalized.get(key, key), IN, (value,)))
+    affinity = pod.spec.affinity
+    if affinity is not None and affinity.node_affinity is not None:
+        na = affinity.node_affinity
+        exprs = []
+        if na.preferred:
+            heaviest = max(na.preferred, key=lambda t: t.weight)
+            exprs.extend(heaviest.preference.match_expressions)
+        if na.required:
+            exprs.extend(na.required[0].match_expressions)
+        for r in exprs:
+            op = r.operator
+            if op != IN and op != NOT_IN and op not in _PRESENCE_OPS:
+                FILTER_FALLBACK_TOTAL.inc(reason="unsupported-operator")
+                return None
+            rows.append((normalized.get(r.key, r.key), op, tuple(r.values)))
+    tols = tuple((t.key, t.operator, t.value, t.effect)
+                 for t in pod.spec.tolerations)
+    gpus = tuple(sorted(
+        (k, q.nano) for k, q in res.gpu_limits_for(pod).items()))
+    return (tuple(rows), tols, gpus)
+
+
+def constraints_key_parts(c: Constraints) -> tuple:
+    """The (requirements, taints, labels) parts of the schedule group key —
+    scheduler.go:100-110 SlicesAsSets semantics (order-insensitive).
+    scheduler._constraints_key is these parts + the GPU-request axis."""
+    reqs = tuple(sorted(
+        (r.key, r.operator, tuple(sorted(r.values)))
+        for r in c.requirements.items))
+    taints = tuple(sorted((t.key, t.value, t.effect) for t in c.taints))
+    labels = tuple(sorted(c.labels.items()))
+    return (reqs, taints, labels)
+
+
+def validate_pod_fast(constraints: Constraints, pod: Pod) -> Optional[str]:
+    """Engine-accelerated ``constraints.validate_pod(pod)`` — identical
+    verdicts and error strings, scalar on any fallback condition."""
+    cc = compile_constraints(constraints)
+    if cc is None:
+        return constraints.validate_pod(pod)
+    return cc.validate(pod)
+
+
+# -- whole-catalog feasibility mask ------------------------------------------
+#
+# The type axis is the real batch here: columns over instance types, one
+# boolean lookup per allowed-set, combined with elementwise AND (numpy, or
+# JAX behind KARPENTER_FEASIBILITY_BACKEND=jax). Memoized by catalog
+# generation (a monotonic token per InstanceType object, the adapter's
+# _instance_token pattern) + allowed sets + required resources.
+
+_token_counter = itertools.count(1)
+_CATALOG_LOCK = threading.Lock()
+_INDEX_CACHE: dict = {}
+_INDEX_CACHE_CAP = 8
+_INDEX_FAILED = object()
+_MASK_CACHE: dict = {}
+_MASK_CACHE_CAP = 128
+
+_GPU_CLASSES = (res.NVIDIA_GPU, res.AMD_GPU, res.AWS_NEURON)
+
+
+def _catalog_token(it) -> int:
+    tok = it.__dict__.get("_feas_token")
+    if tok is None:
+        tok = it.__dict__["_feas_token"] = next(_token_counter)
+    return tok
+
+
+class CatalogIndex:
+    """Columnar view of one instance-type catalog."""
+
+    __slots__ = ("n", "name_vocab", "name_col", "arch_vocab", "arch_col",
+                 "os_vocab", "os_mask", "ct_vocab", "zone_vocab",
+                 "offer_type", "offer_ct", "offer_zone", "eni_zero",
+                 "gpu_zero")
+
+
+def _build_catalog_index(instance_types) -> Optional[CatalogIndex]:
+    n = len(instance_types)
+    idx = CatalogIndex()
+    idx.n = n
+    idx.name_vocab = {}
+    idx.arch_vocab = {}
+    idx.os_vocab = {}
+    idx.ct_vocab = {}
+    idx.zone_vocab = {}
+    idx.name_col = np.zeros(n, np.int32)
+    idx.arch_col = np.zeros(n, np.int32)
+    idx.os_mask = np.zeros(n, np.uint64)
+    idx.eni_zero = np.zeros(n, bool)
+    idx.gpu_zero = {name: np.zeros(n, bool) for name in _GPU_CLASSES}
+    ot: List[int] = []
+    oc: List[int] = []
+    oz: List[int] = []
+    for t, it in enumerate(instance_types):
+        idx.name_col[t] = idx.name_vocab.setdefault(it.name, len(idx.name_vocab))
+        idx.arch_col[t] = idx.arch_vocab.setdefault(
+            it.architecture, len(idx.arch_vocab))
+        m = 0
+        for os_name in it.operating_systems:
+            b = idx.os_vocab.setdefault(os_name, len(idx.os_vocab))
+            if b >= 64:
+                # a single uint64 word per type keeps the column dense;
+                # catalogs with >64 distinct OS values use the scalar path
+                return None
+            m |= 1 << b
+        idx.os_mask[t] = m
+        for o in it.offerings:
+            ot.append(t)
+            oc.append(idx.ct_vocab.setdefault(o.capacity_type, len(idx.ct_vocab)))
+            oz.append(idx.zone_vocab.setdefault(o.zone, len(idx.zone_vocab)))
+        idx.eni_zero[t] = it.aws_pod_eni.is_zero()
+        idx.gpu_zero[res.NVIDIA_GPU][t] = it.nvidia_gpus.is_zero()
+        idx.gpu_zero[res.AMD_GPU][t] = it.amd_gpus.is_zero()
+        idx.gpu_zero[res.AWS_NEURON][t] = it.aws_neurons.is_zero()
+    idx.offer_type = np.array(ot, np.int64)
+    idx.offer_ct = np.array(oc, np.int64)
+    idx.offer_zone = np.array(oz, np.int64)
+    return idx
+
+
+def _vocab_ok(vocab: Dict[str, int], allowed) -> np.ndarray:
+    """Boolean lookup table over a local vocab. ``allowed`` None rejects
+    everything — Go's sets.Has(nil) is false (adapter._validate's note)."""
+    ok = np.zeros(len(vocab), bool)
+    if allowed:
+        for v, i in vocab.items():
+            if v in allowed:
+                ok[i] = True
+    return ok
+
+
+def _combine_columns(cols, n: int) -> np.ndarray:
+    if os.environ.get("KARPENTER_FEASIBILITY_BACKEND", "").strip() == "jax":
+        try:
+            import jax.numpy as jnp
+
+            acc = jnp.ones(n, bool)
+            for c in cols:
+                acc = acc & jnp.asarray(c)
+            return np.asarray(acc)
+        except Exception:
+            FILTER_FALLBACK_TOTAL.inc(reason="jax-backend-unavailable")
+    acc = np.ones(n, bool)
+    for c in cols:
+        acc &= c
+    return acc
+
+
+def _compute_mask(idx: CatalogIndex, allowed: tuple,
+                  required: frozenset) -> np.ndarray:
+    cts, zones, its, archs, oss = allowed
+    n = idx.n
+    ct_ok = _vocab_ok(idx.ct_vocab, cts)
+    zone_ok = _vocab_ok(idx.zone_vocab, zones)
+    row_ok = ct_ok[idx.offer_ct] & zone_ok[idx.offer_zone]
+    offer_ok = np.bincount(
+        idx.offer_type[row_ok], minlength=n).astype(bool)[:n]
+    name_ok = _vocab_ok(idx.name_vocab, its)[idx.name_col]
+    arch_ok = _vocab_ok(idx.arch_vocab, archs)[idx.arch_col]
+    os_bits = 0
+    if oss:
+        for v, b in idx.os_vocab.items():
+            if v in oss:
+                os_bits |= 1 << b
+    os_ok = (idx.os_mask & np.uint64(os_bits)) != 0
+    cols = [offer_ok, name_ok, arch_ok, os_ok]
+    if res.AWS_POD_ENI in required:
+        cols.append(~idx.eni_zero)
+    for name in _GPU_CLASSES:
+        zero = idx.gpu_zero[name]
+        # GPU classes are exclusive both ways (packable.go:205-219)
+        cols.append(~zero if name in required else zero)
+    mask = _combine_columns(cols, n)
+    mask.flags.writeable = False
+    return mask
+
+
+def catalog_feasibility_mask(instance_types, allowed: tuple,
+                             required: frozenset) -> Optional[np.ndarray]:
+    """Per-type viability (True = adapter._validate would return None) for
+    the whole catalog, or None when the catalog cannot be indexed. The
+    result array is shared and read-only."""
+    tokens = tuple(_catalog_token(it) for it in instance_types)
+    mkey = (tokens, allowed, required)
+    with _CATALOG_LOCK:
+        hit = _MASK_CACHE.get(mkey)
+        if hit is not None:
+            return hit
+        idx = _INDEX_CACHE.get(tokens)
+    if idx is _INDEX_FAILED:
+        return None
+    t0 = time.perf_counter()
+    if idx is None:
+        idx = _build_catalog_index(instance_types)
+        if idx is None:
+            FILTER_FALLBACK_TOTAL.inc(reason="os-vocab-overflow")
+            with _CATALOG_LOCK:
+                if len(_INDEX_CACHE) >= _INDEX_CACHE_CAP:
+                    _INDEX_CACHE.pop(next(iter(_INDEX_CACHE)))
+                _INDEX_CACHE[tokens] = _INDEX_FAILED
+            return None
+        with _CATALOG_LOCK:
+            if len(_INDEX_CACHE) >= _INDEX_CACHE_CAP:
+                _INDEX_CACHE.pop(next(iter(_INDEX_CACHE)))
+            _INDEX_CACHE[tokens] = idx
+    mask = _compute_mask(idx, allowed, required)
+    FILTER_BATCH_SECONDS.observe(time.perf_counter() - t0, stage="catalog")
+    with _CATALOG_LOCK:
+        if len(_MASK_CACHE) >= _MASK_CACHE_CAP:
+            _MASK_CACHE.pop(next(iter(_MASK_CACHE)))
+        _MASK_CACHE[mkey] = mask
+    return mask
+
+
+def clear_catalog_caches() -> None:
+    """Tests only."""
+    with _CATALOG_LOCK:
+        _INDEX_CACHE.clear()
+        _MASK_CACHE.clear()

@@ -28,6 +28,7 @@ from typing import List, Optional, Sequence, Tuple
 from karpenter_tpu.api.constraints import Constraints
 from karpenter_tpu.api.core import Pod
 from karpenter_tpu.cloudprovider.spi import InstanceType
+from karpenter_tpu.ops import feasibility
 from karpenter_tpu.solver.host_ffd import (
     NUM_RESOURCES, Packable, R_AMD, R_CPU, R_EXOTIC, R_MEMORY, R_NEURON,
     R_NVIDIA, R_POD_ENI, R_PODS, Vec, pack_one,
@@ -350,9 +351,18 @@ def _build_packables_from(
     daemon_vecs: Sequence[Vec],
     required: frozenset,
 ) -> Tuple[List[Packable], List[InstanceType]]:
+    # whole-catalog viability as one columnar mask (memoized by catalog
+    # generation + allowed + required); None = catalog not indexable, use
+    # the scalar per-type validators. Same verdicts either way —
+    # tests/test_feasibility.py fuzzes the mask against _validate.
+    mask = feasibility.catalog_feasibility_mask(
+        instance_types, allowed, required)
     viable: List[Tuple[Vec, InstanceType, Packable]] = []
-    for it in instance_types:
-        if _validate(it, allowed, required) is not None:
+    for t, it in enumerate(instance_types):
+        if mask is not None:
+            if not mask[t]:
+                continue
+        elif _validate(it, allowed, required) is not None:
             continue
         totals = instance_totals(it)
         p = Packable(index=-1, total=list(totals), reserved=[0] * NUM_RESOURCES)

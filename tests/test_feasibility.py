@@ -1,0 +1,483 @@
+"""Property/fuzz suite for the columnar feasibility engine.
+
+The contract api/requirements.py declares: ops/feasibility.py is the
+vectorized (interned bitset) twin of the scalar requirement algebra,
+property-tested against it. Every test here compares the engine's RAW
+verdicts/masks — not the self-healing production wrappers — against the
+scalar oracle, so a divergence cannot hide behind the fallback path.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.constraints import Constraints, Taints
+from karpenter_tpu.api.core import (
+    Affinity, Container, NodeAffinity, NodeSelectorRequirement,
+    NodeSelectorTerm, Pod, PreferredSchedulingTerm, ResourceRequirements,
+    Taint, Toleration,
+)
+from karpenter_tpu.api.requirements import IN, NOT_IN, Requirements
+from karpenter_tpu.cloudprovider.spi import InstanceType, Offering
+from karpenter_tpu.ops import feasibility
+from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.scheduling.scheduler import Scheduler, _constraints_key
+from karpenter_tpu.solver import adapter
+from karpenter_tpu.utils import fastcopy
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.resources import Quantity
+
+ZONE = wellknown.LABEL_TOPOLOGY_ZONE
+OS = wellknown.LABEL_OS
+ARCH = wellknown.LABEL_ARCH
+
+# canonical key → (alias keys usable on either side, value pool)
+_ALIASES = {}
+for alias, canon in wellknown.NORMALIZED_LABELS.items():
+    _ALIASES.setdefault(canon, []).append(alias)
+
+_POOLS = {
+    ZONE: ["us-1a", "us-1b", "us-1c", "eu-9a"],
+    OS: ["linux", "windows", "bottlerocket"],
+    ARCH: ["amd64", "arm64"],
+    wellknown.LABEL_INSTANCE_TYPE: ["m5.large", "m5.xlarge", "c5.large"],
+    "example.com/team": ["red", "blue", "green"],
+    "env": ["dev", "prod"],
+}
+_CANON_KEYS = list(_POOLS)
+
+
+def _rand_values(rng, canon, allow_empty=True):
+    pool = _POOLS[canon]
+    lo = 0 if allow_empty else 1
+    return rng.sample(pool, rng.randint(lo, min(3, len(pool))))
+
+
+def _maybe_alias(rng, canon):
+    aliases = _ALIASES.get(canon)
+    if aliases and rng.random() < 0.3:
+        return rng.choice(aliases)
+    return canon
+
+
+def rand_constraints(rng) -> Constraints:
+    rows = []
+    for _ in range(rng.randint(0, 6)):
+        canon = rng.choice(_CANON_KEYS)
+        op = rng.choice([IN, IN, IN, NOT_IN, NOT_IN, "Exists"])
+        rows.append(NodeSelectorRequirement(
+            key=_maybe_alias(rng, canon), operator=op,
+            values=_rand_values(rng, canon)))
+    if rng.random() < 0.5:
+        # production style: add() normalizes alias keys
+        reqs = Requirements().add(*rows)
+    else:
+        # raw items, as a deepcopied live list would hold them — keeps the
+        # literal-key alias quirk in play
+        reqs = Requirements(rows)
+    taints = Taints(
+        Taint(key=rng.choice(["a", "b"]), value=rng.choice(["x", "y"]),
+              effect=rng.choice(["NoSchedule", "NoExecute"]))
+        for _ in range(rng.randint(0, 2)))
+    labels = {f"l{i}": "1" for i in range(rng.randint(0, 2))}
+    return Constraints(labels=labels, taints=taints, requirements=reqs)
+
+
+def rand_pod(rng, i=0, ops=(IN, IN, NOT_IN, "Exists")) -> Pod:
+    pod = Pod()
+    pod.metadata.name = f"fuzz-{i}"
+    for _ in range(rng.randint(0, 2)):
+        canon = rng.choice(_CANON_KEYS)
+        pod.spec.node_selector[_maybe_alias(rng, canon)] = rng.choice(
+            _POOLS[canon] + ["unseen-value"])
+    if rng.random() < 0.7:
+        def term():
+            return NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(
+                    key=_maybe_alias(rng, canon2), operator=rng.choice(list(ops)),
+                    values=_rand_values(rng, canon2))
+                for canon2 in rng.sample(_CANON_KEYS, rng.randint(0, 2))])
+        na = NodeAffinity()
+        for w in range(rng.randint(0, 2)):
+            na.preferred.append(
+                PreferredSchedulingTerm(weight=rng.randint(1, 3), preference=term()))
+        if rng.random() < 0.6:
+            na.required = [term()]
+        pod.spec.affinity = Affinity(node_affinity=na)
+    for _ in range(rng.randint(0, 2)):
+        op = rng.choice(["Equal", "Exists"])
+        pod.spec.tolerations.append(Toleration(
+            key=rng.choice(["a", "b", ""]), operator=op,
+            # Exists with a value is the core/v1 "must not carry a value"
+            # quirk — generate it on purpose
+            value=rng.choice(["x", "y", ""]),
+            effect=rng.choice(["NoSchedule", "NoExecute", ""])))
+    if rng.random() < 0.2:
+        pod.spec.containers.append(Container(resources=ResourceRequirements.make(
+            limits={rng.choice(["nvidia.com/gpu", "amd.com/gpu"]): "1"})))
+    return pod
+
+
+def compatible_pod(rng, c: Constraints, i=0) -> Pod:
+    """A pod biased toward satisfying ``c``: selectors drawn from the
+    constraints' own allowed sets, tolerations matching its taints. (Raw
+    alias constraint keys still fail — the literal-key quirk — which keeps
+    this a bias, not a guarantee.)"""
+    pod = Pod()
+    pod.metadata.name = f"compat-{i}"
+    for key in c.requirements.keys():
+        allowed = c.requirements.requirement(key)
+        if allowed and rng.random() < 0.8:
+            pod.spec.node_selector[key] = rng.choice(sorted(allowed))
+    for t in c.taints:
+        pod.spec.tolerations.append(Toleration(
+            key=t.key, operator="Equal", value=t.value, effect=t.effect))
+    return pod
+
+
+class TestFuzzValidate:
+    def test_zero_divergence_raw_verdicts(self):
+        """≥200 random (constraints, pod) cases: the raw bitset verdict
+        equals the scalar oracle, and the production wrapper reproduces the
+        exact error string."""
+        rng = random.Random(0xC0FFEE)
+        compared = 0
+        for i in range(400):
+            c = rand_constraints(rng)
+            pod = rand_pod(rng, i)
+            cc = feasibility.compile_constraints(c)
+            assert cc is not None
+            scalar = c.validate_pod(pod)
+            sig = feasibility.pod_signature(pod)
+            assert sig is not None  # ops drawn from the supported set
+            assert cc._raw_ok(sig) == (scalar is None), (
+                f"case {i}: raw={cc._raw_ok(sig)} scalar={scalar!r} "
+                f"reqs={c.requirements!r} sel={pod.spec.node_selector}")
+            assert feasibility.validate_pod_fast(c, pod) == scalar
+            compared += 1
+        assert compared >= 200
+
+    def test_group_key_and_tighten_parity(self):
+        """Schedulable pods: schedule_entry's memoized (tighten, key) are
+        structurally identical to the per-pod scalar computation."""
+        rng = random.Random(0xBEEF)
+        checked = 0
+        for i in range(300):
+            c = rand_constraints(rng)
+            pod = (compatible_pod(rng, c, i) if i % 2 else rand_pod(rng, i))
+            cc = feasibility.compile_constraints(c)
+            err, tightened, key = cc.schedule_entry(pod)
+            scalar = c.validate_pod(pod)
+            assert (err is None) == (scalar is None)
+            if err is not None:
+                assert err == scalar
+                continue
+            ref = c.tighten(pod)
+            ref_key = _constraints_key(ref, res.gpu_limits_for(pod))
+            assert key == ref_key
+            assert (feasibility.constraints_key_parts(tightened)
+                    == feasibility.constraints_key_parts(ref))
+            assert tightened.labels is c.labels and tightened.taints is c.taints
+            checked += 1
+        assert checked >= 50
+
+    def test_memoized_entry_identical_across_pods(self):
+        """Two pods with the same shape share one memoized tighten — and it
+        is structurally identical to tightening each per-pod (the scalar
+        path the memo replaced)."""
+        c = Constraints(requirements=Requirements().add(
+            NodeSelectorRequirement(key=ZONE, operator=IN,
+                                    values=["us-1a", "us-1b"])))
+        p1, p2 = Pod(), Pod()
+        for p, n in ((p1, "a"), (p2, "b")):
+            p.metadata.name = n
+            p.spec.node_selector = {ZONE: "us-1a"}
+        cc = feasibility.compile_constraints(c)
+        _, t1, k1 = cc.schedule_entry(p1)
+        _, t2, k2 = cc.schedule_entry(p2)
+        assert t1 is t2 and k1 == k2  # one tighten per signature
+        for p in (p1, p2):
+            ref = c.tighten(p)
+            assert _constraints_key(ref, res.gpu_limits_for(p)) == k1
+            assert (feasibility.constraints_key_parts(ref)
+                    == feasibility.constraints_key_parts(t1))
+
+    def test_unsupported_operator_falls_back(self):
+        c = rand_constraints(random.Random(1))
+        pod = Pod()
+        pod.spec.affinity = Affinity(node_affinity=NodeAffinity(required=[
+            NodeSelectorTerm(match_expressions=[NodeSelectorRequirement(
+                key="example.com/team", operator="Gt", values=["5"])])]))
+        before = feasibility.FILTER_FALLBACK_TOTAL.collect().get(
+            (("reason", "unsupported-operator"),), 0.0)
+        assert feasibility.pod_signature(pod) is None
+        assert feasibility.validate_pod_fast(c, pod) == c.validate_pod(pod)
+        after = feasibility.FILTER_FALLBACK_TOTAL.collect()[
+            (("reason", "unsupported-operator"),)]
+        assert after > before
+
+
+class TestQuirks:
+    def test_notin_without_in_collapses(self):
+        """requirements.go:189-194: NotIn with no In is empty, not
+        unconstrained — including an empty NotIn values list."""
+        for values in (["us-1a"], []):
+            c = Constraints(requirements=Requirements([
+                NodeSelectorRequirement(key=ZONE, operator=NOT_IN, values=values)]))
+            pod = Pod()
+            pod.spec.node_selector = {ZONE: "us-1b"}
+            assert c.requirements.requirement(ZONE) == frozenset()
+            scalar = c.validate_pod(pod)
+            assert scalar is not None
+            assert feasibility.validate_pod_fast(c, pod) == scalar
+            sig = feasibility.pod_signature(pod)
+            assert not feasibility.compile_constraints(c)._raw_ok(sig)
+
+    def test_in_and_notin_subtract(self):
+        c = Constraints(requirements=Requirements([
+            NodeSelectorRequirement(key=ZONE, operator=IN, values=["us-1a", "us-1b"]),
+            NodeSelectorRequirement(key=ZONE, operator=NOT_IN, values=["us-1b"])]))
+        ok, bad = Pod(), Pod()
+        ok.spec.node_selector = {ZONE: "us-1a"}
+        bad.spec.node_selector = {ZONE: "us-1b"}
+        assert feasibility.validate_pod_fast(c, ok) is None
+        assert feasibility.validate_pod_fast(c, bad) == c.validate_pod(bad)
+        assert c.validate_pod(bad) is not None
+
+    def test_empty_in_values_collapse(self):
+        c = Constraints(requirements=Requirements([
+            NodeSelectorRequirement(key=ZONE, operator=IN, values=[])]))
+        pod = Pod()
+        pod.spec.node_selector = {ZONE: "us-1a"}
+        scalar = c.validate_pod(pod)
+        assert scalar is not None
+        assert feasibility.validate_pod_fast(c, pod) == scalar
+
+    def test_alias_normalized_on_pod_literal_on_constraints(self):
+        # pod selects via the beta alias; constraints constrain the
+        # canonical key → normalization makes them meet
+        c = Constraints(requirements=Requirements().add(
+            NodeSelectorRequirement(key=ZONE, operator=IN, values=["us-1a"])))
+        pod = Pod()
+        pod.spec.node_selector = {
+            wellknown.LABEL_FAILURE_DOMAIN_BETA_ZONE: "us-1a"}
+        assert c.validate_pod(pod) is None
+        assert feasibility.validate_pod_fast(c, pod) is None
+        # constraints holding a RAW alias row never match the normalized
+        # pod key — requirement() matches literally
+        c2 = Constraints(requirements=Requirements([
+            NodeSelectorRequirement(
+                key=wellknown.LABEL_FAILURE_DOMAIN_BETA_ZONE,
+                operator=IN, values=["us-1a"])]))
+        scalar = c2.validate_pod(pod)
+        assert scalar is not None
+        assert feasibility.validate_pod_fast(c2, pod) == scalar
+
+    def test_exists_toleration_value_quirk(self):
+        c = Constraints(taints=Taints([Taint(key="a", value="x",
+                                             effect="NoSchedule")]))
+        pod = Pod()
+        pod.spec.tolerations = [Toleration(key="a", operator="Exists",
+                                           value="x", effect="NoSchedule")]
+        scalar = c.validate_pod(pod)  # Exists must not carry a value
+        assert scalar is not None
+        assert feasibility.validate_pod_fast(c, pod) == scalar
+
+    def test_constraint_side_unsupported_ops_are_skipped(self):
+        # requirement() ignores non-In/NotIn constraint rows entirely
+        c = Constraints(requirements=Requirements([
+            NodeSelectorRequirement(key=ZONE, operator="Exists", values=[])]))
+        pod = Pod()
+        pod.spec.node_selector = {ZONE: "us-1a"}
+        scalar = c.validate_pod(pod)  # own requirement is None → fail
+        assert scalar is not None
+        assert feasibility.validate_pod_fast(c, pod) == scalar
+
+
+class TestInternTable:
+    def test_generation_reset_keeps_verdicts(self, monkeypatch):
+        feasibility.reset_intern_table()
+        monkeypatch.setattr(feasibility, "_INTERN_MAX", 4)
+        _, gen0 = feasibility.intern_table_stats()
+        rng = random.Random(7)
+        for i in range(30):
+            c = rand_constraints(rng)
+            pod = rand_pod(rng, i)
+            assert feasibility.validate_pod_fast(c, pod) == c.validate_pod(pod)
+        _, gen1 = feasibility.intern_table_stats()
+        assert gen1 > gen0  # the cap forced at least one reset
+
+    def test_compiled_object_survives_reset(self):
+        c = Constraints(requirements=Requirements().add(
+            NodeSelectorRequirement(key=ZONE, operator=IN, values=["us-1a"])))
+        cc = feasibility.compile_constraints(c)
+        pod = Pod()
+        pod.spec.node_selector = {ZONE: "us-1a"}
+        assert cc.validate(pod) is None
+        feasibility.reset_intern_table()
+        # old per-key dicts are unshared but intact: verdicts unchanged
+        assert cc.validate(pod) is None
+        pod2 = Pod()
+        pod2.spec.node_selector = {ZONE: "us-1b"}
+        assert cc.validate(pod2) == c.validate_pod(pod2)
+
+    def test_size_gauge_tracks_interning(self):
+        feasibility.reset_intern_table()
+        c = Constraints(requirements=Requirements().add(
+            NodeSelectorRequirement(key=ZONE, operator=IN,
+                                    values=["us-1a", "us-1b", "us-1c"])))
+        feasibility.compile_constraints(c)
+        size, _ = feasibility.intern_table_stats()
+        assert size == 3
+        assert feasibility.FILTER_INTERN_TABLE_SIZE.collect()[()] == 3.0
+
+
+class TestCopySemantics:
+    def test_deepcopy_recompiles_never_shares_stale(self):
+        c = Constraints(requirements=Requirements().add(
+            NodeSelectorRequirement(key=ZONE, operator=IN, values=["us-1a"])))
+        cc = feasibility.compile_constraints(c)
+        for copy_ in (c.deepcopy(), fastcopy.deep_copy(c)):
+            cc2 = feasibility.compile_constraints(copy_)
+            assert cc2 is not cc  # identity fingerprint mismatched
+            pod = Pod()
+            pod.spec.node_selector = {ZONE: "us-1a"}
+            assert cc2.validate(pod) is None
+
+    def test_mutation_is_detected_by_length(self):
+        # topology.inject appends rows in place — the fingerprint must
+        # observe it and recompile
+        c = Constraints(requirements=Requirements().add(
+            NodeSelectorRequirement(key=ZONE, operator=IN, values=["us-1a"])))
+        cc = feasibility.compile_constraints(c)
+        c.requirements.items.append(NodeSelectorRequirement(
+            key=wellknown.LABEL_HOSTNAME, operator=IN, values=["h-1"]))
+        cc2 = feasibility.compile_constraints(c)
+        assert cc2 is not cc
+        pod = Pod()
+        pod.spec.node_selector = {wellknown.LABEL_HOSTNAME: "h-2"}
+        assert cc2.validate(pod) == c.validate_pod(pod)
+        assert c.validate_pod(pod) is not None
+
+
+def _q(n):
+    return Quantity(int(n) * 10**9)
+
+
+def rand_instance_type(rng, i) -> InstanceType:
+    offerings = [
+        Offering(rng.choice(["spot", "on-demand"]),
+                 rng.choice(["us-1a", "us-1b", "eu-9a"]))
+        for _ in range(rng.randint(0, 3))
+    ]
+    return InstanceType(
+        name=f"it-{i % 7}",
+        offerings=offerings,
+        architecture=rng.choice(["amd64", "arm64"]),
+        operating_systems=frozenset(
+            rng.sample(["linux", "windows", "bottlerocket"],
+                       rng.randint(0, 2))),
+        cpu=_q(4), memory=_q(16), pods=_q(110),
+        nvidia_gpus=_q(rng.choice([0, 0, 1])),
+        amd_gpus=_q(rng.choice([0, 0, 1])),
+        aws_neurons=_q(rng.choice([0, 0, 1])),
+        aws_pod_eni=_q(rng.choice([0, 1])),
+    )
+
+
+def _rand_allowed(rng):
+    def some(pool):
+        if rng.random() < 0.2:
+            return None  # unconstrained set REJECTS (Go sets.Has(nil))
+        return frozenset(rng.sample(pool, rng.randint(0, len(pool))))
+    return (some(["spot", "on-demand"]),
+            some(["us-1a", "us-1b", "eu-9a"]),
+            some([f"it-{j}" for j in range(7)]),
+            some(["amd64", "arm64"]),
+            some(["linux", "windows", "bottlerocket"]))
+
+
+class TestCatalogMask:
+    def test_fuzz_mask_matches_scalar_validate(self):
+        rng = random.Random(0xFACE)
+        for case in range(120):
+            catalog = [rand_instance_type(rng, i)
+                       for i in range(rng.randint(0, 12))]
+            allowed = _rand_allowed(rng)
+            required = frozenset(rng.sample(
+                [res.AWS_POD_ENI, res.NVIDIA_GPU, res.AMD_GPU,
+                 res.AWS_NEURON], rng.randint(0, 2)))
+            mask = feasibility.catalog_feasibility_mask(
+                catalog, allowed, required)
+            assert mask is not None
+            ref = [adapter._validate(it, allowed, required) is None
+                   for it in catalog]
+            assert list(mask) == ref, f"case {case}: {list(mask)} != {ref}"
+
+    def test_mask_is_memoized_and_readonly(self):
+        rng = random.Random(3)
+        catalog = [rand_instance_type(rng, i) for i in range(5)]
+        allowed = _rand_allowed(rng)
+        m1 = feasibility.catalog_feasibility_mask(catalog, allowed, frozenset())
+        m2 = feasibility.catalog_feasibility_mask(catalog, allowed, frozenset())
+        assert m1 is m2
+        assert not m1.flags.writeable
+
+    def test_os_vocab_overflow_falls_back(self):
+        rng = random.Random(4)
+        it = rand_instance_type(rng, 0)
+        it.operating_systems = frozenset(f"os-{i}" for i in range(70))
+        assert feasibility.catalog_feasibility_mask(
+            [it], _rand_allowed(rng), frozenset()) is None
+
+    def test_build_packables_uses_mask(self, monkeypatch):
+        """The adapter path with the mask equals the scalar path with the
+        mask disabled, on the same inputs."""
+        rng = random.Random(5)
+        catalog = [rand_instance_type(rng, i) for i in range(10)]
+        for it in catalog:
+            it.offerings = [Offering("on-demand", "us-1a")]
+            it.operating_systems = frozenset({"linux"})
+            it.nvidia_gpus = it.amd_gpus = it.aws_neurons = _q(0)
+            it.aws_pod_eni = _q(0)
+        allowed = (frozenset({"on-demand"}), frozenset({"us-1a"}),
+                   frozenset(it.name for it in catalog),
+                   frozenset({"amd64", "arm64"}), frozenset({"linux"}))
+        with_mask = adapter._build_packables_from(catalog, allowed, (), frozenset())
+        monkeypatch.setattr(feasibility, "catalog_feasibility_mask",
+                            lambda *a, **k: None)
+        scalar = adapter._build_packables_from(catalog, allowed, (), frozenset())
+        assert [t.name for t in with_mask[1]] == [t.name for t in scalar[1]]
+        assert [p.total for p in with_mask[0]] == [p.total for p in scalar[0]]
+
+
+class TestSchedulerIntegration:
+    def test_window_equals_reference_scalar_loop(self):
+        """A whole window through the engine-backed _get_schedules equals
+        the reference per-pod scalar loop: same group keys, same order,
+        same pod membership, same tightened structure."""
+        rng = random.Random(0xD00D)
+        scheduler = Scheduler(KubeCore())
+        for case in range(20):
+            c = rand_constraints(rng)
+            pods = [rand_pod(rng, i) for i in range(25)]
+            got = scheduler._get_schedules(c, pods)
+            # reference loop (the pre-columnar implementation)
+            ref = {}
+            for pod in pods:
+                if c.validate_pod(pod) is not None:
+                    continue
+                tightened = c.tighten(pod)
+                key = _constraints_key(tightened, res.gpu_limits_for(pod))
+                ref.setdefault(key, []).append(pod.metadata.name)
+            got_map = {
+                _constraints_key(
+                    s.constraints,
+                    res.gpu_limits_for(s.pods[0])): [
+                        p.metadata.name for p in s.pods]
+                for s in got}
+            assert got_map == ref, f"case {case}"
+            assert [list(v) for v in got_map.values()] == list(ref.values())

@@ -1,0 +1,57 @@
+"""Registration + exposition of the feasibility filter metrics."""
+
+from __future__ import annotations
+
+from karpenter_tpu.metrics import filter as mfilter
+from karpenter_tpu.metrics.registry import DEFAULT, Counter, Gauge, Histogram
+
+
+class TestFilterMetricsRegistration:
+    def test_registered_on_default_registry(self):
+        assert isinstance(
+            DEFAULT.histogram("filter_batch_seconds"), Histogram)
+        assert DEFAULT.histogram("filter_batch_seconds") is \
+            mfilter.FILTER_BATCH_SECONDS
+        assert isinstance(DEFAULT.counter("filter_fallback_total"), Counter)
+        assert DEFAULT.counter("filter_fallback_total") is \
+            mfilter.FILTER_FALLBACK_TOTAL
+        assert isinstance(DEFAULT.gauge("filter_intern_table_size"), Gauge)
+        assert DEFAULT.gauge("filter_intern_table_size") is \
+            mfilter.FILTER_INTERN_TABLE_SIZE
+
+    def test_exposition_names_carry_karpenter_prefix(self):
+        mfilter.FILTER_BATCH_SECONDS.observe(0.004, stage="schedule")
+        mfilter.FILTER_FALLBACK_TOTAL.inc(reason="unsupported-operator")
+        mfilter.FILTER_INTERN_TABLE_SIZE.set(17)
+        text = DEFAULT.expose()
+        assert "# TYPE karpenter_filter_batch_seconds histogram" in text
+        assert 'karpenter_filter_batch_seconds_bucket{stage="schedule"' in text
+        assert "# TYPE karpenter_filter_fallback_total counter" in text
+        assert 'karpenter_filter_fallback_total{reason="unsupported-operator"}' in text
+        assert "# TYPE karpenter_filter_intern_table_size gauge" in text
+        assert "karpenter_filter_intern_table_size{} 17" in text
+
+    def test_engine_drives_the_metrics(self):
+        """One scheduler window observes the histogram; compile updates the
+        intern gauge."""
+        from karpenter_tpu.api.constraints import Constraints
+        from karpenter_tpu.api.core import NodeSelectorRequirement, Pod
+        from karpenter_tpu.api.requirements import Requirements
+        from karpenter_tpu.ops import feasibility
+        from karpenter_tpu.runtime.kubecore import KubeCore
+        from karpenter_tpu.scheduling.scheduler import Scheduler
+
+        before = mfilter.FILTER_BATCH_SECONDS.collect().get(
+            (("stage", "schedule"),), (None, 0.0, 0))[2]
+        c = Constraints(requirements=Requirements().add(
+            NodeSelectorRequirement(
+                key="topology.kubernetes.io/zone", operator="In",
+                values=["us-1a"])))
+        pod = Pod()
+        pod.spec.node_selector = {"topology.kubernetes.io/zone": "us-1a"}
+        Scheduler(KubeCore())._get_schedules(c, [pod])
+        after = mfilter.FILTER_BATCH_SECONDS.collect()[
+            (("stage", "schedule"),)][2]
+        assert after == before + 1
+        feasibility.reset_intern_table()
+        assert mfilter.FILTER_INTERN_TABLE_SIZE.collect()[()] == 0.0

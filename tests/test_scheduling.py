@@ -391,3 +391,82 @@ class TestRelaxationTTL:
             assert len(fresh.spec.affinity.node_affinity.preferred) == 2
         finally:
             clock.DEFAULT.reset()
+
+
+class TestWindowLogAggregation:
+    """Scheduler._get_schedules logs one summary line per window instead of
+    one line per unschedulable pod (50k-pod windows must not pay O(N) log
+    I/O)."""
+
+    def test_single_summary_line_with_sample_reasons(self, caplog):
+        import logging
+
+        from karpenter_tpu.scheduling.scheduler import Scheduler
+
+        constraints = Constraints(requirements=Requirements().add(
+            Req(key=ZONE, operator="In", values=["test-zone-1"])))
+        pods = [unschedulable_pod(node_selector={ZONE: "test-zone-1"},
+                                  name="ok-1")]
+        for i in range(8):
+            pods.append(unschedulable_pod(
+                node_selector={ZONE: f"nope-{i}"}, name=f"bad-{i}"))
+        with caplog.at_level(logging.INFO, logger="karpenter.scheduler"):
+            schedules = Scheduler(KubeCore())._get_schedules(constraints, pods)
+        assert len(schedules) == 1 and len(schedules[0].pods) == 1
+        records = [r for r in caplog.records
+                   if "unable to schedule" in r.getMessage()]
+        assert len(records) == 1
+        message = records[0].getMessage()
+        assert "8/9" in message
+        # at most 5 sample reasons, each naming a pod and the scalar error
+        assert message.count("invalid nodeSelector") == 5
+        assert "default/bad-0" in message
+
+    def test_no_line_when_everything_schedules(self, caplog):
+        import logging
+
+        from karpenter_tpu.scheduling.scheduler import Scheduler
+
+        constraints = Constraints(requirements=Requirements().add(
+            Req(key=ZONE, operator="In", values=["test-zone-1"])))
+        pods = [unschedulable_pod(name=f"p-{i}") for i in range(3)]
+        with caplog.at_level(logging.INFO, logger="karpenter.scheduler"):
+            Scheduler(KubeCore())._get_schedules(constraints, pods)
+        assert not [r for r in caplog.records
+                    if "unable to schedule" in r.getMessage()]
+
+
+class TestMemoizedTighten:
+    """The scheduler memoizes constraints.tighten() per group signature;
+    the memoized result must be structurally identical to tightening every
+    pod individually (the pre-columnar behavior)."""
+
+    def test_memoized_equals_per_pod(self):
+        from karpenter_tpu.ops import feasibility
+        from karpenter_tpu.scheduling.scheduler import (
+            Scheduler, _constraints_key,
+        )
+        from karpenter_tpu.utils import resources as res
+
+        constraints = Constraints(
+            labels={"team": "infra"},
+            requirements=Requirements().add(
+                Req(key=ZONE, operator="In",
+                    values=["test-zone-1", "test-zone-2"])))
+        pods = [unschedulable_pod(node_selector={ZONE: "test-zone-1"},
+                                  name=f"p-{i}") for i in range(6)]
+        pods += [unschedulable_pod(node_selector={ZONE: "test-zone-2"},
+                                   name=f"q-{i}") for i in range(6)]
+        schedules = Scheduler(KubeCore())._get_schedules(constraints, pods)
+        assert len(schedules) == 2
+        assert sorted(len(s.pods) for s in schedules) == [6, 6]
+        for s in schedules:
+            for pod in s.pods:
+                per_pod = constraints.tighten(pod)
+                assert (_constraints_key(per_pod, res.gpu_limits_for(pod))
+                        == _constraints_key(s.constraints,
+                                            res.gpu_limits_for(pod)))
+                assert (feasibility.constraints_key_parts(per_pod)
+                        == feasibility.constraints_key_parts(s.constraints))
+                assert per_pod.labels == s.constraints.labels
+                assert list(per_pod.taints) == list(s.constraints.taints)
