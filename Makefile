@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes bench-control native dryrun lint chart chaos-soak clean help
+.PHONY: test battletest bench bench-shapes bench-control native dryrun lint chart chaos-soak chaos-overload clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -52,6 +52,14 @@ soak: ## Extended differential soak: 500 fuzz cases + repeated chaos/races
 
 chaos-soak: ## Seeded fault-injection soak (slow); prints seed, replay via KARPENTER_CHAOS_SEED=<n>
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -s -m slow
+
+chaos-overload: ## Brownout soak: 50k-pod flood + pressure faults (slow) after the fast seeded smoke
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_chaos.py::TestOverloadSoak::test_overload_smoke_brownout_and_recovery \
+		tests/test_pressure.py -q -s
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_chaos.py::TestOverloadSoak::test_overload_soak_50k_flood \
+		-q -s -m slow
 
 cardinality-diff: ## One-off full-size 50k×25k-shape differential (hours)
 	python tools/full_cardinality_diff.py

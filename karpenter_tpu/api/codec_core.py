@@ -267,6 +267,7 @@ def pod_spec_from(s: Dict[str, Any]) -> PodSpec:
             for v in (s.get("volumes") or [])
         ],
         priority_class_name=s.get("priorityClassName", ""),
+        priority=int(s.get("priority", 0) or 0),
         preemption_policy=s.get("preemptionPolicy", "PreemptLowerPriority"),
         # 0 is a valid, explicit "delete immediately" — only None defaults
         termination_grace_period_seconds=(
@@ -313,6 +314,8 @@ def pod_spec_to(s: PodSpec) -> Dict[str, Any]:
         ]
     if s.priority_class_name:
         out["priorityClassName"] = s.priority_class_name
+    if s.priority:
+        out["priority"] = s.priority
     out["terminationGracePeriodSeconds"] = s.termination_grace_period_seconds
     return out
 

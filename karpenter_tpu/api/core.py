@@ -190,6 +190,7 @@ class PodSpec:
     topology_spread_constraints: List[TopologySpreadConstraint] = field(default_factory=list)
     volumes: List[Volume] = field(default_factory=list)
     priority_class_name: str = ""
+    priority: int = 0  # resolved priority value (admission stamps it from the class)
     preemption_policy: str = "PreemptLowerPriority"
     termination_grace_period_seconds: int = 30
 

@@ -22,8 +22,10 @@ boundary   op             kinds
 ========== ============== ==========================================
 kube       create/update/ ``conflict`` (409 before the write lands),
            patch/delete/  ``timeout`` (generic ApiError — request
-           bind_pods/     lost before the server applied it)
-           evict_pod
+           bind_pods/     lost before the server applied it),
+           evict_pod      ``slow-apiserver`` (the request succeeds
+                          but only after a synthetic latency stall —
+                          the brownout soak's degraded-apiserver mode)
 kube       watch          ``drop`` (a Pod MODIFIED event vanishes;
                           ADDED/DELETED and non-Pod kinds are never
                           dropped — see :class:`_DroppingWatch`)
@@ -36,7 +38,20 @@ ec2        create_fleet   ``ice``, ``throttle``, ``partial`` (one
                           response lost)
 device     solve          ``watchdog-trip`` (forced solver timeout →
                           breaker opens → host-FFD fallback)
+pressure   depth          ``queue-flood`` (the monitor's intake-depth
+                          sample is inflated by max_depth/2 — a
+                          synthetic 50%-of-bound flood, no real
+                          queue entries allocated)
+pressure   rss            ``memory-pressure`` (the RSS sample is
+                          inflated by 87% of the watermark —
+                          deterministically lands in the L2 band
+                          without allocating memory)
 ========== ============== ==========================================
+
+The ``pressure`` boundary is consumed by
+:class:`karpenter_tpu.pressure.monitor.PressureMonitor` — one
+``decide()`` per monitor evaluation, so ``count`` bounds how many
+evaluations see the inflated sample.
 
 Production call sites consult :func:`active_fault`; with no plan
 installed that is one global read and a ``None`` return.
@@ -237,6 +252,11 @@ class ChaosKube:
     def __init__(self, inner):
         self._inner = inner
 
+    #: synthetic apiserver latency for ``slow-apiserver`` (seconds) — long
+    #: enough to register against the soak's wall clock, short enough that
+    #: a handful of stalls don't dominate it
+    SLOW_APISERVER_STALL_S = 0.25
+
     def _maybe_raise(self, op: str) -> None:
         from karpenter_tpu.runtime.kubecore import ApiError, Conflict
 
@@ -245,6 +265,12 @@ class ChaosKube:
             raise Conflict(f"injected conflict on {op}")
         if kind == "timeout":
             raise ApiError(f"injected timeout on {op}")
+        if kind == "slow-apiserver":
+            # the write SUCCEEDS, just late — models a degraded (not dead)
+            # apiserver; the caller's only symptom is latency
+            import time as _time
+
+            _time.sleep(self.SLOW_APISERVER_STALL_S)
 
     def create(self, obj):
         self._maybe_raise("create")

@@ -44,6 +44,14 @@ class Options:
     # both-directions grace window; 0 interval disables the controller
     gc_interval_seconds: float = 120.0
     gc_grace_seconds: float = 600.0
+    # brownout / pressure ladder (karpenter_tpu/pressure/,
+    # docs/robustness.md §4)
+    pressure_enabled: bool = True
+    pressure_max_depth: int = 100_000       # batcher hard depth bound
+    pressure_rss_watermark_mb: int = 4096   # L3 RSS watermark; 0 disables
+    pressure_dwell_seconds: float = 5.0     # hysteresis dwell per rung
+    pressure_split_items: int = 4096        # L1+ max pods per solve chunk
+    pressure_aging_seconds: float = 60.0    # one band promotion per step
     # AWS provider (options.go:45-49)
     aws_node_name_convention: str = "ip-name"  # ip-name | resource-name
     aws_eni_limited_pod_density: bool = True
@@ -63,6 +71,18 @@ class Options:
             errs.append(f"kube-backend invalid: {self.kube_backend}")
         if self.gc_interval_seconds < 0 or self.gc_grace_seconds < 0:
             errs.append("gc-interval-seconds/gc-grace-seconds must be >= 0")
+        if self.pressure_max_depth < 1:
+            errs.append(
+                f"pressure-max-depth must be >= 1: {self.pressure_max_depth}")
+        if self.pressure_rss_watermark_mb < 0:
+            errs.append("pressure-rss-watermark-mb must be >= 0")
+        if self.pressure_dwell_seconds < 0:
+            errs.append("pressure-dwell-seconds must be >= 0")
+        if self.pressure_split_items < 1:
+            errs.append(
+                f"pressure-split-items must be >= 1: {self.pressure_split_items}")
+        if self.pressure_aging_seconds < 0:
+            errs.append("pressure-aging-seconds must be >= 0")
         if self.aws_node_name_convention not in ("ip-name", "resource-name"):
             errs.append(
                 f"aws-node-name-convention invalid: {self.aws_node_name_convention}")
@@ -118,6 +138,29 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                    default=_env("gc-interval-seconds", defaults.gc_interval_seconds))
     p.add_argument("--gc-grace-seconds", type=float,
                    default=_env("gc-grace-seconds", defaults.gc_grace_seconds))
+    p.add_argument("--pressure-enabled", action=argparse.BooleanOptionalAction,
+                   default=_env("pressure-enabled", defaults.pressure_enabled),
+                   help="brownout ladder: pressure-aware admission/shedding")
+    p.add_argument("--pressure-max-depth", type=int,
+                   default=_env("pressure-max-depth",
+                                defaults.pressure_max_depth),
+                   help="hard bound on pods awaiting a batch window")
+    p.add_argument("--pressure-rss-watermark-mb", type=int,
+                   default=_env("pressure-rss-watermark-mb",
+                                defaults.pressure_rss_watermark_mb),
+                   help="process RSS watermark (MiB) for L2/L3; 0 disables")
+    p.add_argument("--pressure-dwell-seconds", type=float,
+                   default=_env("pressure-dwell-seconds",
+                                defaults.pressure_dwell_seconds),
+                   help="seconds below a rung before the ladder steps down")
+    p.add_argument("--pressure-split-items", type=int,
+                   default=_env("pressure-split-items",
+                                defaults.pressure_split_items),
+                   help="max pods per solve chunk when splitting at L1+")
+    p.add_argument("--pressure-aging-seconds", type=float,
+                   default=_env("pressure-aging-seconds",
+                                defaults.pressure_aging_seconds),
+                   help="queued/shed pods gain one priority band per step")
     p.add_argument("--aws-node-name-convention",
                    choices=["ip-name", "resource-name"],
                    default=_env("aws-node-name-convention",

@@ -20,6 +20,8 @@ from karpenter_tpu.api.core import Node, NodeSelectorRequirement as Req, Pod, Ta
 from karpenter_tpu.api.provisioner import Provisioner, set_condition
 from karpenter_tpu.api.requirements import Requirements
 from karpenter_tpu.cloudprovider.spi import CloudProvider, InstanceType
+from karpenter_tpu import pressure
+from karpenter_tpu.metrics.pressure import WINDOW_SPLITS_TOTAL
 from karpenter_tpu.metrics.registry import HISTOGRAMS
 from karpenter_tpu.runtime.kubecore import (
     AlreadyExists, ApiError, KubeCore, NotFound,
@@ -99,10 +101,13 @@ class ProvisionerWorker:
                 log.exception("provisioning failed")
 
     # -- API for the selection controller -----------------------------------
-    def add(self, pod: Pod, key=None) -> threading.Event:
-        """Enqueue a pod; returns the gate to block on (provisioner.go:80-82).
-        ``key`` (namespace, name) enables :meth:`pending` de-duplication."""
-        return self.batcher.add(pod, key=key)
+    def add(self, pod: Pod, key=None) -> Optional[threading.Event]:
+        """Enqueue a pod; returns the gate to block on (provisioner.go:80-82)
+        or None when brownout admission shed the pod (it re-enters via the
+        selection requeue once pressure falls). ``key`` (namespace, name)
+        enables :meth:`pending` de-duplication."""
+        band, priority = pressure.classify(pod)
+        return self.batcher.add(pod, key=key, band=band, priority=priority)
 
     def pending(self, key) -> bool:
         """True while a pod with this (namespace, name) key awaits a batch
@@ -127,9 +132,36 @@ class ProvisionerWorker:
                     seen.add(key)
                     deduped.append(p)
             pods = [p for p in deduped if self._is_provisionable(p)]
-            with HISTOGRAMS.time("scheduling_duration_seconds",
-                                 provisioner=self.provisioner.metadata.name):
-                schedules = self.scheduler.solve(self.provisioner, pods)
+            # L1+ batch-split: the batcher returns windows in priority
+            # order, so chunking preserves it — critical pods solve and
+            # bind in the FIRST chunk while the tail is still queued, and
+            # each chunk bounds solve p99 under pressure
+            monitor = self.batcher._monitor()
+            split = monitor.config.split_items
+            if int(monitor.level()) >= 1 and 0 < split < len(pods):
+                chunks = [pods[i:i + split]
+                          for i in range(0, len(pods), split)]
+                WINDOW_SPLITS_TOTAL.inc(amount=float(len(chunks) - 1))
+                log.info("pressure L%d: split %d-pod window into %d "
+                         "chunks of <=%d", int(monitor.level()), len(pods),
+                         len(chunks), split)
+            else:
+                chunks = [pods]
+            last_result = None
+            for chunk in chunks:
+                result = self._provision_chunk(chunk)
+                if result is not None:
+                    last_result = result
+            return last_result
+        finally:
+            self.batcher.flush()
+
+    def _provision_chunk(self, pods: List[Pod]) -> Optional[SolveResult]:
+        """One schedule → solve → launch pass over a (possibly split)
+        window chunk."""
+        with HISTOGRAMS.time("scheduling_duration_seconds",
+                             provisioner=self.provisioner.metadata.name):
+            schedules = self.scheduler.solve(self.provisioner, pods)
             # ALL schedules pack in one batched device call (one tunnel
             # round trip total, vmap/shard_map over the batch axis) instead
             # of the reference's sequential per-schedule loop
@@ -157,8 +189,6 @@ class ProvisionerWorker:
                     if err is not None:
                         log.error("could not launch node: %s", err)
             return last_result
-        finally:
-            self.batcher.flush()
 
     def _is_provisionable(self, candidate: Pod) -> bool:
         """Fresh read per pod to avoid duplicate binds (provisioner.go:

@@ -18,6 +18,7 @@ from karpenter_tpu.api.core import (
     Affinity, NodeAffinity, NodeSelectorRequirement, NodeSelectorTerm, Pod,
 )
 from karpenter_tpu.ops import feasibility
+from karpenter_tpu.pressure import get_monitor
 from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
 from karpenter_tpu.utils import clock
 from karpenter_tpu.utils import pod as podutil
@@ -236,6 +237,18 @@ class SelectionController:
         err = self._select_provisioner(pod)
         if err is not None:
             log.debug("could not schedule pod %s: %s", name, err)
+        return self._requeue_seconds()
+
+    def _requeue_seconds(self) -> float:
+        """Pressure-aware requeue backoff: at L2+ the shed population's
+        5 s retry storm is itself intake load, so back off (the pods are
+        Pending either way — a slower retry only delays re-admission, it
+        never loses a pod)."""
+        level = int(get_monitor().level())
+        if level >= 3:
+            return self.REQUEUE_SECONDS * 4
+        if level >= 2:
+            return self.REQUEUE_SECONDS * 2
         return self.REQUEUE_SECONDS
 
     def _select_provisioner(self, pod: Pod) -> Optional[str]:
@@ -265,6 +278,12 @@ class SelectionController:
         if chosen is None:
             return f"matched 0/{len(errs)} provisioners: " + "; ".join(errs)
         gate = chosen.add(pod, key=(pod.metadata.namespace, pod.metadata.name))
+        if gate is None:
+            # shed at admission (pressure level or depth bound) — already
+            # counted by the batcher; the requeue retries once pressure
+            # falls, so a shed is a delay, never a loss
+            return (f"shed at intake by provisioner/"
+                    f"{chosen.provisioner.metadata.name} (pressure)")
         if self.gate_timeout > 0:
             gate.wait(timeout=self.gate_timeout)
         return None

@@ -28,6 +28,7 @@ from karpenter_tpu.controllers.provisioning import ProvisioningController
 from karpenter_tpu.controllers.pvc import PVCController
 from karpenter_tpu.controllers.selection import SelectionController
 from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu import pressure
 from karpenter_tpu.metrics import registry
 from karpenter_tpu.runtime.kubecore import KubeCore
 from karpenter_tpu.runtime.manager import Manager
@@ -64,13 +65,23 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
     """Register the controllers: the reference's eight
     (cmd/controller/main.go:89-98) plus consolidation."""
     cloud_provider = build_cloud_provider(options)
+    # brownout ladder: install the process-wide pressure monitor before any
+    # batcher exists so every admission decision sees the configured ladder
+    pressure.configure(pressure.PressureConfig(
+        enabled=options.pressure_enabled,
+        max_depth=options.pressure_max_depth,
+        rss_watermark_bytes=options.pressure_rss_watermark_mb * 1024 ** 2,
+        dwell_seconds=options.pressure_dwell_seconds,
+        split_items=options.pressure_split_items,
+        aging_step_seconds=options.pressure_aging_seconds))
     provisioning = ProvisioningController(
         kube, cloud_provider,
         solver_config=SolverConfig(use_device=options.solver_use_device),
         batcher_factory=lambda: Batcher(
             idle_seconds=options.batch_idle_seconds,
             max_seconds=options.batch_max_seconds,
-            max_items=options.batch_max_items))
+            max_items=options.batch_max_items,
+            max_depth=options.pressure_max_depth))
     manager = Manager(kube)
     manager.register(provisioning)
     # worker pools are clamped to the host's cores (utils/workers.py): the
@@ -108,8 +119,14 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Type", "text/plain; version=0.0.4")
         elif self.path in ("/healthz", "/readyz"):
             ok = self.manager is None or self.manager.healthz()
-            body = b"ok" if ok else b"unhealthy"
-            self.send_response(200 if ok else 500)
+            level = int(pressure.get_monitor().level())
+            if self.path == "/readyz" and level >= 3:
+                # L3 = system-critical only: stop advertising readiness so
+                # load balancers drain non-critical traffic off this replica
+                # (liveness stays green — a restart would only make it worse)
+                ok = False
+            body = (f"{'ok' if ok else 'unhealthy'} level=L{level}").encode()
+            self.send_response(200 if ok else 503)
             self.send_header("Content-Type", "text/plain")
         else:
             body = b"not found"

@@ -1,0 +1,318 @@
+"""Pressure monitor: measurable overload signals → one ladder rung.
+
+The control plane degrades in *levels*, not cliffs:
+
+====  =====================  =============================================
+rung  name                   behavior change
+====  =====================  =============================================
+L0    normal                 nothing — full windows, admit everything
+L1    window-shrink          batch windows halve; oversized windows are
+                             split into bounded solve chunks (p99 guard)
+L2    shed low bands         besteffort + low-priority pods refused at
+                             intake (counted, re-enter via the selection
+                             requeue once pressure falls)
+L3    system-critical only   everything but system-critical refused
+====  =====================  =============================================
+
+Signals (each maps to a rung; the target level is the max):
+
+- **intake depth** — items awaiting a batch window, summed across all
+  registered batchers (L1/L2/L3 at 20 / 50 / 85 % of the depth bound)
+- **window assembly wall time** — a slow batcher wait means the loop is
+  falling behind its own intake (L1/L2)
+- **solver breaker** — ``solver_health()['breaker_open']``: the device
+  ring is sick, host fallbacks are slower, shrink the windows (L1)
+- **kube throttle** — time-decayed accumulation of TokenBucket waits on
+  the API client's request path (L1/L2)
+- **process RSS** — /proc/self/status VmRSS against a watermark
+  (L2 at 85 %, L3 at 100 %)
+
+Hysteresis: the level RISES immediately (overload must not wait out a
+dwell) but FALLS one rung at a time, and only after the computed target
+has stayed below the held level for ``dwell_seconds`` continuously — an
+oscillating signal therefore parks the ladder at the higher rung instead
+of flapping admission decisions on every sample.
+
+Chaos hooks: each evaluation consults the installed
+:mod:`karpenter_tpu.chaos.inject` plan on the ``("pressure", "depth")``
+and ``("pressure", "rss")`` streams, so a seeded ``queue-flood`` /
+``memory-pressure`` fault inflates that sample deterministically without
+allocating real memory or real queue entries.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Callable, Dict, Optional
+
+from karpenter_tpu.chaos.inject import active_fault
+from karpenter_tpu.metrics.pressure import INTAKE_QUEUE_DEPTH, PRESSURE_LEVEL
+
+log = logging.getLogger("karpenter.pressure")
+
+
+class PressureLevel(IntEnum):
+    L0 = 0  # normal
+    L1 = 1  # window-shrink / batch-split
+    L2 = 2  # shed besteffort + low bands
+    L3 = 3  # system-critical only
+
+
+@dataclass
+class PressureConfig:
+    enabled: bool = True
+    # intake depth bound (the Batcher's hard cap) and the ladder's depth
+    # thresholds as fractions of it (resolved in __post_init__; pass
+    # absolute values to override)
+    max_depth: int = 100_000
+    depth_l1: int = 0
+    depth_l2: int = 0
+    depth_l3: int = 0
+    # window assembly wall time (seconds)
+    window_l1_seconds: float = 5.0
+    window_l2_seconds: float = 30.0
+    # decayed kube-client throttle accumulation (seconds); decays with
+    # throttle_tau_seconds time constant between samples
+    throttle_l1_seconds: float = 0.5
+    throttle_l2_seconds: float = 2.0
+    throttle_tau_seconds: float = 30.0
+    # process RSS watermark; 0 disables the signal
+    rss_watermark_bytes: int = 4 * 1024 ** 3
+    # hysteresis: a rung is surrendered only after the target stays below
+    # it this long (per rung — L3→L0 takes 3 dwells)
+    dwell_seconds: float = 5.0
+    # aging: queued/shed pods are promoted one band per step (bands.py)
+    aging_step_seconds: float = 60.0
+    # L1+ window splitting: max pods per schedule+solve chunk
+    split_items: int = 4096
+    # signal staleness: a window sample older than this no longer counts
+    window_staleness_seconds: float = 120.0
+
+    def __post_init__(self):
+        if self.depth_l1 <= 0:
+            self.depth_l1 = max(1, int(self.max_depth * 0.20))
+        if self.depth_l2 <= 0:
+            self.depth_l2 = max(2, int(self.max_depth * 0.50))
+        if self.depth_l3 <= 0:
+            self.depth_l3 = max(3, int(self.max_depth * 0.85))
+
+
+def read_rss_bytes() -> int:
+    """Process resident set size. /proc is authoritative on Linux; the
+    getrusage fallback (ru_maxrss, a high-watermark) keeps the signal
+    meaningful on hosts without procfs."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:  # noqa: BLE001 — a missing signal must never crash
+        return 0
+
+
+def _default_breaker() -> bool:
+    # lazy import: solver pulls in jax; the monitor must stay importable
+    # (and testable) without touching the accelerator stack
+    from karpenter_tpu.solver.solve import solver_health
+
+    return bool(solver_health()["breaker_open"])
+
+
+class PressureMonitor:
+    """Thread-safe signal aggregator. Producers push partial signals
+    (note_*); consumers read :meth:`level`, which re-evaluates at most
+    every ``eval_interval`` seconds so per-pod admission checks stay a
+    cached integer read."""
+
+    eval_interval = 0.05
+    rss_sample_interval = 0.5
+
+    def __init__(self, config: Optional[PressureConfig] = None,
+                 timefunc: Optional[Callable[[], float]] = None,
+                 breaker_fn: Optional[Callable[[], bool]] = None,
+                 rss_fn: Optional[Callable[[], int]] = None):
+        self.config = config or PressureConfig()
+        self._now = timefunc or time.monotonic
+        self._breaker_fn = breaker_fn if breaker_fn is not None else _default_breaker
+        self._rss_fn = rss_fn or read_rss_bytes
+        self._lock = threading.Lock()
+        self._depths: Dict[int, int] = {}
+        self._window_s = 0.0
+        self._window_at: Optional[float] = None
+        self._throttle = 0.0
+        self._throttle_at: Optional[float] = None
+        self._rss = 0
+        self._rss_at: Optional[float] = None
+        self._level = PressureLevel.L0
+        self._below_since: Optional[float] = None
+        self._last_eval: Optional[float] = None
+        PRESSURE_LEVEL.set(0)
+
+    # -- signal intake -------------------------------------------------------
+    def note_depth(self, source: int, depth: int) -> None:
+        """Register one batcher's live queue depth (source = id(batcher));
+        the depth signal is the sum across sources."""
+        with self._lock:
+            if depth <= 0:
+                self._depths.pop(source, None)
+            else:
+                self._depths[source] = depth
+            INTAKE_QUEUE_DEPTH.set(float(sum(self._depths.values())))
+
+    def forget_source(self, source: int) -> None:
+        """A stopped batcher must not pin the depth signal forever."""
+        self.note_depth(source, 0)
+
+    def note_window(self, seconds: float) -> None:
+        with self._lock:
+            self._window_s = seconds
+            self._window_at = self._now()
+
+    def note_throttle(self, waited: float) -> None:
+        """Accumulate a TokenBucket wait with exponential time decay: a
+        saturated budget piles waits faster than tau drains them."""
+        now = self._now()
+        with self._lock:
+            self._throttle = self._decayed_throttle(now) + waited
+            self._throttle_at = now
+
+    # -- evaluation ----------------------------------------------------------
+    def _decayed_throttle(self, now: float) -> float:
+        if self._throttle_at is None or self._throttle <= 0:
+            return 0.0
+        tau = max(1e-6, self.config.throttle_tau_seconds)
+        return self._throttle * math.exp(-(now - self._throttle_at) / tau)
+
+    def _sample_rss(self, now: float) -> int:
+        if (self._rss_at is None
+                or now - self._rss_at >= self.rss_sample_interval):
+            self._rss = self._rss_fn()
+            self._rss_at = now
+        rss = self._rss
+        if active_fault("pressure", "rss") == "memory-pressure":
+            # synthetic memory pressure: report 87% of the watermark on
+            # top of reality — deterministically lands in the L2 band
+            # without allocating anything
+            rss += int(0.87 * self.config.rss_watermark_bytes)
+        return rss
+
+    def _target(self, now: float) -> PressureLevel:
+        c = self.config
+        depth = sum(self._depths.values())
+        if active_fault("pressure", "depth") == "queue-flood":
+            depth += c.max_depth // 2  # synthetic flood: at least L2 depth
+        window = self._window_s
+        if (self._window_at is None
+                or now - self._window_at > c.window_staleness_seconds):
+            window = 0.0
+        throttle = self._decayed_throttle(now)
+        rss = self._sample_rss(now)
+        watermark = c.rss_watermark_bytes
+
+        if depth >= c.depth_l3 or (watermark and rss >= watermark):
+            return PressureLevel.L3
+        if (depth >= c.depth_l2 or window >= c.window_l2_seconds
+                or throttle >= c.throttle_l2_seconds
+                or (watermark and rss >= 0.85 * watermark)):
+            return PressureLevel.L2
+        breaker = False
+        try:
+            breaker = bool(self._breaker_fn())
+        except Exception:  # noqa: BLE001 — health probe failure ≠ pressure
+            pass
+        if (depth >= c.depth_l1 or window >= c.window_l1_seconds
+                or throttle >= c.throttle_l1_seconds or breaker):
+            return PressureLevel.L1
+        return PressureLevel.L0
+
+    def evaluate(self) -> PressureLevel:
+        """Force a recomputation (rise immediately, fall one rung per
+        dwell)."""
+        if not self.config.enabled:
+            return PressureLevel.L0
+        now = self._now()
+        with self._lock:
+            target = self._target(now)
+            self._last_eval = now
+            if target > self._level:
+                log.warning("pressure rising: L%d -> L%d", self._level, target)
+                self._level = target
+                self._below_since = None
+            elif target < self._level:
+                if self._below_since is None:
+                    self._below_since = now
+                elif now - self._below_since >= self.config.dwell_seconds:
+                    self._level = PressureLevel(self._level - 1)
+                    log.info("pressure easing: now L%d", self._level)
+                    # the next rung down needs its own full dwell
+                    self._below_since = now if target < self._level else None
+            else:
+                self._below_since = None
+            PRESSURE_LEVEL.set(float(self._level))
+            return self._level
+
+    def level(self) -> PressureLevel:
+        """Current rung, re-evaluated at most every eval_interval."""
+        if not self.config.enabled:
+            return PressureLevel.L0
+        now = self._now()
+        with self._lock:
+            fresh = (self._last_eval is not None
+                     and now - self._last_eval < self.eval_interval)
+            if fresh:
+                return self._level
+        return self.evaluate()
+
+    def signals(self) -> dict:
+        """Snapshot for observability endpoints and tests."""
+        now = self._now()
+        with self._lock:
+            return {
+                "level": int(self._level),
+                "intake_depth": sum(self._depths.values()),
+                "window_seconds": self._window_s,
+                "throttle_seconds": round(self._decayed_throttle(now), 4),
+                "rss_bytes": self._rss,
+            }
+
+
+# ---------------------------------------------------------------------------
+# Process-wide monitor (the solver_health() analog for the intake plane)
+# ---------------------------------------------------------------------------
+
+_MONITOR: Optional[PressureMonitor] = None
+_MONITOR_LOCK = threading.Lock()
+
+
+def get_monitor() -> PressureMonitor:
+    global _MONITOR
+    with _MONITOR_LOCK:
+        if _MONITOR is None:
+            _MONITOR = PressureMonitor()
+        return _MONITOR
+
+
+def set_monitor(monitor: Optional[PressureMonitor]) -> None:
+    """Install (or, with None, reset) the process-wide monitor — tests and
+    main.py wiring."""
+    global _MONITOR
+    with _MONITOR_LOCK:
+        _MONITOR = monitor
+
+
+def configure(config: PressureConfig, **kwargs) -> PressureMonitor:
+    """Build a monitor from config and install it globally (main.py)."""
+    monitor = PressureMonitor(config, **kwargs)
+    set_monitor(monitor)
+    return monitor

@@ -35,6 +35,8 @@ from urllib.parse import quote, urlencode, urlsplit
 
 from karpenter_tpu.api import codec, codec_core
 from karpenter_tpu.api.core import LabelSelector, Pod
+from karpenter_tpu.metrics.pressure import KUBE_CLIENT_THROTTLE_SECONDS
+from karpenter_tpu.pressure.monitor import get_monitor
 from karpenter_tpu.utils.fastcopy import deep_copy
 from karpenter_tpu.runtime.kubecore import (
     AlreadyExists, ApiError, Conflict, Event, InternalError, NotFound,
@@ -260,7 +262,12 @@ class KubeApiClient:
     def _request(self, method: str, path: str, body: Optional[Dict] = None,
                  content_type: str = "application/json",
                  _throttle_retries: int = 2) -> Dict:
-        self._limiter.acquire()
+        waited = self._limiter.acquire()
+        if waited > 0:
+            # bucket saturation is a first-class pressure signal: the
+            # control plane is producing API calls faster than its budget
+            KUBE_CLIENT_THROTTLE_SECONDS.observe(waited)
+            get_monitor().note_throttle(waited)
         payload = json.dumps(body) if body is not None else None
         headers = self._headers(content_type if body is not None else None)
         # transport ring: a stale keep-alive (server closed it idle) or a

@@ -1,21 +1,68 @@
-"""Windowed pod batcher.
+"""Windowed pod batcher with bounded, priority-ordered intake.
 
 Reference: pkg/controllers/provisioning/batcher.go. Separates a stream of
-add() calls into windows: 1 s idle / 10 s max / 2,000 items — but the item
-cap is configurable and defaults higher here because the TPU solver's cost
-is sublinear in pods (shape-deduped), removing the reference's memory-bound
+add() calls into windows: 1 s idle / 10 s max / item cap — the item cap is
+configurable and defaults higher here because the TPU solver's cost is
+sublinear in pods (shape-deduped), removing the reference's memory-bound
 2k cap (SURVEY.md §5.7).
 
-Callers block on the gate returned by add(); the provisioning worker flushes
-the gate after a provisioning pass so selection reconcilers can re-verify.
+Brownout extensions (docs/robustness.md §4):
+
+- **Hard depth bound** (``max_depth``): intake is no longer an unbounded
+  ``queue.Queue`` a 50k-pod flood can grow until the process dies. A full
+  queue sheds the incoming pod (reason ``depth-bound``) — unless the pod
+  is system-critical, in which case the *worst* queued non-critical entry
+  is displaced to make room (reason ``displaced``); its key is released
+  immediately so the selection requeue re-offers it later.
+- **Pressure-aware admission**: at L2+ the :mod:`karpenter_tpu.pressure`
+  shedding policy refuses low bands at add() time (``add`` returns None,
+  no gate, no key registered). Shed pods re-enter through the selection
+  controller's existing 5 s re-verify requeue — no new persistence.
+- **Priority-ordered windows with aging**: wait() returns items ordered
+  by (effective band rank, priority value desc, stable id). A pod's
+  first-seen time persists across sheds (keyed re-adds), and every aging
+  step promotes it one band, so sustained pressure cannot starve it.
+- **Window shrink**: at L1+ the idle/max windows halve so assembly wall
+  time — itself a pressure signal — is bounded under load.
+
+Callers block on the gate returned by add(); the provisioning worker
+flushes the gate after a provisioning pass so selection reconcilers can
+re-verify.
 """
 
 from __future__ import annotations
 
-import queue
 import threading
 import time
-from typing import Any, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
+
+from karpenter_tpu.metrics.pressure import PODS_SHED_TOTAL
+from karpenter_tpu.pressure import bands as _bands
+from karpenter_tpu.pressure.bands import BANDS, RANK
+
+# first-seen bookkeeping: entries untouched this long are assumed deleted
+# (a live shed pod re-touches its entry on every 5 s requeue)
+FIRST_SEEN_TTL_SECONDS = 600.0
+_FIRST_SEEN_SWEEP_MIN = 1024
+
+
+class _Entry:
+    __slots__ = ("seq", "item", "key", "band", "rank", "priority",
+                 "first_seen", "sid")
+
+    def __init__(self, seq: int, item: Any, key: Any, band: str, rank: int,
+                 priority: int, first_seen: float):
+        self.seq = seq
+        self.item = item
+        self.key = key
+        self.band = band
+        self.rank = rank
+        self.priority = priority
+        self.first_seen = first_seen
+        # stable identity for deterministic ordering: the same pod set
+        # sorts identically whatever the arrival interleaving (keyed items;
+        # unkeyed test payloads fall back to arrival order)
+        self.sid = str(key) if key is not None else f"~{seq:020d}"
 
 
 class Batcher:
@@ -24,51 +71,150 @@ class Batcher:
         idle_seconds: float = 1.0,
         max_seconds: float = 10.0,
         max_items: int = 50_000,
+        max_depth: int = 100_000,
+        monitor=None,
     ):
         self.idle_seconds = idle_seconds
         self.max_seconds = max_seconds
         self.max_items = max_items
-        self._queue: "queue.Queue[Any]" = queue.Queue()
+        self.max_depth = max_depth
+        self._monitor_obj = monitor
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._entries: List[_Entry] = []
+        self._seq = 0
         self._gate = threading.Event()
         self._running = True
-        # keys awaiting a window (cleared as wait() consumes them): lets the
-        # selection requeue loop skip the full relax/validate/select path for
-        # a pod that is already queued — on a contended 1-core host the 5 s
-        # re-verify requeues of 10k pending pods otherwise dominate the GIL
+        # keys awaiting a window (cleared as wait() consumes them, OR the
+        # moment the entry is shed/displaced): lets the selection requeue
+        # loop skip the full relax/validate/select path for a pod that is
+        # already queued. A shed pod's key MUST leave this set immediately
+        # or selection would skip re-queueing it forever.
         self._pending_keys: set = set()
+        # key → (first_seen, last_touch): survives sheds so the aging term
+        # accrues across re-adds; consumed keys drop their entry, deleted
+        # pods age out via the TTL sweep
+        self._first_seen: Dict[Any, Tuple[float, float]] = {}
+        self._next_first_seen_sweep = 0.0
         # monotonic counters for synchronizers (tests/expectations.py):
-        # added_total — items enqueued; consumed_total — items a wait()
+        # added_total — items ADMITTED; consumed_total — items a wait()
         # window has picked up; processed_total — items whose window has
         # been FLUSHED (provisioning pass complete). A pod is fully
         # processed once processed_total passes its add position — exact
         # even when the pod lands in the window after the one in flight
-        # (the pre-captured-gate race, advisor finding r3).
+        # (the pre-captured-gate race, advisor finding r3). Shed items are
+        # counted in `shed`, never in added_total (they were refused, and
+        # a synchronizer waiting on them would deadlock).
         self.added_total = 0
         self.consumed_total = 0
         self.processed_total = 0
+        self.shed: Dict[Tuple[str, str], int] = {}  # (reason, band) → count
 
-    def add(self, item: Any, key: Any = None) -> threading.Event:
-        """Enqueue an item; returns the gate event the caller may wait on
-        (batcher.go:61-69). ``key`` (optional) registers the item for
-        :meth:`contains` until its window is consumed. The key is registered
-        BEFORE the item becomes consumable so a concurrent wait() can never
-        observe the item yet miss the key (which would strand it forever)."""
+    # -- pressure plumbing ---------------------------------------------------
+    def _monitor(self):
+        if self._monitor_obj is not None:
+            return self._monitor_obj
+        from karpenter_tpu.pressure import get_monitor
+
+        return get_monitor()
+
+    def _aging_step(self, monitor) -> float:
+        return monitor.config.aging_step_seconds
+
+    def _count_shed_locked(self, reason: str, band: str) -> None:
+        self.shed[(reason, band)] = self.shed.get((reason, band), 0) + 1
+        PODS_SHED_TOTAL.inc(reason=reason, priority_band=band)
+
+    def shed_total(self, band: Optional[str] = None) -> int:
         with self._lock:
+            return sum(n for (_, b), n in self.shed.items()
+                       if band is None or b == band)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- intake --------------------------------------------------------------
+    def add(self, item: Any, key: Any = None, band: str = "default",
+            priority: int = 0) -> Optional[threading.Event]:
+        """Enqueue an item; returns the gate event the caller may wait on
+        (batcher.go:61-69), or **None when the item was shed** (pressure
+        level refused its band, or the depth bound is hit). ``key``
+        (optional) registers the item for :meth:`contains` until its window
+        is consumed. The key is registered BEFORE the item becomes
+        consumable so a concurrent wait() can never observe the item yet
+        miss the key (which would strand it forever)."""
+        monitor = self._monitor()
+        level = int(monitor.level())
+        now = time.monotonic()
+        rank = RANK.get(band, RANK["default"])
+        with self._cv:
+            first_seen = now
             if key is not None:
-                self._pending_keys.add(key)
-            self.added_total += 1
-            gate = self._gate
-        self._queue.put((item, key))
-        return gate
+                prev = self._first_seen.get(key)
+                if prev is not None:
+                    first_seen = prev[0]
+                self._first_seen[key] = (first_seen, now)
+                self._sweep_first_seen_locked(now)
+            eff = _bands.effective_rank(rank, now - first_seen,
+                                        self._aging_step(monitor))
+            reason = _bands.shed_reason(eff, level)
+            if reason is None and len(self._entries) >= self.max_depth:
+                if rank == 0:
+                    # never shed system-critical: displace the worst queued
+                    # non-critical entry instead (or overflow by the
+                    # handful of critical pods a cluster actually has)
+                    self._displace_locked(now, monitor)
+                else:
+                    reason = "depth-bound"
+            if reason is not None:
+                self._count_shed_locked(reason, band)
+                depth = len(self._entries)
+            else:
+                entry = _Entry(self._seq, item, key, band, rank, priority,
+                               first_seen)
+                self._seq += 1
+                self._entries.append(entry)
+                if key is not None:
+                    self._pending_keys.add(key)
+                self.added_total += 1
+                gate = self._gate
+                depth = len(self._entries)
+                self._cv.notify()
+        monitor.note_depth(id(self), depth)
+        return None if reason is not None else gate
+
+    def _displace_locked(self, now: float, monitor) -> None:
+        victims = [e for e in self._entries if e.rank != 0]
+        if not victims:
+            return  # all queued entries are critical too: admit over bound
+        step = self._aging_step(monitor)
+        worst = max(victims, key=lambda e: self._sort_key(e, now, step))
+        self._entries.remove(worst)
+        if worst.key is not None:
+            # release the key NOW: selection's next requeue must re-offer
+            # the displaced pod, not skip it as "already pending"
+            self._pending_keys.discard(worst.key)
+        self._count_shed_locked("displaced", worst.band)
 
     def contains(self, key: Any) -> bool:
         """True while an item added with ``key`` awaits a window. Returns
-        False the moment wait() consumes it — the caller's next requeue then
-        performs the full post-batch re-verification."""
+        False the moment wait() consumes it — or the moment it is shed or
+        displaced — so the caller's next requeue performs the full
+        re-verification/re-add."""
         with self._lock:
             return key in self._pending_keys
 
+    def _sweep_first_seen_locked(self, now: float) -> None:
+        if (len(self._first_seen) < _FIRST_SEEN_SWEEP_MIN
+                or now < self._next_first_seen_sweep):
+            return
+        self._first_seen = {
+            k: v for k, v in self._first_seen.items()
+            if now - v[1] < FIRST_SEEN_TTL_SECONDS}
+        self._next_first_seen_sweep = now + FIRST_SEEN_TTL_SECONDS / 4
+
+    # -- lifecycle -----------------------------------------------------------
     def flush(self) -> None:
         """Release all waiters and open a new gate (batcher.go:72-77)."""
         with self._lock:
@@ -79,41 +225,68 @@ class Batcher:
             self._gate = threading.Event()
 
     def stop(self) -> None:
-        self._running = False
-        self._queue.put(None)  # unblock wait()
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        monitor = self._monitor_obj
+        if monitor is not None:
+            monitor.forget_source(id(self))
+        else:
+            from karpenter_tpu.pressure import get_monitor
+
+            get_monitor().forget_source(id(self))
+
+    # -- window assembly -----------------------------------------------------
+    @staticmethod
+    def _sort_key(entry: _Entry, now: float, aging_step: float):
+        eff = _bands.effective_rank(entry.rank, now - entry.first_seen,
+                                    aging_step)
+        return (eff, -entry.priority, entry.sid)
 
     def wait(self) -> Tuple[List[Any], float]:
         """Collect one windowed batch (batcher.go:80-103): starts at the
-        first item; extends on arrivals up to idle/max/size limits."""
-        items: List[Any] = []
-        keys: List[Any] = []
-
-        def take(envelope) -> bool:
-            if envelope is None:
-                return False
-            item, key = envelope
-            items.append(item)
-            if key is not None:
-                keys.append(key)
-            return True
-
-        first = self._queue.get()
-        if not self._running or not take(first):
-            return items, 0.0
-        start = time.monotonic()
-        deadline = start + self.max_seconds
-        while self._running and len(items) < self.max_items:
+        first item; extends on arrivals up to idle/max/size limits; returns
+        items in priority order (band rank with aging, then priority value,
+        then stable id)."""
+        monitor = self._monitor()
+        level = int(monitor.level())
+        # L1+ window shrink: half windows bound assembly wall time (which
+        # is itself a pressure signal — shrinking breaks the feedback loop)
+        idle = self.idle_seconds / 2 if level >= 1 else self.idle_seconds
+        max_s = self.max_seconds / 2 if level >= 1 else self.max_seconds
+        with self._cv:
+            while self._running and not self._entries:
+                self._cv.wait()
+            if not self._running:
+                return [], 0.0
+            start = time.monotonic()
+            deadline = start + max_s
+            while self._running and len(self._entries) < self.max_items:
+                seen = len(self._entries)
+                timeout = min(idle, deadline - time.monotonic())
+                if timeout <= 0:
+                    break
+                self._cv.wait(timeout)
+                if len(self._entries) <= seen:
+                    break  # idle window expired with no new arrivals
             now = time.monotonic()
-            timeout = min(self.idle_seconds, deadline - now)
-            if timeout <= 0:
-                break
-            try:
-                envelope = self._queue.get(timeout=timeout)
-            except queue.Empty:
-                break
-            if not take(envelope):
-                break
-        with self._lock:
-            self._pending_keys.difference_update(keys)
-            self.consumed_total += len(items)
-        return items, time.monotonic() - start
+            step = self._aging_step(monitor)
+            ordered = sorted(self._entries,
+                             key=lambda e: self._sort_key(e, now, step))
+            take = ordered[:self.max_items]
+            if len(take) < len(self._entries):
+                taken_seqs = {e.seq for e in take}
+                self._entries = [e for e in self._entries
+                                 if e.seq not in taken_seqs]
+            else:
+                self._entries = []
+            for e in take:
+                if e.key is not None:
+                    self._pending_keys.discard(e.key)
+                    self._first_seen.pop(e.key, None)
+            self.consumed_total += len(take)
+            depth = len(self._entries)
+        monitor.note_depth(id(self), depth)
+        window = now - start
+        monitor.note_window(window)
+        return [e.item for e in take], window

@@ -563,3 +563,170 @@ class TestFaultPlanSoak:
         same invariants."""
         _run_faulted_soak(SOAK_SPECS, window=8, pods_total=60,
                           burst_gap_s=0.03, settle_s=60.0)
+
+
+# ---------------------------------------------------------------------------
+# Brownout overload soak: flood the intake, inject pressure faults, assert
+# the control plane degrades by the ladder instead of dying
+# ---------------------------------------------------------------------------
+
+OVERLOAD_SPECS = [
+    inject.FaultSpec("pressure", "depth", "queue-flood", 2),
+    inject.FaultSpec("pressure", "rss", "memory-pressure", 2),
+    inject.FaultSpec("kube", "create", "slow-apiserver", 1),
+]
+
+
+def _run_overload_soak(flood_pods, real_pods, critical_pods, max_depth,
+                       settle_s, seed=CHAOS_SEED):
+    """`make chaos-overload`'s engine: a low-priority pod flood far past
+    the batcher's depth bound, plus seeded queue-flood / memory-pressure /
+    slow-apiserver faults, against the full manager stack. Brownout
+    invariants (docs/robustness.md §4):
+
+    1. process RSS stays under the configured watermark (the bound held —
+       a flood cannot grow the queue until the process dies);
+    2. ZERO system-critical pods are shed, and every one of them binds;
+    3. pressure returns to L0 once the flood drains (hysteresis releases);
+    4. every surviving real pod eventually binds (a shed is a delay, not a
+       loss — the selection requeue re-admits it).
+
+    Replayable from the printed seed (KARPENTER_CHAOS_SEED)."""
+    import functools
+
+    from karpenter_tpu import pressure
+    from karpenter_tpu.pressure.monitor import read_rss_bytes
+
+    print(f"chaos overload: seed={seed} "
+          "(replay with KARPENTER_CHAOS_SEED=<seed>)")
+    start_rss = read_rss_bytes()
+    watermark = start_rss + 1024 ** 3  # flood headroom: < 1 GiB of growth
+    monitor = pressure.configure(pressure.PressureConfig(
+        max_depth=max_depth,
+        rss_watermark_bytes=watermark,
+        dwell_seconds=0.4,          # fast release so the soak sees L0 again
+        aging_step_seconds=1.0,     # starvation freedom on soak timescales
+        window_l1_seconds=2.0))
+    core = KubeCore()
+    kube = inject.ChaosKube(core)
+    provider = decorate(FakeCloudProvider(catalog=instance_types(8)))
+    plan = inject.FaultPlan(seed, OVERLOAD_SPECS, window=16)
+    provisioning = ProvisioningController(
+        kube, provider,
+        batcher_factory=functools.partial(
+            Batcher, idle_seconds=0.05, max_seconds=0.5,
+            max_depth=max_depth))
+    manager = Manager(kube)
+    manager.register(provisioning, workers=2)
+    manager.register(SelectionController(kube, provisioning), workers=16)
+    from karpenter_tpu.controllers.node import NodeController
+
+    manager.register(NodeController(kube), workers=4)
+    prov = Provisioner()
+    prov.metadata.name = "chaos"
+    core.create(prov)
+
+    inject.install(plan)
+    manager.start()
+    rng = random.Random(seed)
+    peak_rss = start_rss
+    peak_level = 0
+    created = []
+    try:
+        deadline = time.monotonic() + 10.0
+        while "chaos" not in provisioning.workers:
+            assert time.monotonic() < deadline, "worker never materialized"
+            time.sleep(0.05)
+        worker = provisioning.workers["chaos"]
+
+        # real workload: default-band pods plus system-critical ones, all
+        # through the (chaos-wrapped) apiserver and the selection path
+        for i in range(real_pods):
+            pod = unschedulable_pod(
+                requests={"cpu": f"{rng.choice([100, 500])}m"},
+                name=f"real-{i}")
+            kube.create(pod)
+            created.append(pod.metadata.name)
+        for i in range(critical_pods):
+            pod = unschedulable_pod(
+                requests={"cpu": "100m"}, name=f"crit-{i}",
+                priority_class_name="system-cluster-critical")
+            kube.create(pod)
+            created.append(pod.metadata.name)
+
+        # the flood: synthetic low-priority pods pushed straight into the
+        # worker's intake, far past every depth threshold. None exist in
+        # kube, so any that reach a window are dropped as non-provisionable
+        # — the POINT is what admission does before that.
+        for i in range(flood_pods):
+            worker.add(unschedulable_pod(
+                requests={"cpu": "100m"}, name=f"flood-{i}", priority=-10))
+            if i % 256 == 0:
+                peak_rss = max(peak_rss, read_rss_bytes())
+                peak_level = max(peak_level, int(monitor.level()))
+
+        # settle: flood drains, ladder releases, every real pod binds
+        deadline = time.monotonic() + settle_s
+        unbound = created
+        while time.monotonic() < deadline:
+            peak_rss = max(peak_rss, read_rss_bytes())
+            peak_level = max(peak_level, int(monitor.level()))
+            unbound = []
+            for name in created:
+                try:
+                    if not core.read("Pod", name, "default",
+                                     lambda p: p.spec.node_name):
+                        unbound.append(name)
+                except NotFound:
+                    pass
+            if not unbound and int(monitor.level()) == 0:
+                break
+            time.sleep(0.1)
+
+        shed = dict(worker.batcher.shed)
+        print(f"chaos overload: seed={seed} peak_level=L{peak_level} "
+              f"shed={shed} rss_growth={(peak_rss - start_rss) >> 20}MiB "
+              f"fired={plan.fired_counts()}")
+        # 1. the depth bound held: RSS never approached the watermark
+        assert peak_rss < watermark, (
+            f"seed={seed}: RSS peaked at {peak_rss} >= watermark "
+            f"{watermark} — the flood was not bounded")
+        # 2. zero system-critical sheds, and every critical pod bound
+        assert worker.batcher.shed_total(band="system-critical") == 0, (
+            f"seed={seed}: system-critical pods were shed: {shed}")
+        # 3. the ladder engaged (the soak is not vacuous) and released
+        assert peak_level >= 2, (
+            f"seed={seed}: pressure never reached L2 — no brownout "
+            f"was exercised (peak L{peak_level})")
+        assert worker.batcher.shed_total() > 0, (
+            f"seed={seed}: the flood shed nothing")
+        assert int(monitor.level()) == 0, (
+            f"seed={seed}: pressure stuck at "
+            f"L{int(monitor.level())} after the flood drained")
+        # 4. every surviving real pod bound
+        assert not unbound, (
+            f"seed={seed}: {len(unbound)}/{len(created)} real pods never "
+            f"bound (e.g. {unbound[:5]})")
+        assert manager.healthz(), (
+            f"seed={seed}: a reconcile worker died during the overload")
+        assert plan.fired(), f"seed={seed}: no fault ever fired"
+        return plan
+    finally:
+        inject.uninstall()
+        manager.stop()
+        pressure.set_monitor(None)
+
+
+class TestOverloadSoak:
+    def test_overload_smoke_brownout_and_recovery(self):
+        """Tier-1 smoke: a 4x-depth-bound flood plus the seeded pressure
+        faults; the ladder must shed, hold the bound, and release."""
+        _run_overload_soak(flood_pods=2000, real_pods=10, critical_pods=3,
+                           max_depth=500, settle_s=45.0)
+
+    @pytest.mark.slow
+    def test_overload_soak_50k_flood(self):
+        """The long soak behind `make chaos-overload`: a 50k-pod flood
+        against a 10k depth bound, same four invariants."""
+        _run_overload_soak(flood_pods=50_000, real_pods=40, critical_pods=5,
+                           max_depth=10_000, settle_s=120.0)

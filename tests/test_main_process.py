@@ -69,7 +69,8 @@ class TestMainProcess:
                                 f"\n{out[-2000:]}")
                 try:
                     status, body = _get(port, "/healthz")
-                    if status == 200 and body == "ok":
+                    # body carries the pressure rung: "ok level=L0"
+                    if status == 200 and body.startswith("ok"):
                         break
                 except OSError as e:
                     last_err = e

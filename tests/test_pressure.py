@@ -1,0 +1,465 @@
+"""Brownout subsystem: bands, ladder hysteresis, bounded priority intake.
+
+Covers the invariants docs/robustness.md §4 promises:
+
+- classification is derived from the same fields the kube scheduler uses;
+- the ladder rises immediately and falls one rung per dwell (hysteresis —
+  an oscillating signal parks at the higher rung);
+- shedding policy per rung, with system-critical never shed and aging
+  promotion preventing starvation;
+- the batcher's depth bound sheds (or displaces for system-critical)
+  instead of growing without bound, and a shed pod's key is released
+  immediately;
+- window order is a pure function of the pod set — any arrival
+  interleaving of the same pods yields the same order (parity);
+- the seeded chaos kinds (queue-flood / memory-pressure / slow-apiserver)
+  drive the monitor and the kube shim deterministically.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.api.core import ObjectMeta, Pod, PodSpec
+from karpenter_tpu.chaos import inject
+from karpenter_tpu.metrics import registry
+from karpenter_tpu.pressure import bands
+from karpenter_tpu.pressure.monitor import (
+    PressureConfig, PressureLevel, PressureMonitor,
+)
+from karpenter_tpu.scheduling.batcher import Batcher
+from tests.expectations import unschedulable_pod
+
+
+class FakeMonitor:
+    """Deterministic monitor stand-in for batcher tests: a fixed level and
+    the real config object (thresholds, aging step, split size)."""
+
+    def __init__(self, level=0, aging_step_seconds=60.0, max_depth=100_000):
+        self.config = PressureConfig(max_depth=max_depth,
+                                     aging_step_seconds=aging_step_seconds)
+        self._level = level
+
+    def level(self):
+        return self._level
+
+    def note_depth(self, source, depth):
+        pass
+
+    def note_window(self, seconds):
+        pass
+
+    def forget_source(self, source):
+        pass
+
+
+def _monitor(dwell=5.0, max_depth=100, watermark=0, **kw):
+    """PressureMonitor on a fake clock with all ambient signals silenced."""
+    t = [0.0]
+    mon = PressureMonitor(
+        PressureConfig(max_depth=max_depth, dwell_seconds=dwell,
+                       rss_watermark_bytes=watermark, **kw),
+        timefunc=lambda: t[0],
+        breaker_fn=lambda: False,
+        rss_fn=lambda: 0)
+    return mon, t
+
+
+# ---------------------------------------------------------------------------
+# Band classification + policy
+# ---------------------------------------------------------------------------
+
+
+class TestBands:
+    def test_system_critical_by_class_name(self):
+        pod = unschedulable_pod(
+            requests={"cpu": "100m"},
+            priority_class_name="system-cluster-critical")
+        assert bands.classify(pod) == ("system-critical", 0)
+
+    def test_system_critical_by_priority_floor(self):
+        pod = unschedulable_pod(requests={"cpu": "100m"},
+                                priority=2_000_001_000)
+        assert bands.classify(pod)[0] == "system-critical"
+
+    def test_high_default_low(self):
+        assert bands.classify(unschedulable_pod(
+            requests={"cpu": "1"}, priority=100))[0] == "high"
+        assert bands.classify(unschedulable_pod(
+            requests={"cpu": "1"}))[0] == "default"
+        assert bands.classify(unschedulable_pod(
+            requests={"cpu": "1"}, priority=-10)) == ("low", -10)
+
+    def test_besteffort_is_requestless(self):
+        pod = Pod(metadata=ObjectMeta(name="be"), spec=PodSpec())
+        assert bands.classify(pod)[0] == "besteffort"
+
+    def test_non_pod_items_land_in_default(self):
+        assert bands.classify("just-a-string") == ("default", 0)
+        assert bands.classify(42) == ("default", 0)
+
+    def test_shed_policy_matrix(self):
+        R = bands.RANK
+        for level in range(4):
+            assert bands.shed_reason(R["system-critical"], level) is None
+        for rank in (R["high"], R["default"]):
+            assert bands.shed_reason(rank, 2) is None
+            assert bands.shed_reason(rank, 3) == "pressure-l3"
+        for rank in (R["low"], R["besteffort"]):
+            assert bands.shed_reason(rank, 1) is None
+            assert bands.shed_reason(rank, 2) == "pressure-l2"
+            assert bands.shed_reason(rank, 3) == "pressure-l3"
+
+    def test_aging_promotes_one_band_per_step_never_into_critical(self):
+        R = bands.RANK
+        assert bands.effective_rank(R["besteffort"], 0.0, 60.0) == 4
+        assert bands.effective_rank(R["besteffort"], 59.9, 60.0) == 4
+        assert bands.effective_rank(R["besteffort"], 60.0, 60.0) == 3
+        assert bands.effective_rank(R["besteffort"], 1e9, 60.0) == 1
+        assert bands.effective_rank(R["system-critical"], 1e9, 60.0) == 0
+        # aging disabled
+        assert bands.effective_rank(R["low"], 1e9, 0.0) == R["low"]
+
+
+# ---------------------------------------------------------------------------
+# Ladder hysteresis
+# ---------------------------------------------------------------------------
+
+
+class TestHysteresis:
+    def test_rises_immediately(self):
+        mon, t = _monitor()
+        assert mon.evaluate() == PressureLevel.L0
+        mon.note_depth(1, 90)  # >= depth_l3 (85% of 100)
+        assert mon.evaluate() == PressureLevel.L3
+
+    def test_falls_one_rung_per_dwell(self):
+        mon, t = _monitor(dwell=5.0)
+        mon.note_depth(1, 60)  # >= depth_l2 (50)
+        assert mon.evaluate() == PressureLevel.L2
+        mon.note_depth(1, 0)
+        t[0] = 1.0
+        assert mon.evaluate() == PressureLevel.L2  # dwell not served yet
+        t[0] = 5.9
+        assert mon.evaluate() == PressureLevel.L2
+        t[0] = 6.0
+        assert mon.evaluate() == PressureLevel.L1  # one rung, not a cliff
+        t[0] = 10.9
+        assert mon.evaluate() == PressureLevel.L1
+        t[0] = 11.0
+        assert mon.evaluate() == PressureLevel.L0
+
+    def test_oscillation_parks_at_the_higher_rung(self):
+        mon, t = _monitor(dwell=5.0)
+        for cycle in range(5):
+            t[0] = cycle * 4.0
+            mon.note_depth(1, 60)
+            assert mon.evaluate() == PressureLevel.L2
+            t[0] = cycle * 4.0 + 2.0
+            mon.note_depth(1, 0)
+            assert mon.evaluate() == PressureLevel.L2
+
+    def test_rise_mid_dwell_resets_the_clock(self):
+        mon, t = _monitor(dwell=5.0)
+        mon.note_depth(1, 60)
+        mon.evaluate()
+        mon.note_depth(1, 0)
+        t[0] = 4.0
+        mon.evaluate()
+        mon.note_depth(1, 95)  # spike back up
+        t[0] = 4.5
+        assert mon.evaluate() == PressureLevel.L3
+        mon.note_depth(1, 0)
+        t[0] = 9.0  # only 4.5 s below L3
+        assert mon.evaluate() == PressureLevel.L3
+
+    def test_disabled_pins_l0(self):
+        mon, t = _monitor(enabled=False)
+        mon.note_depth(1, 1000)
+        assert mon.evaluate() == PressureLevel.L0
+        assert mon.level() == PressureLevel.L0
+
+
+class TestSignals:
+    def test_depth_thresholds(self):
+        mon, t = _monitor()
+        mon.note_depth(1, 20)
+        assert mon.evaluate() == PressureLevel.L1
+        mon.note_depth(2, 30)  # summed across sources: 50 -> L2
+        assert mon.evaluate() == PressureLevel.L2
+        mon.forget_source(2)
+        mon.forget_source(1)
+        assert mon._target(t[0]) == PressureLevel.L0
+
+    def test_window_signal_and_staleness(self):
+        mon, t = _monitor()
+        mon.note_window(6.0)  # >= window_l1 (5 s)
+        assert mon.evaluate() == PressureLevel.L1
+        mon.note_window(31.0)  # >= window_l2 (30 s)
+        assert mon._target(t[0]) == PressureLevel.L2
+        t[0] = 200.0  # past window_staleness_seconds — sample expires
+        assert mon._target(t[0]) == PressureLevel.L0
+
+    def test_throttle_accumulates_and_decays(self):
+        mon, t = _monitor()
+        mon.note_throttle(0.3)
+        assert mon._target(t[0]) == PressureLevel.L0
+        mon.note_throttle(0.3)  # accumulated 0.6 >= throttle_l1 (0.5)
+        assert mon._target(t[0]) == PressureLevel.L1
+        t[0] = 90.0  # 3 tau later: 0.6 * e^-3 ~ 0.03
+        assert mon._target(t[0]) == PressureLevel.L0
+
+    def test_breaker_maps_to_l1(self):
+        state = {"open": True}
+        mon = PressureMonitor(
+            PressureConfig(max_depth=100, rss_watermark_bytes=0),
+            timefunc=lambda: 0.0, breaker_fn=lambda: state["open"],
+            rss_fn=lambda: 0)
+        assert mon.evaluate() == PressureLevel.L1
+
+    def test_rss_watermark(self):
+        rss = {"v": 0}
+        t = [0.0]
+        mon = PressureMonitor(
+            PressureConfig(max_depth=100, rss_watermark_bytes=1000),
+            timefunc=lambda: t[0], breaker_fn=lambda: False,
+            rss_fn=lambda: rss["v"])
+        rss["v"] = 850  # 85% -> L2
+        t[0] = 1.0
+        assert mon.evaluate() == PressureLevel.L2
+        rss["v"] = 1000  # at the watermark -> L3
+        t[0] = 2.0
+        assert mon.evaluate() == PressureLevel.L3
+
+    def test_level_metric_exported(self):
+        mon, _ = _monitor()
+        mon.note_depth(1, 60)
+        mon.evaluate()
+        exported = registry.DEFAULT.expose()
+        assert "karpenter_pressure_level{} 2.0" in exported
+
+
+# ---------------------------------------------------------------------------
+# Bounded, priority-ordered batcher intake
+# ---------------------------------------------------------------------------
+
+
+def _pod(name, **spec_kwargs):
+    return unschedulable_pod(requests={"cpu": "100m"}, name=name,
+                             **spec_kwargs)
+
+
+class TestBatcherShedding:
+    def test_l2_sheds_low_bands_and_releases_key(self):
+        fm = FakeMonitor(level=2)
+        b = Batcher(idle_seconds=0.01, max_seconds=0.1, monitor=fm)
+        low = _pod("low-1", priority=-5)
+        gate = b.add(low, key=("default", "low-1"), band="low", priority=-5)
+        assert gate is None
+        assert not b.contains(("default", "low-1"))  # released immediately
+        assert b.shed == {("pressure-l2", "low"): 1}
+        assert b.added_total == 0  # shed items never count as added
+
+        # pressure falls: the same keyed pod is admitted on the requeue
+        fm._level = 0
+        gate = b.add(low, key=("default", "low-1"), band="low", priority=-5)
+        assert gate is not None
+        assert b.contains(("default", "low-1"))
+
+    def test_l3_sheds_default_but_never_system_critical(self):
+        fm = FakeMonitor(level=3)
+        b = Batcher(idle_seconds=0.01, max_seconds=0.1, monitor=fm)
+        assert b.add(_pod("d"), key=("default", "d")) is None
+        crit = b.add(_pod("c"), key=("default", "c"),
+                     band="system-critical", priority=2_000_001_000)
+        assert crit is not None
+        assert b.shed == {("pressure-l3", "default"): 1}
+
+    def test_first_seen_survives_sheds_and_ages_into_admission(self):
+        fm = FakeMonitor(level=2, aging_step_seconds=1.0)
+        b = Batcher(idle_seconds=0.01, max_seconds=0.1, monitor=fm)
+        key = ("default", "aged")
+        now = time.monotonic()
+        # simulate a pod that has been shed and requeued for 3 aging steps
+        b._first_seen[key] = (now - 3.5, now)
+        gate = b.add(_pod("aged", priority=-5), key=key, band="low",
+                     priority=-5)
+        assert gate is not None, (
+            "an aged low-priority pod must be promoted past the L2 shed "
+            "line — starvation freedom")
+
+    def test_depth_bound_sheds_non_critical(self):
+        b = Batcher(idle_seconds=0.01, max_seconds=0.1, max_depth=2,
+                    monitor=FakeMonitor())
+        assert b.add(_pod("a"), key=("default", "a")) is not None
+        assert b.add(_pod("b"), key=("default", "b")) is not None
+        assert b.add(_pod("c"), key=("default", "c")) is None
+        assert b.shed == {("depth-bound", "default"): 1}
+        assert not b.contains(("default", "c"))
+        assert b.depth() == 2
+
+    def test_depth_bound_displaces_for_system_critical(self):
+        b = Batcher(idle_seconds=0.01, max_seconds=0.1, max_depth=2,
+                    monitor=FakeMonitor())
+        b.add(_pod("a"), key=("default", "a"))
+        b.add(_pod("b"), key=("default", "b"))
+        gate = b.add(_pod("crit"), key=("default", "crit"),
+                     band="system-critical", priority=2_000_001_000)
+        assert gate is not None
+        assert b.depth() == 2
+        assert b.shed == {("displaced", "default"): 1}
+        # exactly one of the two defaults lost its slot AND its key
+        pending = [k for k in (("default", "a"), ("default", "b"))
+                   if b.contains(k)]
+        assert len(pending) == 1
+        assert b.contains(("default", "crit"))
+
+    def test_all_critical_queue_overflows_the_bound(self):
+        b = Batcher(idle_seconds=0.01, max_seconds=0.1, max_depth=1,
+                    monitor=FakeMonitor())
+        b.add(_pod("c1"), key=("default", "c1"), band="system-critical")
+        gate = b.add(_pod("c2"), key=("default", "c2"),
+                     band="system-critical")
+        assert gate is not None  # admitted over the bound, never shed
+        assert b.depth() == 2
+        assert b.shed == {}
+
+
+class TestWindowOrder:
+    def _mixed_pods(self):
+        pods = []
+        for i in range(4):
+            pods.append((_pod(f"crit-{i}",
+                              priority_class_name="system-cluster-critical"),
+                         "system-critical", 2_000_001_000))
+            pods.append((_pod(f"high-{i}", priority=100 - i), "high", 100 - i))
+            pods.append((_pod(f"def-{i}"), "default", 0))
+            pods.append((_pod(f"low-{i}", priority=-1 - i), "low", -1 - i))
+        return pods
+
+    def _window_for(self, order):
+        b = Batcher(idle_seconds=0.01, max_seconds=0.2,
+                    monitor=FakeMonitor())
+        for pod, band, prio in order:
+            b.add(pod, key=(pod.metadata.namespace, pod.metadata.name),
+                  band=band, priority=prio)
+        items, _ = b.wait()
+        b.stop()
+        return [p.metadata.name for p in items]
+
+    def test_priority_order_parity_across_interleavings(self):
+        """Same pod set, ANY arrival interleaving -> the identical window
+        order: rank, then priority value desc, then stable pod identity —
+        never arrival sequence."""
+        pods = self._mixed_pods()
+        reference = self._window_for(pods)
+        # bands come out strictly in rank order
+        rank_seq = [bands.RANK[b] for b in
+                    ("system-critical",) * 4 + ("high",) * 4
+                    + ("default",) * 4 + ("low",) * 4]
+        got_ranks = []
+        for name in reference:
+            band = {"crit": "system-critical", "high": "high",
+                    "def": "default", "low": "low"}[name.split("-")[0]]
+            got_ranks.append(bands.RANK[band])
+        assert got_ranks == rank_seq
+        # high band is ordered by priority value, descending
+        highs = [n for n in reference if n.startswith("high-")]
+        assert highs == ["high-0", "high-1", "high-2", "high-3"]
+        for seed in (1, 7, 42):
+            shuffled = list(pods)
+            random.Random(seed).shuffle(shuffled)
+            assert self._window_for(shuffled) == reference, (
+                f"arrival interleaving (seed={seed}) changed window order")
+
+    def test_shed_metric_counts_by_reason_and_band(self):
+        b = Batcher(idle_seconds=0.01, max_seconds=0.1,
+                    monitor=FakeMonitor(level=2))
+        before = dict(b.shed)
+        assert before == {}
+        b.add(_pod("be-x"), band="besteffort")
+        b.add(_pod("lo-x", priority=-1), band="low", priority=-1)
+        assert b.shed == {("pressure-l2", "besteffort"): 1,
+                          ("pressure-l2", "low"): 1}
+        assert b.shed_total() == 2
+        assert b.shed_total(band="low") == 1
+        exported = registry.DEFAULT.expose()
+        assert "karpenter_pods_shed_total" in exported
+
+
+# ---------------------------------------------------------------------------
+# Chaos kinds
+# ---------------------------------------------------------------------------
+
+
+class TestChaosKinds:
+    def test_queue_flood_inflates_the_depth_sample(self):
+        mon, t = _monitor()
+        inject.install(inject.FaultPlan(3, [
+            inject.FaultSpec("pressure", "depth", "queue-flood", 1)],
+            window=1))
+        try:
+            # max_depth=100 -> +50 synthetic depth -> depth_l2 -> L2
+            assert mon.evaluate() == PressureLevel.L2
+        finally:
+            inject.uninstall()
+
+    def test_memory_pressure_inflates_the_rss_sample(self):
+        t = [0.0]
+        mon = PressureMonitor(
+            PressureConfig(max_depth=100, rss_watermark_bytes=1000),
+            timefunc=lambda: t[0], breaker_fn=lambda: False,
+            rss_fn=lambda: 100)
+        inject.install(inject.FaultPlan(3, [
+            inject.FaultSpec("pressure", "rss", "memory-pressure", 1)],
+            window=1))
+        try:
+            # 100 real + 870 synthetic = 970 >= 85% of 1000 -> L2
+            assert mon.evaluate() == PressureLevel.L2
+        finally:
+            inject.uninstall()
+        # the fault fired exactly once: the next evaluation is clean
+        t[0] = 10.0
+        assert mon.evaluate() == PressureLevel.L2  # hysteresis holds it...
+        t[0] = 20.0
+        mon.evaluate()
+        t[0] = 30.0
+        assert mon.evaluate() == PressureLevel.L0  # ...then it drains
+
+    def test_slow_apiserver_stalls_but_succeeds(self, monkeypatch):
+        from karpenter_tpu.runtime.kubecore import KubeCore
+
+        monkeypatch.setattr(inject.ChaosKube, "SLOW_APISERVER_STALL_S", 0.05)
+        kube = inject.ChaosKube(KubeCore())
+        inject.install(inject.FaultPlan(5, [
+            inject.FaultSpec("kube", "create", "slow-apiserver", 1)],
+            window=1))
+        try:
+            start = time.monotonic()
+            kube.create(_pod("slow"))
+        finally:
+            inject.uninstall()
+        assert time.monotonic() - start >= 0.05
+        assert kube.get("Pod", "slow") is not None  # the write LANDED
+
+
+# ---------------------------------------------------------------------------
+# Level-aware window shrink
+# ---------------------------------------------------------------------------
+
+
+class TestWindowShrink:
+    def test_l1_halves_the_windows(self):
+        fm = FakeMonitor(level=1)
+        b = Batcher(idle_seconds=0.2, max_seconds=2.0, monitor=fm)
+        b.add("x")
+        start = time.monotonic()
+        items, _ = b.wait()
+        elapsed = time.monotonic() - start
+        b.stop()
+        assert items == ["x"]
+        # idle window halves at L1: 0.1 s, not 0.2 s (generous ceiling for
+        # slow CI hosts — the unhalved window would be >= 0.2)
+        assert elapsed < 0.19, f"window did not shrink at L1: {elapsed:.3f}s"
