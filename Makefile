@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes bench-control native dryrun lint chart chaos-soak chaos-overload clean help
+.PHONY: test battletest bench bench-shapes bench-control bench-pipeline native dryrun lint chart chaos-soak chaos-overload clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -19,6 +19,9 @@ bench-shapes: ## Shape-cardinality + type-SPMD configs only (compaction regime)
 	python bench.py --only config_6 config_8
 
 bench-control: ## Control-plane config only (columnar filter regime, filter_ms breakdown)
+	python bench.py --only config_7
+
+bench-pipeline: ## Control-plane pipeline A/B: depth 2 vs serial, side-by-side in extra.pipeline_ab
 	python bench.py --only config_7
 
 native: ## Build the C++ FFD kernel explicitly (normally built lazily)

@@ -40,6 +40,17 @@ class Options:
     batch_max_items: int = 50_000
     # solver
     solver_use_device: bool = True
+    # pipelined hot loop (solver/pipeline.py): dispatched-but-unfetched
+    # solve chunks in flight (1 = serial; collapses to 1 at pressure L1+)
+    pipeline_depth: int = 2
+    # L0 chunk size the pipeline overlaps over; applied at every depth so
+    # serial and pipelined runs see identical chunk boundaries; 0 disables
+    pipeline_chunk_items: int = 4096
+    # pre-compile the (shape × type) bucket ladder at boot (solver/warmup.py)
+    solver_warmup: bool = False
+    # JAX persistent compilation cache dir ("" disables): restarts re-load
+    # compiled programs instead of re-lowering them
+    solver_compile_cache_dir: str = ""
     # capacity garbage collection (controllers/gc.py): sweep cadence and the
     # both-directions grace window; 0 interval disables the controller
     gc_interval_seconds: float = 120.0
@@ -83,6 +94,11 @@ class Options:
                 f"pressure-split-items must be >= 1: {self.pressure_split_items}")
         if self.pressure_aging_seconds < 0:
             errs.append("pressure-aging-seconds must be >= 0")
+        if self.pipeline_depth < 1:
+            errs.append(f"pipeline-depth must be >= 1: {self.pipeline_depth}")
+        if self.pipeline_chunk_items < 0:
+            errs.append("pipeline-chunk-items must be >= 0 (0 disables "
+                        f"chunking): {self.pipeline_chunk_items}")
         if self.aws_node_name_convention not in ("ip-name", "resource-name"):
             errs.append(
                 f"aws-node-name-convention invalid: {self.aws_node_name_convention}")
@@ -134,6 +150,24 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                    default=_env("batch-max-items", defaults.batch_max_items))
     p.add_argument("--solver-use-device", action=argparse.BooleanOptionalAction,
                    default=_env("solver-use-device", defaults.solver_use_device))
+    p.add_argument("--pipeline-depth", type=int,
+                   default=_env("pipeline-depth", defaults.pipeline_depth),
+                   help="provisioning pipeline depth: solve chunks in "
+                        "flight (1=serial; collapses to 1 at pressure L1+)")
+    p.add_argument("--pipeline-chunk-items", type=int,
+                   default=_env("pipeline-chunk-items",
+                                defaults.pipeline_chunk_items),
+                   help="max pods per pipelined solve chunk at L0 "
+                        "(0 disables chunking)")
+    p.add_argument("--solver-warmup", action=argparse.BooleanOptionalAction,
+                   default=_env("solver-warmup", defaults.solver_warmup),
+                   help="pre-compile the solver bucket ladder at boot on a "
+                        "background thread (solver/warmup.py)")
+    p.add_argument("--solver-compile-cache-dir",
+                   default=_env("solver-compile-cache-dir",
+                                defaults.solver_compile_cache_dir),
+                   help="JAX persistent compilation cache directory "
+                        "(empty disables)")
     p.add_argument("--gc-interval-seconds", type=float,
                    default=_env("gc-interval-seconds", defaults.gc_interval_seconds))
     p.add_argument("--gc-grace-seconds", type=float,

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
+from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from karpenter_tpu.api import wellknown
@@ -28,7 +30,8 @@ from karpenter_tpu.runtime.kubecore import (
 )
 from karpenter_tpu.scheduling.batcher import Batcher
 from karpenter_tpu.scheduling.scheduler import Scheduler
-from karpenter_tpu.solver.batch_solve import Problem, solve_batch
+from karpenter_tpu.solver.batch_solve import Problem, dispatch_batch
+from karpenter_tpu.solver.pipeline import PipelineConfig, SolvePipeline
 from karpenter_tpu.solver.solve import SolveResult, SolverConfig
 from karpenter_tpu.utils import pod as podutil
 
@@ -62,6 +65,16 @@ def global_requirements(instance_types: List[InstanceType]) -> Requirements:
     )
 
 
+@dataclass
+class _ChunkPrep:
+    """Host-marshalled state of one window chunk, handed stage-to-stage
+    through the pipeline (schedule → dispatch → launch/bind)."""
+
+    schedules: list
+    problems: List[Problem]
+    dispatch_s: float = field(default=0.0)
+
+
 class ProvisionerWorker:
     """One worker per Provisioner CR (provisioner.go:41-76)."""
 
@@ -72,12 +85,14 @@ class ProvisionerWorker:
         cloud_provider: CloudProvider,
         solver_config: Optional[SolverConfig] = None,
         batcher: Optional[Batcher] = None,
+        pipeline_config: Optional[PipelineConfig] = None,
     ):
         self.provisioner = provisioner
         self.kube = kube
         self.cloud_provider = cloud_provider
         self.solver_config = solver_config or SolverConfig()
         self.batcher = batcher or Batcher()
+        self.pipeline_config = pipeline_config or PipelineConfig()
         self.scheduler = Scheduler(kube)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -146,30 +161,45 @@ class ProvisionerWorker:
                          "chunks of <=%d", int(monitor.level()), len(pods),
                          len(chunks), split)
             else:
-                chunks = [pods]
+                # L0: bound chunks to the pipeline's unit size so depth>1
+                # has work to overlap. The SAME boundaries apply at depth 1
+                # — chunking is governed by chunk_items, depth only by the
+                # pipeline — so serial and pipelined runs stay node-for-node
+                # identical (the A/B bench and differential suite rely on it)
+                ci = self.pipeline_config.chunk_items
+                if 0 < ci < len(pods):
+                    chunks = [pods[i:i + ci]
+                              for i in range(0, len(pods), ci)]
+                else:
+                    chunks = [pods]
+            # the pipeline consumes FIFO, so the first chunk still launches
+            # and binds as soon as its solve lands (first-chunk-binds-early)
+            # while the next chunk's solve is already in flight; at L1+ the
+            # effective depth collapses to 1 and this degenerates to the
+            # serial chunk loop
+            pipeline = SolvePipeline(self.pipeline_config, monitor=monitor)
+            results = pipeline.run(
+                chunks, prepare=self._prepare_chunk,
+                dispatch=self._dispatch_chunk,
+                consume=self._complete_chunk,
+                on_chunk=self._observe_chunk)
             last_result = None
-            for chunk in chunks:
-                result = self._provision_chunk(chunk)
+            for result in results:
                 if result is not None:
                     last_result = result
             return last_result
         finally:
             self.batcher.flush()
 
-    def _provision_chunk(self, pods: List[Pod]) -> Optional[SolveResult]:
-        """One schedule → solve → launch pass over a (possibly split)
-        window chunk."""
+    # -- pipeline stages (one schedule → solve → launch pass per chunk) ------
+    def _prepare_chunk(self, pods: List[Pod]) -> _ChunkPrep:
+        """Host marshal stage: schedule the chunk and build its packing
+        problems. Catalog/daemon I/O stays OUTSIDE the binpacking histogram
+        so that measures the solver alone."""
         with HISTOGRAMS.time("scheduling_duration_seconds",
                              provisioner=self.provisioner.metadata.name):
             schedules = self.scheduler.solve(self.provisioner, pods)
-            # ALL schedules pack in one batched device call (one tunnel
-            # round trip total, vmap/shard_map over the batch axis) instead
-            # of the reference's sequential per-schedule loop
-            # (provisioner.go:109-120); solve_batch falls back per problem.
-            # Catalog/daemon I/O stays OUTSIDE the histogram so
-            # binpacking_duration_seconds measures the solver alone (one
-            # sample per provisioning pass — the batch IS one solve).
-            batch_problems = [
+            problems = [
                 Problem(
                     constraints=s.constraints,
                     pods=s.pods,
@@ -178,17 +208,39 @@ class ProvisionerWorker:
                     daemons=self._get_daemons(s.constraints))
                 for s in schedules
             ]
-            with HISTOGRAMS.time("binpacking_duration_seconds",
-                                 provisioner=self.provisioner.metadata.name):
-                results = solve_batch(batch_problems, config=self.solver_config)
-            last_result = None
-            for schedule, result in zip(schedules, results):
-                last_result = result
-                for packing in result.packings:
-                    err = self._launch(schedule.constraints, packing)
-                    if err is not None:
-                        log.error("could not launch node: %s", err)
-            return last_result
+        return _ChunkPrep(schedules=schedules, problems=problems)
+
+    def _dispatch_chunk(self, prep: _ChunkPrep):
+        """ALL the chunk's schedules pack in one batched device call (one
+        tunnel round trip total, vmap/shard_map over the batch axis) instead
+        of the reference's sequential per-schedule loop
+        (provisioner.go:109-120). Async: returns the in-flight BatchHandle
+        for the pipeline to fetch; fallbacks resolve at fetch time."""
+        t0 = time.perf_counter()
+        handle = dispatch_batch(prep.problems, config=self.solver_config)
+        prep.dispatch_s = time.perf_counter() - t0
+        return handle
+
+    def _complete_chunk(self, prep: _ChunkPrep,
+                        results: List[SolveResult]) -> Optional[SolveResult]:
+        """Launch/bind stage: runs while the NEXT chunk's solve is in
+        flight (depth permitting)."""
+        last_result = None
+        for schedule, result in zip(prep.schedules, results):
+            last_result = result
+            for packing in result.packings:
+                err = self._launch(schedule.constraints, packing)
+                if err is not None:
+                    log.error("could not launch node: %s", err)
+        return last_result
+
+    def _observe_chunk(self, prep: _ChunkPrep, stats: dict) -> None:
+        # binpacking = solver wall the hot loop actually paid (dispatch +
+        # blocked fetch); device time hidden behind launch/bind is the
+        # pipeline's win and lands in solver_overlap_seconds_total instead
+        HISTOGRAMS.histogram("binpacking_duration_seconds").observe(
+            prep.dispatch_s + stats.get("device_s", 0.0),
+            provisioner=self.provisioner.metadata.name)
 
     def _is_provisionable(self, candidate: Pod) -> bool:
         """Fresh read per pod to avoid duplicate binds (provisioner.go:
@@ -288,10 +340,12 @@ class ProvisioningController:
 
     def __init__(self, kube: KubeCore, cloud_provider: CloudProvider,
                  solver_config: Optional[SolverConfig] = None,
-                 batcher_factory: Optional[Callable[[], Batcher]] = None):
+                 batcher_factory: Optional[Callable[[], Batcher]] = None,
+                 pipeline_config: Optional[PipelineConfig] = None):
         self.kube = kube
         self.cloud_provider = cloud_provider
         self.solver_config = solver_config
+        self.pipeline_config = pipeline_config
         self.batcher_factory = batcher_factory or Batcher
         self.workers: Dict[str, ProvisionerWorker] = {}
         self._hashes: Dict[str, tuple] = {}
@@ -328,7 +382,8 @@ class ProvisioningController:
                 worker = ProvisionerWorker(
                     provisioner, self.kube, self.cloud_provider,
                     solver_config=self.solver_config,
-                    batcher=self.batcher_factory())
+                    batcher=self.batcher_factory(),
+                    pipeline_config=self.pipeline_config)
                 worker.start()
                 self.workers[name] = worker
                 self._hashes[name] = key

@@ -74,9 +74,22 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
         dwell_seconds=options.pressure_dwell_seconds,
         split_items=options.pressure_split_items,
         aging_step_seconds=options.pressure_aging_seconds))
+    # pipelined hot loop (solver/pipeline.py): chunk N solves on device
+    # while chunk N-1 binds and chunk N+1 marshals; compile warmup +
+    # persistent cache keep the first window off the 20-40 s cold compile
+    from karpenter_tpu.solver import warmup as solver_warmup
+    from karpenter_tpu.solver.pipeline import PipelineConfig
+
+    solver_warmup.configure_compilation_cache(options.solver_compile_cache_dir)
+    solver_config = SolverConfig(use_device=options.solver_use_device)
+    if options.solver_warmup:
+        solver_warmup.start_warmup(solver_config)
     provisioning = ProvisioningController(
         kube, cloud_provider,
-        solver_config=SolverConfig(use_device=options.solver_use_device),
+        solver_config=solver_config,
+        pipeline_config=PipelineConfig(
+            depth=options.pipeline_depth,
+            chunk_items=options.pipeline_chunk_items),
         batcher_factory=lambda: Batcher(
             idle_seconds=options.batch_idle_seconds,
             max_seconds=options.batch_max_seconds,

@@ -1,0 +1,44 @@
+"""Metrics for the pipelined provisioning hot loop (solver/pipeline.py).
+
+Four series, all on the process-wide registry (exposed with the
+``karpenter_`` prefix by registry.expose()):
+
+- ``karpenter_pipeline_depth``                 gauge — effective pipeline
+  depth of the most recent provisioning window (configured depth at L0;
+  collapses to 1 at pressure L1+, so a sustained 1 here under a depth-2
+  config is the ladder speaking, not a bug)
+- ``karpenter_pipeline_stage_seconds``         histogram, ``stage`` label —
+  per-chunk wall time by stage: ``marshal`` (schedule + problem build +
+  encode + async dispatch), ``device`` (blocking fetch/materialize of the
+  in-flight batch), ``launch_bind`` (cloud create + node object + binds)
+- ``karpenter_solver_overlap_seconds_total``   counter — cumulative seconds
+  each dispatched batch spent in flight before its fetch began, i.e. device
+  time hidden behind host launch/bind + marshal work. This is an upper
+  bound on wall time saved versus the serial sum (the device may finish
+  early inside the span); in serial mode (depth 1) it is ~0 by construction
+  because every fetch immediately follows its dispatch.
+- ``karpenter_pipeline_dispatch_wait_seconds`` histogram — per-chunk wait
+  between dispatch completing and the fetch starting (queueing delay a
+  handle experiences inside the pipeline's bounded window)
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.metrics.registry import DEFAULT
+
+PIPELINE_DEPTH = DEFAULT.gauge(
+    "pipeline_depth",
+    "Effective provisioning pipeline depth of the last window "
+    "(1=serial; collapses to 1 at pressure L1+)")
+PIPELINE_STAGE_SECONDS = DEFAULT.histogram(
+    "pipeline_stage_seconds",
+    "Per-chunk pipeline stage wall time "
+    "(stage=marshal|device|launch_bind)")
+SOLVER_OVERLAP_SECONDS_TOTAL = DEFAULT.counter(
+    "solver_overlap_seconds_total",
+    "Seconds dispatched batches spent in flight while the host did other "
+    "pipeline work (upper bound on wall saved vs the serial sum)")
+PIPELINE_DISPATCH_WAIT_SECONDS = DEFAULT.histogram(
+    "pipeline_dispatch_wait_seconds",
+    "Seconds between a chunk's async dispatch completing and its fetch "
+    "starting inside the pipeline window")

@@ -168,7 +168,21 @@ class PressureMonitor:
                 self._depths.pop(source, None)
             else:
                 self._depths[source] = depth
-            INTAKE_QUEUE_DEPTH.set(float(sum(self._depths.values())))
+            total = sum(self._depths.values())
+            INTAKE_QUEUE_DEPTH.set(float(total))
+            # burst guard: "rises immediately" must hold even when the
+            # whole flood lands inside one eval_interval window (a fast
+            # intake loop can fill the queue to its cap in <50 ms, and the
+            # cached level() would sample L0 before and after the burst) —
+            # a sample crossing a rung threshold forces a re-evaluation
+            c = self.config
+            crossed = ((total >= c.depth_l3 and self._level < PressureLevel.L3)
+                       or (total >= c.depth_l2
+                           and self._level < PressureLevel.L2)
+                       or (total >= c.depth_l1
+                           and self._level < PressureLevel.L1))
+        if crossed:
+            self.evaluate()
 
     def forget_source(self, source: int) -> None:
         """A stopped batcher must not pin the depth signal forever."""

@@ -1,4 +1,5 @@
-"""Batch solve: many schedules, ONE device round trip.
+"""Batch solve: many schedules, ONE device round trip — split into a
+dispatch half and a fetch half so the provisioning loop can pipeline.
 
 The scheduler emits one independent packing problem per isomorphic
 constraint group (scheduling/scheduler.py); the reference packs them
@@ -10,11 +11,24 @@ the mesh batch axis, one flattened fetch — and falls back per problem
 (native C++ → host oracle) for anything that can't join the batch. Results
 are identical problem-for-problem to the sequential path (differentially
 tested in tests/test_batch_solve.py).
+
+The split (solver/pipeline.py): :func:`dispatch_batch` marshals, encodes,
+``device_put``s the invariants and launches the sharded kernel WITHOUT
+blocking (JAX async dispatch — the call returns a device future), and
+returns a :class:`BatchHandle` whose ``fetch()`` materializes the results.
+The device watchdog/breaker and the hedged fetcher attach to the FETCH
+side, so a hung transport still trips within ``device_timeout_s``; the
+dispatch side stays cheap enough to run inline in the hot loop (a dead
+transport at ``device_put`` time is caught by the breaker state checked
+before dispatch). :func:`solve_batch` — dispatch and fetch back-to-back —
+remains the serial entry point and is result-identical to the pre-split
+path.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
@@ -30,6 +44,7 @@ from karpenter_tpu.ops.encode import encode
 from karpenter_tpu.solver.adapter import (
     build_packables_cached, marshal_pods_interned,
 )
+from karpenter_tpu.solver import hedge
 from karpenter_tpu.solver import solve as solve_module
 from karpenter_tpu.solver.solve import (
     SolveResult, SolverConfig, materialize, resolved_device_max_shapes,
@@ -54,13 +69,26 @@ def solve_batch(problems: Sequence[Problem],
     """Solve each problem; device-eligible ones go in one sharded batch.
     Every problem is prepared (packables + pod vectors) exactly once; the
     fallback paths reuse the preparation instead of recomputing it."""
+    return dispatch_batch(problems, config).fetch()
+
+
+def dispatch_batch(problems: Sequence[Problem],
+                   config: Optional[SolverConfig] = None) -> "BatchHandle":
+    """Prepare + encode every problem and async-launch the device batch.
+
+    Returns without blocking on the kernel: the sharded solve is in flight
+    when this returns (JAX async dispatch), and ``BatchHandle.fetch()``
+    materializes it. Problems that can't join the batch (cardinality gate,
+    encode failure, no device) are carried on the handle and solved on the
+    solo fallback path at fetch time, so ``dispatch_batch(p).fetch()`` is
+    exactly ``solve_batch(p)``."""
     config = config or SolverConfig()
     with gc_deferred():
-        return _solve_batch(problems, config)
+        return _dispatch_batch(problems, config)
 
 
-def _solve_batch(problems: Sequence[Problem],
-                 config: SolverConfig) -> List[SolveResult]:
+def _dispatch_batch(problems: Sequence[Problem],
+                    config: SolverConfig) -> "BatchHandle":
     prepared = []
     for prob in problems:
         vecs, required, sids = marshal_pods_interned(prob.pods)
@@ -118,178 +146,320 @@ def _solve_batch(problems: Sequence[Problem],
                     batch_idx.append(i)
                     encs.append(penc)
 
-    results: List[Optional[SolveResult]] = [None] * len(problems)
+    run: Optional[_DeviceBatchRun] = None
     if len(batch_idx) >= 2 and not solve_module._WATCHDOG.tripped():
         try:
-            with trace("karpenter.solve.batch_device"):
-                # same hang watchdog + circuit breaker as the solo device
-                # ring (solver/solve.py): a sick transport must not stall
-                # the provisioning hot loop
+            with trace("karpenter.solve.batch_dispatch"):
                 batch_packables = [prepared[i][0] for i in batch_idx]
                 batch_prices = [
                     _problem_prices(i) if config.cost_tiebreak else None
                     for i in batch_idx]
-                if config.device_timeout_s > 0:
-                    host_results = solve_module._WATCHDOG.run(
-                        lambda: _device_batch(
-                            encs, batch_packables, batch_prices, config),
-                        config.device_timeout_s,
-                        config.device_breaker_seconds)
-                else:
-                    host_results = _device_batch(
-                        encs, batch_packables, batch_prices, config)
+                run = _launch_device_batch(
+                    encs, batch_packables, batch_prices, config)
         except Exception:  # device ring: never drop a provisioning loop
-            log.exception("batched device solve failed; falling back per problem")
+            log.exception(
+                "batched device dispatch failed; problems fall back at fetch")
+            run = None
+    handle = BatchHandle(problems, config, prepared, raw_encs, batch_idx, run)
+    if run is not None:
+        # suppress hedging while this batch is in flight: a duplicate
+        # dispatch would queue behind it on the device (solver/hedge.py)
+        hedge.note_dispatched(handle)
+    return handle
+
+
+class BatchHandle:
+    """One dispatched (possibly in-flight) batched solve.
+
+    ``fetch()`` — idempotent; results are computed once and cached — blocks
+    for the in-flight device batch under the same hang watchdog + circuit
+    breaker as the solo device ring (solver/solve.py), materializes the
+    device answers, and solves every remaining problem on the solo fallback
+    path. Any device failure (hang → watchdog trip, kernel error, transport
+    fault) degrades to the per-problem fallback without losing a problem.
+    The handle counts as "outstanding" for hedge suppression from dispatch
+    until its fetch begins."""
+
+    def __init__(self, problems, config, prepared, raw_encs, batch_idx, run):
+        self._problems = list(problems)
+        self._config = config
+        self._prepared = prepared
+        self._raw_encs = raw_encs
+        self._batch_idx = batch_idx
+        self._run = run
+        self._results: Optional[List[SolveResult]] = None
+
+    @property
+    def in_flight(self) -> bool:
+        """True while a device batch is launched but not yet fetched."""
+        return self._results is None and self._run is not None
+
+    def fetch(self) -> List[SolveResult]:
+        if self._results is not None:
+            return self._results
+        hedge.note_fetching(self)
+        with gc_deferred():
+            self._results = self._fetch()
+        return self._results
+
+    def _fetch(self) -> List[SolveResult]:
+        problems, config, prepared = self._problems, self._config, self._prepared
+        results: List[Optional[SolveResult]] = [None] * len(problems)
+        run, self._run = self._run, None  # a failed fetch must not re-enter
+        if run is not None:
             host_results = None
-        if host_results is not None:
-            solve_module.record_executor("device-batch",
-                                         count=len(batch_idx))
-            for j, i in enumerate(batch_idx):
-                results[i] = materialize(
-                    host_results[j], problems[i].pods, prepared[i][1],
-                    problems[i].constraints, config)
+            try:
+                with trace("karpenter.solve.batch_device"):
+                    # same hang watchdog + circuit breaker as the solo
+                    # device ring (solver/solve.py): a sick transport must
+                    # not stall the provisioning hot loop — the watchdog
+                    # wraps the FETCH, where a hung materialize would park
+                    if config.device_timeout_s > 0:
+                        host_results = solve_module._WATCHDOG.run(
+                            lambda: _finish_device_batch(run),
+                            config.device_timeout_s,
+                            config.device_breaker_seconds)
+                    else:
+                        host_results = _finish_device_batch(run)
+            except Exception:  # device ring: never drop a provisioning loop
+                log.exception(
+                    "batched device solve failed; falling back per problem")
+                host_results = None
+            if host_results is not None:
+                solve_module.record_executor("device-batch",
+                                             count=len(self._batch_idx))
+                for j, i in enumerate(self._batch_idx):
+                    results[i] = materialize(
+                        host_results[j], problems[i].pods, prepared[i][1],
+                        problems[i].constraints, config)
 
-    for i, prob in enumerate(problems):
-        if results[i] is None:  # not batched (or batch failed): solo path
-            packables, sorted_types, vecs, sids = prepared[i]
-            results[i] = solve_with_packables(
-                prob.constraints, prob.pods, packables, sorted_types, vecs,
-                config, sids=sids, enc=raw_encs[i])
-    return results
+        for i, prob in enumerate(problems):
+            if results[i] is None:  # not batched (or batch failed): solo path
+                packables, sorted_types, vecs, sids = prepared[i]
+                results[i] = solve_with_packables(
+                    prob.constraints, prob.pods, packables, sorted_types,
+                    vecs, config, sids=sids, enc=self._raw_encs[i])
+        return results
 
 
-def _device_batch(encs, packables_list, prices_list, config: SolverConfig):
-    """One (or rarely more) pack_batch_sharded_flat call(s) solving all
+def _launch_device_batch(encs, packables_list, prices_list,
+                         config: SolverConfig) -> "_DeviceBatchRun":
+    """Dispatch-side seam: build the device state and async-launch the first
+    chunk. Module-level so tests can spy on batch membership."""
+    return _DeviceBatchRun(encs, packables_list, prices_list, config)
+
+
+def _finish_device_batch(run: "_DeviceBatchRun"):
+    """Fetch-side seam: blocking materialize + chunk-resume loop. Runs under
+    the device watchdog; module-level so tests can inject hangs exactly
+    where a sick transport would park."""
+    return run.finish()
+
+
+class _DeviceBatchRun:
+    """Device-side state of one in-flight batched solve.
+
+    One (or rarely more) pack_batch_sharded_flat call(s) solving all
     encoded problems; chunk-resumes any problem that outlives num_iters.
-    Invariant tensors ship host→device ONCE; resumes send only the small
-    counts/dropped rows. ``prices_list`` carries each problem's per-packable
-    effective $/h (or None); rows without prices get all-INT32_MAX price
-    vectors, which degrade the in-kernel tie-break to Go's first-smallest —
-    exactly what the solo path does for an unpriced catalog."""
-    import jax
+    Invariant tensors ship host→device ONCE (``__init__``, which also
+    async-launches the first chunk — JAX returns a device future without
+    blocking; trace/compile errors still surface synchronously and retry on
+    the XLA kernel); resumes send only the small counts/dropped rows.
+    ``prices_list`` carries each problem's per-packable effective $/h (or
+    None); rows without prices get all-INT32_MAX price vectors, which
+    degrade the in-kernel tie-break to Go's first-smallest — exactly what
+    the solo path does for an unpriced catalog."""
 
-    from karpenter_tpu.parallel.mesh import solver_mesh
-    from karpenter_tpu.parallel.sharded_pack import (
-        pack_batch_sharded_flat, pad_problems, unpack_batch_flat,
-    )
+    def __init__(self, encs, packables_list, prices_list,
+                 config: SolverConfig):
+        import jax
 
-    mesh = solver_mesh()
-    on_tpu = jax.default_backend() == "tpu"
-    kernel = config.device_kernel or default_kernel()
-    if kernel == "type-spmd":
-        # type-axis sharding scales ONE problem across the mesh (solo path,
-        # models/ffd.py); a batch already fills the mesh on the batch axis,
-        # so batched schedules run the per-problem default kernel — loudly,
-        # not silently
-        kernel = default_kernel()
-        log.info("device_kernel='type-spmd' applies to solo solves; "
-                 "batched schedules use the %r kernel", kernel)
-    if kernel not in ("xla", "pallas"):
-        # same contract as the solo path: a typo must not silently run XLA
-        raise ValueError(f"unknown device kernel {kernel!r} for the batched "
-                         "path: expected None, 'xla', 'pallas' or 'type-spmd'")
-    L = config.chunk_iters
-    batch = pad_problems(encs, mesh.devices.size)
-    (shapes, counts, dropped, totals, reserved0, valid,
-     last_valid, pods_unit, B) = batch
-    S = shapes.shape[1]
-    if kernel == "pallas" and S > config.pallas_max_shapes:
-        # padded batch landed above the pallas-validated bucket — the
-        # block-tiled XLA scan is the executor for it (models/ffd.py:117)
-        kernel = "xla"
-    if kernel == "pallas":
-        from karpenter_tpu.ops.pack_pallas import DIV_CAP
+        from karpenter_tpu.parallel.mesh import solver_mesh
+        from karpenter_tpu.parallel.sharded_pack import (
+            pack_batch_sharded_flat, pad_problems,
+        )
 
-        if int(counts.max(initial=0)) >= DIV_CAP - 4:
-            # pallas float32-division count bound (models/ffd.py) —
-            # unreachable behind the 100k batch guard, checked anyway
+        self.encs = encs
+        self.packables_list = packables_list
+        self.config = config
+        self._jax = jax
+        self._pack = pack_batch_sharded_flat
+        self.mesh = solver_mesh()
+        self.on_tpu = jax.default_backend() == "tpu"
+        kernel = config.device_kernel or default_kernel()
+        if kernel == "type-spmd":
+            # type-axis sharding scales ONE problem across the mesh (solo
+            # path, models/ffd.py); a batch already fills the mesh on the
+            # batch axis, so batched schedules run the per-problem default
+            # kernel — loudly, not silently
+            kernel = default_kernel()
+            log.info("device_kernel='type-spmd' applies to solo solves; "
+                     "batched schedules use the %r kernel", kernel)
+        if kernel not in ("xla", "pallas"):
+            # same contract as the solo path: a typo must not silently run XLA
+            raise ValueError(
+                f"unknown device kernel {kernel!r} for the batched "
+                "path: expected None, 'xla', 'pallas' or 'type-spmd'")
+        self.L = config.chunk_iters
+        batch = pad_problems(encs, self.mesh.devices.size)
+        (shapes, counts, dropped, totals, reserved0, valid,
+         last_valid, pods_unit, _B) = batch
+        self.S0 = shapes.shape[1]
+        if kernel == "pallas" and self.S0 > config.pallas_max_shapes:
+            # padded batch landed above the pallas-validated bucket — the
+            # block-tiled XLA scan is the executor for it (models/ffd.py:117)
             kernel = "xla"
-    use_cost = config.cost_tiebreak and any(
-        p is not None for p in prices_list)
-    prices_arr = None
-    if use_cost:
-        T = totals.shape[1]
-        prices_arr = np.full((shapes.shape[0], T),
-                             np.iinfo(np.int32).max, np.int32)
-        for b, pr in enumerate(prices_list):
-            if pr is not None:
-                prices_arr[b] = encode_prices(pr, T)
-    # one transfer for the invariants (tunnel-latency bound, models/ffd.py)
-    shapes_host = shapes  # original (B, S, R) — compaction gathers from it
-    shapes_d, totals, reserved0, valid, last_valid, pods_unit = jax.device_put(
-        (shapes, totals, reserved0, valid, last_valid, pods_unit))
-    if prices_arr is not None:
-        prices_arr = jax.device_put(prices_arr)
-    counts_d, dropped_d = jax.device_put((counts, dropped))
+        if kernel == "pallas":
+            from karpenter_tpu.ops.pack_pallas import DIV_CAP
 
-    def run(kern, shapes_now, counts_now, dropped_now):
-        def dispatch():
-            return np.asarray(pack_batch_sharded_flat(
-                shapes_now, counts_now, dropped_now, totals, reserved0, valid,
-                last_valid, pods_unit, num_iters=L, mesh=mesh,
-                kernel=kern, interpret=kern == "pallas" and not on_tpu,
-                prices=prices_arr, cost_tiebreak=use_cost))
+            if int(counts.max(initial=0)) >= DIV_CAP - 4:
+                # pallas float32-division count bound (models/ffd.py) —
+                # unreachable behind the 100k batch guard, checked anyway
+                kernel = "xla"
+        self.kernel = kernel
+        self.use_cost = config.cost_tiebreak and any(
+            p is not None for p in prices_list)
+        prices_arr = None
+        if self.use_cost:
+            T = totals.shape[1]
+            prices_arr = np.full((shapes.shape[0], T),
+                                 np.iinfo(np.int32).max, np.int32)
+            for b, pr in enumerate(prices_list):
+                if pr is not None:
+                    prices_arr[b] = encode_prices(pr, T)
+        # one transfer for the invariants (tunnel-latency bound,
+        # models/ffd.py)
+        self.shapes_host = shapes  # original (B, S, R) — compaction gathers
+        (self.shapes_d, self.totals, self.reserved0, self.valid,
+         self.last_valid, self.pods_unit) = jax.device_put(
+            (shapes, totals, reserved0, valid, last_valid, pods_unit))
+        self.prices_arr = (jax.device_put(prices_arr)
+                           if prices_arr is not None else None)
+        self.counts_d, self.dropped_d = jax.device_put((counts, dropped))
+        self._pending = None
+        self._pending_lock = threading.Lock()
+        self.launch()
 
-        if not config.device_hedge:
-            return dispatch()
-        # same tail mitigation as the solo leg (models/ffd.py): the batched
-        # fetch is equally tunnel-RTT-bound and equally deterministic
+    # -- dispatch side -------------------------------------------------------
+    def _dispatch_chunk(self):
+        """Async-dispatch one chunk against the current tensors; returns the
+        un-materialized device buffer."""
+        return self._pack(
+            self.shapes_d, self.counts_d, self.dropped_d, self.totals,
+            self.reserved0, self.valid, self.last_valid, self.pods_unit,
+            num_iters=self.L, mesh=self.mesh, kernel=self.kernel,
+            interpret=self.kernel == "pallas" and not self.on_tpu,
+            prices=self.prices_arr, cost_tiebreak=self.use_cost)
+
+    def launch(self) -> None:
+        """Queue the next chunk without blocking; a no-op when a chunk is
+        already pending (a resumed fetch must never double-dispatch)."""
+        with self._pending_lock:
+            if self._pending is not None:
+                return
+        try:
+            buf = self._dispatch_chunk()
+        except Exception:
+            if self.kernel == "xla":
+                raise
+            log.exception(
+                "pallas batch kernel failed at dispatch; retrying with xla")
+            self.kernel = "xla"
+            buf = self._dispatch_chunk()
+        with self._pending_lock:
+            self._pending = buf
+
+    def _take_pending(self):
+        with self._pending_lock:
+            buf, self._pending = self._pending, None
+            return buf
+
+    # -- fetch side ----------------------------------------------------------
+    def _fetch_chunk(self):
+        """Blocking materialize of the launched chunk, hedged.
+
+        A hedge that merely re-awaited the same device future could never
+        win, so the first attempt POPS the pending buffer (once, under the
+        lock) and any further attempt re-dispatches the — deterministic —
+        kernel: real tail mitigation on the fetch side, same as the solo
+        leg (models/ffd.py). Hedging self-disables while other batches are
+        in flight (solver/hedge.py pipeline awareness)."""
+        def attempt():
+            buf = self._take_pending()
+            if buf is None:
+                buf = self._dispatch_chunk()
+            return np.asarray(buf)
+
+        if not self.config.device_hedge:
+            return attempt()
         from karpenter_tpu.solver.hedge import FETCHER
 
-        key = ("batch", kern, shapes_now.shape, totals.shape[1], L, use_cost)
-        return FETCHER.fetch(key, dispatch)
+        key = ("batch", self.kernel, tuple(self.shapes_d.shape),
+               self.totals.shape[1], self.L, self.use_cost)
+        return FETCHER.fetch(key, attempt)
 
-    # batch-level active-shape compaction (ops/compact.py): the batch
-    # tensors must keep ONE static S, so chunk boundaries re-bucket to the
-    # bucket of the LARGEST alive set across problems. dropped is
-    # accumulated host-side per problem (each resume ships zero rows) so
-    # deltas scatter through each problem's permutation exactly.
-    from karpenter_tpu.ops.compact import (
-        compact_rows, scatter_dropped, sparse_record,
-    )
-    from karpenter_tpu.ops.encode import SHAPE_BUCKETS, bucket
+    def finish(self):
+        """Materialize the in-flight chunk and drive the resume loop.
 
-    records: List[list] = [[] for _ in range(len(encs))]
-    dropped_full = [np.zeros(S, np.int64) for _ in range(len(encs))]
-    perms: List[Optional[np.ndarray]] = [None] * len(encs)
-    S_cur = S
-    for _ in range(MAX_CHUNKS):
-        try:
-            buf = run(kernel, shapes_d, counts_d, dropped_d)
-        except Exception:
-            if kernel == "xla":
-                raise
-            log.exception("pallas batch kernel failed; retrying with xla")
-            kernel = "xla"
-            buf = run(kernel, shapes_d, counts_d, dropped_d)
-        counts_f, dropped_f, done, chosen, q, packed = unpack_batch_flat(
-            buf, S_cur, L)
-        for b in range(len(encs)):
-            perm = perms[b]
-            for i in range(L):
-                if q[b, i] > 0:
-                    rec = (packed[b, i] if perm is None
-                           else sparse_record(packed[b, i], perm))
-                    records[b].append((int(chosen[b, i]), int(q[b, i]), rec))
-            scatter_dropped(dropped_full[b], dropped_f[b], perm)
-        if done.all():
-            break
-        alive_max = int((counts_f > 0).sum(axis=1).max(initial=0))
-        S_new = bucket(max(alive_max, 1), SHAPE_BUCKETS)
-        if S_new is not None and S_new < S_cur:
-            perms, shapes_c, counts_c = compact_rows(
-                counts_f, perms, shapes_host, S_new)
-            S_cur = S_new
-            shapes_d, counts_d, dropped_d = jax.device_put(
-                (shapes_c, counts_c, np.zeros_like(counts_c)))
+        Batch-level active-shape compaction (ops/compact.py): the batch
+        tensors must keep ONE static S, so chunk boundaries re-bucket to
+        the bucket of the LARGEST alive set across problems. dropped is
+        accumulated host-side per problem (each resume ships zero rows) so
+        deltas scatter through each problem's permutation exactly."""
+        from karpenter_tpu.ops.compact import (
+            compact_rows, scatter_dropped, sparse_record,
+        )
+        from karpenter_tpu.ops.encode import SHAPE_BUCKETS, bucket
+        from karpenter_tpu.parallel.sharded_pack import unpack_batch_flat
+
+        jax = self._jax
+        encs = self.encs
+        L = self.L
+        records: List[list] = [[] for _ in range(len(encs))]
+        dropped_full = [np.zeros(self.S0, np.int64) for _ in range(len(encs))]
+        perms: List[Optional[np.ndarray]] = [None] * len(encs)
+        S_cur = self.S0
+        for _ in range(MAX_CHUNKS):
+            try:
+                self.launch()  # no-op on the first pass (already in flight)
+                buf = self._fetch_chunk()
+            except Exception:
+                if self.kernel == "xla":
+                    raise
+                log.exception("pallas batch kernel failed; retrying with xla")
+                self.kernel = "xla"
+                self._take_pending()  # drop the failed pallas buffer
+                self.launch()
+                buf = self._fetch_chunk()
+            counts_f, dropped_f, done, chosen, q, packed = unpack_batch_flat(
+                buf, S_cur, L)
+            for b in range(len(encs)):
+                perm = perms[b]
+                for i in range(L):
+                    if q[b, i] > 0:
+                        rec = (packed[b, i] if perm is None
+                               else sparse_record(packed[b, i], perm))
+                        records[b].append(
+                            (int(chosen[b, i]), int(q[b, i]), rec))
+                scatter_dropped(dropped_full[b], dropped_f[b], perm)
+            if done.all():
+                break
+            alive_max = int((counts_f > 0).sum(axis=1).max(initial=0))
+            S_new = bucket(max(alive_max, 1), SHAPE_BUCKETS)
+            if S_new is not None and S_new < S_cur:
+                perms, shapes_c, counts_c = compact_rows(
+                    counts_f, perms, self.shapes_host, S_new)
+                S_cur = S_new
+                self.shapes_d, self.counts_d, self.dropped_d = jax.device_put(
+                    (shapes_c, counts_c, np.zeros_like(counts_c)))
+            else:
+                self.counts_d, self.dropped_d = jax.device_put(
+                    (counts_f, np.zeros_like(counts_f)))
         else:
-            counts_d, dropped_d = jax.device_put(
-                (counts_f, np.zeros_like(counts_f)))
-    else:
-        raise RuntimeError("batched solve did not converge")
+            raise RuntimeError("batched solve did not converge")
 
-    return [
-        _decode(enc, records[b], dropped_full[b], packables_list[b],
-                config.max_instance_types)
-        for b, enc in enumerate(encs)
-    ]
+        return [
+            _decode(enc, records[b], dropped_full[b], self.packables_list[b],
+                    self.config.max_instance_types)
+            for b, enc in enumerate(encs)
+        ]

@@ -23,9 +23,62 @@ import logging
 import threading
 import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from contextlib import contextmanager
 from typing import Callable, Dict, Tuple
 
 log = logging.getLogger("karpenter.solver.hedge")
+
+# -- pipeline awareness -------------------------------------------------------
+# With the provisioning pipeline at depth > 1 (solver/pipeline.py) there is a
+# dispatched-but-unfetched BatchHandle occupying the device while the current
+# fetch materializes. A hedge fired in that state re-dispatches an identical
+# kernel BEHIND the in-flight batch: the duplicate queues after it, cannot
+# finish first, and steals device time from the chunk pipelined ahead — a
+# duplicate dispatch with no tail-reduction upside. Hedging therefore
+# self-disables while any BatchHandle is outstanding or any depth>1 pipeline
+# scope is active. Suppressed fetches do not feed the EWMA either: a
+# pipelined fetch's wall is mostly residual wait behind other chunks, not a
+# calibration signal for the unpipelined RTT.
+
+_SUPPRESS_LOCK = threading.Lock()
+_OUTSTANDING: set = set()  # id() of dispatched-but-unfetched BatchHandles
+_ACTIVE_PIPELINES = 0
+
+
+def note_dispatched(handle) -> None:
+    """Register a BatchHandle whose device batch is in flight."""
+    with _SUPPRESS_LOCK:
+        _OUTSTANDING.add(id(handle))
+
+
+def note_fetching(handle) -> None:
+    """The handle's fetch is starting: it stops counting as outstanding (the
+    device is now serving it, so its own materialize may hedge normally —
+    unless OTHER handles are still in flight behind it)."""
+    with _SUPPRESS_LOCK:
+        _OUTSTANDING.discard(id(handle))
+
+
+@contextmanager
+def pipeline_scope(depth: int):
+    """Mark a depth>1 pipeline window as active for its duration."""
+    global _ACTIVE_PIPELINES
+    if depth <= 1:
+        yield
+        return
+    with _SUPPRESS_LOCK:
+        _ACTIVE_PIPELINES += 1
+    try:
+        yield
+    finally:
+        with _SUPPRESS_LOCK:
+            _ACTIVE_PIPELINES -= 1
+
+
+def hedging_suppressed() -> bool:
+    """True while a duplicate dispatch could land behind an in-flight batch."""
+    with _SUPPRESS_LOCK:
+        return bool(_OUTSTANDING) or _ACTIVE_PIPELINES > 0
 
 # hedge only when the expected wall is comfortably RTT-shaped: beyond this
 # the duplicate dispatch costs real device time (e.g. the 8192-shape pallas
@@ -89,6 +142,10 @@ class HedgedFetcher:
         """Run ``fn()`` hedged. ``key`` identifies the compiled shape
         (kernel, bucket dims, chunk length) so the delay calibrates to the
         path actually running."""
+        if hedging_suppressed():
+            # pipelined mode: a duplicate would queue behind the outstanding
+            # batch — run plain, and keep the EWMA free of pipelined walls
+            return fn()
         with self._lock:
             ewma = self._wall.get(key)
         if ewma is None or ewma > MAX_HEDGEABLE_WALL_S:

@@ -1,0 +1,147 @@
+"""Bounded-depth pipelined executor for the provisioning hot loop.
+
+The serial hot loop stacks its costs end-to-end: marshal/encode chunk N,
+block on the device solve, launch + bulk-bind over the kube/EC2 wire while
+the TPU idles, then start chunk N+1. With `solver/batch_solve.py` split
+into dispatch and fetch halves, this module overlaps them instead:
+
+    chunk N-1 ──► launch/bind ─────────┐
+    chunk N   ──► device solve (in flight, JAX async dispatch)
+    chunk N+1 ──► marshal/encode + dispatch ◄─ host
+
+Depth 2 (double buffering, the default) keeps at most one batch in flight
+while the host works; the window is bounded so a slow device cannot pile
+up unfetched batches. Guarantees:
+
+- **Order**: chunks are consumed strictly in submission order (FIFO), so
+  bind order and result order match the serial path exactly.
+- **Pressure**: the effective depth is re-read from the PressureMonitor
+  before every dispatch; at L1+ it collapses to 1 (serial). The ladder
+  from PR 4 stays authoritative — overlap never hides rising window wall
+  time, because the batcher measures the window clock upstream of this
+  executor and the monitor's own signals (depth, throttle) are untouched.
+- **Drain**: on any stage failure every in-flight handle is still fetched
+  and consumed (each under its own try/except) before the first error
+  re-raises — no SolveResult is dropped, and the FIFO pop guarantees no
+  chunk is double-launched.
+- **Hedge**: a depth>1 window runs inside `hedge.pipeline_scope`, which
+  self-disables the hedged fetcher (a duplicate dispatch would queue
+  behind the in-flight batch — solver/hedge.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from karpenter_tpu.metrics.pipeline import (
+    PIPELINE_DEPTH, PIPELINE_DISPATCH_WAIT_SECONDS, PIPELINE_STAGE_SECONDS,
+    SOLVER_OVERLAP_SECONDS_TOTAL,
+)
+from karpenter_tpu.solver import hedge
+
+log = logging.getLogger("karpenter.solver.pipeline")
+
+
+@dataclass
+class PipelineConfig:
+    """``depth`` bounds dispatched-but-unfetched chunks (1 = serial, 2 =
+    double-buffered). ``chunk_items`` is the L0 chunk size the provisioning
+    loop feeds the pipeline — applied at EVERY depth so depth 1 and depth 2
+    see identical chunk boundaries and stay node-for-node comparable (the
+    L1+ pressure split, which is smaller or equal, takes precedence)."""
+
+    depth: int = 2
+    chunk_items: int = 4096
+
+
+class SolvePipeline:
+    """Drive ``prepare → dispatch → fetch → consume`` over ordered chunks
+    with at most ``depth`` handles in flight."""
+
+    def __init__(self, config: Optional[PipelineConfig] = None, monitor=None):
+        self.config = config or PipelineConfig()
+        self._monitor = monitor
+
+    def effective_depth(self) -> int:
+        """Configured depth, collapsed to 1 (serial) at pressure L1+."""
+        depth = max(1, int(self.config.depth))
+        if depth > 1 and self._monitor is not None \
+                and int(self._monitor.level()) >= 1:
+            return 1
+        return depth
+
+    def run(self, chunks, prepare: Callable, dispatch: Callable,
+            consume: Callable, on_chunk: Optional[Callable] = None) -> List:
+        """Run every chunk through the pipeline; returns ``consume``'s
+        outputs in chunk order.
+
+        ``prepare(chunk)`` does the host-side marshal (scheduling, problem
+        build); ``dispatch(prep)`` returns a handle with ``.fetch()``;
+        ``consume(prep, results)`` does launch/bind. ``on_chunk(prep,
+        stats)``, if given, receives per-chunk stage timings (used by the
+        worker for the binpacking histogram)."""
+        depth = self.effective_depth()
+        PIPELINE_DEPTH.set(float(depth))
+        with hedge.pipeline_scope(depth):
+            return self._run(chunks, prepare, dispatch, consume, on_chunk)
+
+    def _run(self, chunks, prepare, dispatch, consume, on_chunk) -> List:
+        inflight: deque = deque()  # FIFO of (prep, handle, t_disp, stats)
+        outs: List = []
+        try:
+            for chunk in chunks:
+                # re-read the ladder before every dispatch: a mid-window
+                # rise to L1+ must stop us running ahead immediately
+                depth = self.effective_depth()
+                PIPELINE_DEPTH.set(float(depth))
+                while len(inflight) >= depth:
+                    self._complete(inflight.popleft(), consume, outs,
+                                   on_chunk)
+                t0 = time.perf_counter()
+                prep = prepare(chunk)
+                handle = dispatch(prep)
+                t1 = time.perf_counter()
+                stats = {"marshal_s": t1 - t0}
+                PIPELINE_STAGE_SECONDS.observe(t1 - t0, stage="marshal")
+                inflight.append((prep, handle, t1, stats))
+            while inflight:
+                self._complete(inflight.popleft(), consume, outs, on_chunk)
+        except BaseException:
+            self._drain(inflight, consume, outs, on_chunk)
+            raise
+        return outs
+
+    def _complete(self, entry, consume, outs, on_chunk) -> None:
+        prep, handle, t_disp, stats = entry
+        t0 = time.perf_counter()
+        # the in-flight span: device time hidden behind host work (~0 when
+        # serial, where every fetch immediately follows its dispatch)
+        stats["inflight_s"] = t0 - t_disp
+        PIPELINE_DISPATCH_WAIT_SECONDS.observe(stats["inflight_s"])
+        SOLVER_OVERLAP_SECONDS_TOTAL.inc(amount=stats["inflight_s"])
+        results = handle.fetch()
+        t1 = time.perf_counter()
+        out = consume(prep, results)
+        t2 = time.perf_counter()
+        stats["device_s"] = t1 - t0
+        stats["launch_bind_s"] = t2 - t1
+        PIPELINE_STAGE_SECONDS.observe(t1 - t0, stage="device")
+        PIPELINE_STAGE_SECONDS.observe(t2 - t1, stage="launch_bind")
+        if on_chunk is not None:
+            on_chunk(prep, stats)
+        outs.append(out)
+
+    def _drain(self, inflight: deque, consume, outs, on_chunk) -> None:
+        """Fault/shutdown path: fetch AND consume every outstanding handle
+        so no solved chunk is dropped; per-handle failures are logged, not
+        raised (the original error is already propagating)."""
+        while inflight:
+            entry = inflight.popleft()
+            try:
+                self._complete(entry, consume, outs, on_chunk)
+            except Exception:
+                log.exception("pipeline drain: outstanding chunk failed")

@@ -134,13 +134,13 @@ class TestBatchRouting:
                                 instance_types=problems[0].instance_types))
 
         seen_batches = []
-        real = bs._device_batch
+        real = bs._launch_device_batch
 
-        def spying(encs, packables_list, config):
+        def spying(encs, packables_list, prices_list, config):
             seen_batches.append([e.num_shapes for e in encs])
-            return real(encs, packables_list, config)
+            return real(encs, packables_list, prices_list, config)
 
-        monkeypatch.setattr(bs, "_device_batch", spying)
+        monkeypatch.setattr(bs, "_launch_device_batch", spying)
         config = SolverConfig(device_min_pods=1, device_max_shapes=32)
         out = solve_batch(problems, config=config)
         for batch in seen_batches:

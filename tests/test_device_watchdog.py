@@ -177,10 +177,13 @@ class TestBatchSolveWithWatchdog:
         ]
         want = solve_batch(problems, config=SolverConfig(use_device=False))
 
+        # hang at the fetch seam: the dispatch half (device_put + async
+        # launch) still runs for real, and the watchdog must trip while the
+        # materialize is parked — exactly where a sick transport stalls
         def hang(*a, **kw):
             time.sleep(10.0)
 
-        monkeypatch.setattr(bs, "_device_batch", hang)
+        monkeypatch.setattr(bs, "_finish_device_batch", hang)
         t0 = time.monotonic()
         got = solve_batch(problems, config=SolverConfig(
             device_min_pods=1, device_timeout_s=0.1,

@@ -149,3 +149,76 @@ def test_solve_path_respects_device_hedge_flag(monkeypatch):
     assert res.node_count >= 1 and not res.unschedulable
 
 
+
+
+# -- pipeline awareness (round 7 regression) ---------------------------------
+# With the provisioning pipeline at depth > 1 there is a dispatched-but-
+# unfetched batch on the device; a hedge fired then re-dispatches BEHIND it
+# and can never win. The hedger must self-disable while any BatchHandle is
+# outstanding or a depth>1 pipeline scope is active — and must not let the
+# pipelined walls (mostly residual wait) poison the EWMA.
+
+
+def _tail_prone_fetcher():
+    """Fetcher calibrated so a 0.2 s fetch is a guaranteed tail event."""
+    f = HedgedFetcher(min_delay_s=0.01, multiplier=1.0)
+    f._wall[("k",)] = 0.01  # known-fast path: hedge delay ~10 ms
+    return f
+
+
+def test_outstanding_handle_suppresses_hedging():
+    from karpenter_tpu.solver import hedge
+
+    f = _tail_prone_fetcher()
+    handle = object()
+    hedge.note_dispatched(handle)
+    try:
+        assert hedge.hedging_suppressed()
+        calls = []
+        out = f.fetch(("k",), lambda: calls.append(1) or time.sleep(0.2) or "a")
+        assert out == "a" and len(calls) == 1
+        assert f.hedges_fired == 0, "hedged behind an in-flight batch"
+        # suppressed walls must not recalibrate the EWMA
+        assert f._wall[("k",)] == 0.01
+    finally:
+        hedge.note_fetching(handle)
+    assert not hedge.hedging_suppressed()
+
+
+def test_pipeline_scope_suppresses_hedging_and_reenables_on_exit():
+    from karpenter_tpu.solver import hedge
+
+    f = _tail_prone_fetcher()
+    with hedge.pipeline_scope(2):
+        assert hedge.hedging_suppressed()
+        f.fetch(("k",), lambda: time.sleep(0.2) or "a")
+        assert f.hedges_fired == 0
+    assert not hedge.hedging_suppressed()
+    # back to normal: the same tail event now fires the hedge
+    f.fetch(("k",), lambda: time.sleep(0.2) or "b")
+    assert f.hedges_fired == 1
+
+
+def test_depth1_pipeline_scope_does_not_suppress():
+    from karpenter_tpu.solver import hedge
+
+    with hedge.pipeline_scope(1):
+        assert not hedge.hedging_suppressed()
+
+
+def test_fetch_start_lifts_own_suppression_but_not_others():
+    """A handle stops counting as outstanding when ITS fetch begins; other
+    in-flight handles keep hedging off."""
+    from karpenter_tpu.solver import hedge
+
+    a, b = object(), object()
+    hedge.note_dispatched(a)
+    hedge.note_dispatched(b)
+    try:
+        hedge.note_fetching(a)
+        assert hedge.hedging_suppressed(), "b is still in flight"
+        hedge.note_fetching(b)
+        assert not hedge.hedging_suppressed()
+    finally:
+        hedge.note_fetching(a)
+        hedge.note_fetching(b)

@@ -1,0 +1,85 @@
+"""Pipeline/warmup option plumbing (config/options.py).
+
+The round-7 flags must parse from the CLI, fall back to their
+KARPENTER_-prefixed environment variables, let an explicit flag beat the
+environment, and validate their ranges — an operator typo must fail at
+boot, not deep in the hot loop.
+"""
+
+import pytest
+
+from karpenter_tpu.config.options import Options, parse
+
+
+class TestDefaults:
+    def test_pipeline_and_warmup_defaults(self):
+        o = parse([])
+        assert o.pipeline_depth == 2
+        assert o.pipeline_chunk_items == 4096
+        assert o.solver_warmup is False
+        assert o.solver_compile_cache_dir == ""
+
+
+class TestFlags:
+    def test_flags_parse(self):
+        o = parse([
+            "--pipeline-depth", "3",
+            "--pipeline-chunk-items", "512",
+            "--solver-warmup",
+            "--solver-compile-cache-dir", "/tmp/ktpu-cache",
+        ])
+        assert o.pipeline_depth == 3
+        assert o.pipeline_chunk_items == 512
+        assert o.solver_warmup is True
+        assert o.solver_compile_cache_dir == "/tmp/ktpu-cache"
+
+    def test_no_solver_warmup_flag(self):
+        assert parse(["--no-solver-warmup"]).solver_warmup is False
+
+
+class TestEnvFallback:
+    def test_env_fallbacks(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_PIPELINE_DEPTH", "4")
+        monkeypatch.setenv("KARPENTER_PIPELINE_CHUNK_ITEMS", "128")
+        monkeypatch.setenv("KARPENTER_SOLVER_WARMUP", "true")
+        monkeypatch.setenv("KARPENTER_SOLVER_COMPILE_CACHE_DIR", "/var/cache/xla")
+        o = parse([])
+        assert o.pipeline_depth == 4
+        assert o.pipeline_chunk_items == 128
+        assert o.solver_warmup is True
+        assert o.solver_compile_cache_dir == "/var/cache/xla"
+
+    def test_flag_beats_env(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_PIPELINE_DEPTH", "4")
+        assert parse(["--pipeline-depth", "5"]).pipeline_depth == 5
+
+    def test_no_flag_beats_env_bool(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_WARMUP", "true")
+        assert parse(["--no-solver-warmup"]).solver_warmup is False
+
+    @pytest.mark.parametrize("raw,want", [
+        ("1", True), ("yes", True), ("TRUE", True),
+        ("0", False), ("false", False), ("", False),
+    ])
+    def test_bool_env_coercion(self, monkeypatch, raw, want):
+        monkeypatch.setenv("KARPENTER_SOLVER_WARMUP", raw)
+        assert parse([]).solver_warmup is want
+
+
+class TestValidation:
+    def _errs(self, **kw):
+        return Options(cluster_name="c", cluster_endpoint="e", **kw).validate()
+
+    def test_valid_defaults_pass(self):
+        assert self._errs() == []
+
+    def test_pipeline_depth_must_be_positive(self):
+        errs = self._errs(pipeline_depth=0)
+        assert any("pipeline-depth" in e for e in errs)
+
+    def test_pipeline_chunk_items_must_be_nonnegative(self):
+        errs = self._errs(pipeline_chunk_items=-1)
+        assert any("pipeline-chunk-items" in e for e in errs)
+
+    def test_zero_chunk_items_disables_chunking_and_is_valid(self):
+        assert self._errs(pipeline_chunk_items=0) == []

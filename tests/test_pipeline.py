@@ -1,0 +1,235 @@
+"""Pipelined hot loop (solver/pipeline.py + the provisioning worker).
+
+The pipeline buys overlap, never answers: a depth-2 run must be
+result-identical — per-problem node sets AND bind order — to the serial
+path, across seeds, including when a mid-pipeline device fault trips the
+watchdog and the outstanding chunks fall back to the host executors. The
+executor itself must collapse to serial at pressure L1+ and drain every
+dispatched handle on failure (no SolveResult dropped, none double-fetched).
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.chaos import inject
+from karpenter_tpu.cloudprovider.fake.provider import FakeCloudProvider, instance_types
+from karpenter_tpu.controllers.provisioning import (
+    ProvisionerWorker, universe_constraints,
+)
+from karpenter_tpu.scheduling.batcher import Batcher
+from karpenter_tpu.solver import solve as solve_mod
+from karpenter_tpu.solver.pipeline import PipelineConfig, SolvePipeline
+from karpenter_tpu.solver.solve import SolverConfig
+from karpenter_tpu.runtime.kubecore import KubeCore
+from tests.expectations import make_provisioner, unschedulable_pod
+
+
+@pytest.fixture()
+def fresh_watchdog(monkeypatch):
+    wd = solve_mod._DeviceWatchdog()
+    monkeypatch.setattr(solve_mod, "_WATCHDOG", wd)
+    return wd
+
+
+def make_pods(seed: int, n: int = 120):
+    """Deterministic pod population: a few request shapes (so the device
+    batch stays in one compile bucket) and alternating zone selectors (so
+    each chunk schedules into >= 2 problems and actually batches)."""
+    rng = random.Random(seed)
+    cpus = ["250m", "500m", "1"]
+    mems = ["256Mi", "512Mi"]
+    pods = []
+    for i in range(n):
+        selector = {}
+        if i % 2:
+            selector = {wellknown.LABEL_TOPOLOGY_ZONE:
+                        rng.choice(["test-zone-1", "test-zone-2"])}
+        pods.append(unschedulable_pod(
+            requests={"cpu": rng.choice(cpus), "memory": rng.choice(mems)},
+            node_selector=selector, name=f"pod-s{seed}-{i:03d}"))
+    return pods
+
+
+def run_provision(seed: int, depth: int, n: int = 120, chunk_items: int = 25):
+    """One full worker pass at the given pipeline depth; returns the bind
+    groups (tuples of pod names) in bind-call order plus the node count."""
+    kube = KubeCore()
+    catalog = instance_types(6)
+    provider = FakeCloudProvider(catalog=catalog)
+    provisioner = make_provisioner(constraints=universe_constraints(catalog))
+    kube.create(provisioner)
+    worker = ProvisionerWorker(
+        provisioner, kube, provider,
+        solver_config=SolverConfig(device_min_pods=1),
+        batcher=Batcher(idle_seconds=0.05, max_seconds=5.0),
+        pipeline_config=PipelineConfig(depth=depth, chunk_items=chunk_items))
+    binds = []
+    orig_bind = worker._bind
+
+    def recording_bind(node, pods):
+        binds.append(tuple(sorted(p.metadata.name for p in pods)))
+        return orig_bind(node, pods)
+
+    worker._bind = recording_bind
+    pods = make_pods(seed, n)
+    for pod in pods:
+        kube.create(pod)
+        gate = worker.add(pod, key=(pod.metadata.namespace, pod.metadata.name))
+        assert gate is not None, "L0 admission shed a pod"
+    worker.provision()
+    worker.stop()
+    return binds, len(kube.list("Node")), [p.metadata.name for p in pods]
+
+
+class TestDifferentialPipelinedVsSerial:
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_depth2_identical_to_serial(self, seed, fresh_watchdog):
+        serial_binds, serial_nodes, pod_names = run_provision(seed, depth=1)
+        piped_binds, piped_nodes, _ = run_provision(seed, depth=2)
+        # every pod bound exactly once in both modes
+        flat = sorted(name for group in piped_binds for name in group)
+        assert flat == sorted(pod_names)
+        # node parity AND bind order: the pipeline must not reorder chunks
+        assert piped_nodes == serial_nodes
+        assert piped_binds == serial_binds
+
+    def test_chaos_midpipeline_watchdog_trip_loses_nothing(
+            self, fresh_watchdog):
+        """A device fault on the FIRST fetch (while the next chunk is
+        already dispatched behind it) must fall back to the host executors
+        without losing or duplicating a single pod — and the degraded run
+        still matches the serial answer node-for-node."""
+        seed = 7
+        serial_binds, serial_nodes, pod_names = run_provision(seed, depth=1)
+        plan = inject.FaultPlan(11, [
+            inject.FaultSpec("device", "solve", "watchdog-trip", 1)],
+            window=1)
+        inject.install(plan)
+        try:
+            chaos_binds, chaos_nodes, _ = run_provision(seed, depth=2)
+        finally:
+            inject.uninstall()
+        assert plan.fired_counts() == {
+            ("device", "solve", "watchdog-trip"): 1}
+        # chunk 0's fetch tripped the breaker (the log shows the 120 s open),
+        # but chunk 1 — dispatched BEFORE the trip and healthy — closes it
+        # again when its own fetch succeeds: the pipeline recovers to the
+        # device path within the same window instead of staying degraded
+        assert not solve_mod._WATCHDOG.tripped(), (
+            "healthy in-flight chunk did not close the breaker")
+        # no pod lost, none bound twice
+        flat = sorted(name for group in chaos_binds for name in group)
+        assert flat == sorted(pod_names)
+        # fallback answers are differential with the device path, so even
+        # the degraded run matches the serial baseline exactly
+        assert chaos_nodes == serial_nodes
+        assert chaos_binds == serial_binds
+
+
+class _CountingHandle:
+    def __init__(self, results, tracker):
+        self._results = results
+        self._tracker = tracker
+        self.fetches = 0
+
+    def fetch(self):
+        self.fetches += 1
+        self._tracker["now"] -= 1
+        return self._results
+
+
+class _Monitor:
+    def __init__(self, level):
+        self._level = level
+
+    def level(self):
+        return self._level
+
+
+class TestPressureCollapse:
+    def test_effective_depth_collapses_at_l1(self):
+        pipe = SolvePipeline(PipelineConfig(depth=3), monitor=_Monitor(1))
+        assert pipe.effective_depth() == 1
+        pipe = SolvePipeline(PipelineConfig(depth=3), monitor=_Monitor(0))
+        assert pipe.effective_depth() == 3
+        # depth 1 stays serial regardless of the ladder
+        pipe = SolvePipeline(PipelineConfig(depth=1), monitor=_Monitor(0))
+        assert pipe.effective_depth() == 1
+
+    @pytest.mark.parametrize("level,want_max", [(0, 2), (1, 1), (2, 1)])
+    def test_run_bounds_inflight_handles(self, level, want_max):
+        tracker = {"now": 0, "max": 0}
+        handles = []
+
+        def dispatch(prep):
+            tracker["now"] += 1
+            tracker["max"] = max(tracker["max"], tracker["now"])
+            handle = _CountingHandle([prep], tracker)
+            handles.append(handle)
+            return handle
+
+        pipe = SolvePipeline(PipelineConfig(depth=2, chunk_items=0),
+                             monitor=_Monitor(level))
+        outs = pipe.run(list(range(6)), prepare=lambda c: c,
+                        dispatch=dispatch,
+                        consume=lambda prep, results: results[0])
+        assert outs == list(range(6))
+        assert tracker["max"] == want_max
+        # FIFO pop: every dispatched handle fetched exactly once
+        assert [h.fetches for h in handles] == [1] * 6
+
+
+class TestDrain:
+    def test_consume_failure_drains_every_dispatched_handle(self):
+        tracker = {"now": 0, "max": 0}
+        handles = []
+        consumed = []
+
+        def dispatch(prep):
+            tracker["now"] += 1
+            handle = _CountingHandle([prep], tracker)
+            handles.append(handle)
+            return handle
+
+        def consume(prep, results):
+            consumed.append(prep)
+            raise ValueError("bind exploded")
+
+        pipe = SolvePipeline(PipelineConfig(depth=2, chunk_items=0))
+        with pytest.raises(ValueError):
+            pipe.run(list(range(4)), prepare=lambda c: c,
+                     dispatch=dispatch, consume=consume)
+        # chunks 0 and 1 were dispatched before the first consume raised;
+        # BOTH must still be fetched (and consumption attempted) exactly
+        # once — nothing dropped, nothing double-launched
+        assert len(handles) == 2
+        assert [h.fetches for h in handles] == [1, 1]
+        assert consumed == [0, 1]
+
+    def test_fetch_failure_drains_remaining_handles(self):
+        handles = []
+
+        class _Exploding:
+            def __init__(self, boom):
+                self.boom = boom
+                self.fetches = 0
+
+            def fetch(self):
+                self.fetches += 1
+                if self.boom:
+                    raise RuntimeError("transport died")
+                return ["ok"]
+
+        def dispatch(prep):
+            handle = _Exploding(boom=(prep == 0))
+            handles.append(handle)
+            return handle
+
+        pipe = SolvePipeline(PipelineConfig(depth=2, chunk_items=0))
+        with pytest.raises(RuntimeError):
+            pipe.run(list(range(4)), prepare=lambda c: c,
+                     dispatch=dispatch,
+                     consume=lambda prep, results: results[0])
+        assert [h.fetches for h in handles] == [1, 1]

@@ -135,6 +135,18 @@ class TestHysteresis:
         mon.note_depth(1, 90)  # >= depth_l3 (85% of 100)
         assert mon.evaluate() == PressureLevel.L3
 
+    def test_burst_within_one_eval_window_still_rises(self):
+        """A flood that fills the queue inside a single eval_interval
+        window must still raise the ladder: level() serves a cached rung
+        for 50 ms, but a depth sample crossing a rung threshold forces a
+        re-evaluation — "rises immediately" must not depend on the intake
+        loop being slow enough to straddle two eval windows."""
+        mon, t = _monitor()
+        assert mon.level() == PressureLevel.L0  # primes the eval cache
+        # zero wall time passes: a plain level() would serve the cached L0
+        mon.note_depth(1, 90)  # >= depth_l3 — the burst guard re-evaluates
+        assert mon.level() == PressureLevel.L3
+
     def test_falls_one_rung_per_dwell(self):
         mon, t = _monitor(dwell=5.0)
         mon.note_depth(1, 60)  # >= depth_l2 (50)

@@ -1,0 +1,49 @@
+"""Compile warmup + persistent cache wiring (solver/warmup.py).
+
+One tiny bucket keeps the test fast: the point is that the warmup drives
+the SAME jitted entries the serving path uses (so a warmed bucket is a
+compile-free bucket), never raises, and that the cache knob round-trips.
+"""
+
+from karpenter_tpu.solver import warmup
+from karpenter_tpu.solver.solve import SolverConfig
+
+
+class TestCompilationCache:
+    def test_empty_dir_disables(self):
+        assert warmup.configure_compilation_cache("") is False
+
+    def test_configures_and_creates_dir(self, tmp_path):
+        import jax
+
+        cache = tmp_path / "xla-cache"
+        old = jax.config.jax_compilation_cache_dir
+        try:
+            assert warmup.configure_compilation_cache(str(cache)) is True
+            assert cache.is_dir()
+            assert jax.config.jax_compilation_cache_dir == str(cache)
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old)
+
+
+class TestWarmupPass:
+    def test_smallest_bucket_compiles_solo_and_batch(self):
+        n = warmup.warmup_pass(SolverConfig(), shape_buckets=[8],
+                               type_buckets=[8])
+        assert n == 2  # one solo entry + one batch entry
+
+    def test_failed_bucket_is_swallowed(self, monkeypatch):
+        # force the synthetic builder to blow up: the pass must log and
+        # return 0, never raise (warmup must never hurt boot)
+        def boom(S, T):
+            raise RuntimeError("synthetic failure")
+
+        monkeypatch.setattr(warmup, "_synthetic_args", boom)
+        assert warmup.warmup_pass(SolverConfig(), shape_buckets=[8],
+                                  type_buckets=[8]) == 0
+
+    def test_background_thread_completes(self):
+        t = warmup.start_warmup(SolverConfig(), shape_buckets=[8],
+                                type_buckets=[8], include_batch=False)
+        t.join(timeout=120)
+        assert not t.is_alive()
