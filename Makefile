@@ -21,8 +21,11 @@ bench-shapes: ## Shape-cardinality + type-SPMD configs only (compaction regime)
 bench-control: ## Control-plane config only (columnar filter regime, filter_ms breakdown)
 	python bench.py --only config_7
 
-bench-pipeline: ## Control-plane pipeline A/B: depth 2 vs serial, side-by-side in extra.pipeline_ab
-	python bench.py --only config_7
+DEVICES ?= 2  # virtual host devices for bench-pipeline (--xla_force_host_platform_device_count)
+
+bench-pipeline: ## Pipeline A/B at DEVICES virtual devices (DEVICES=N); prints verdict line on stderr
+	python bench.py --only config_7 --devices $(DEVICES) \
+		| python tools/pipeline_verdict.py
 
 native: ## Build the C++ FFD kernel explicitly (normally built lazily)
 	g++ -O3 -std=c++17 -shared -fPIC \

@@ -56,8 +56,37 @@ TARGET_MS = 200.0
 ITERS = 100           # target timed iterations per config
 BUDGET_S = 90.0       # wall-clock cap per config's timing loop
 _MODE_ENV = "KARPENTER_BENCH_MODE"        # unset=supervisor | direct | direct-cpu
+_DEVICES_ENV = "KARPENTER_BENCH_DEVICES"  # --devices N, inherited by children
 TPU_CHILD_DEADLINE_S = 1800.0
 CPU_CHILD_DEADLINE_S = 1500.0
+
+
+def _apply_devices_env():
+    """Honor ``--devices N`` (the _DEVICES_ENV var) in a child: force the
+    host platform to expose N virtual devices via XLA_FLAGS. Must run
+    before jax is imported; if some import beat us to it (direct mode
+    invoked by hand in an already-warm interpreter), re-exec so the flag
+    takes. On a real TPU backend the flag is inert (it only affects the
+    host platform), so it is safe to set unconditionally."""
+    raw = os.environ.get(_DEVICES_ENV, "").strip()
+    if not raw:
+        return
+    try:
+        n = int(raw)
+    except ValueError:
+        return
+    if n < 1:
+        return
+    want = f"--xla_force_host_platform_device_count={n}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if want not in flags.split():
+        flags = " ".join(
+            [f for f in flags.split()
+             if not f.startswith("--xla_force_host_platform_device_count=")]
+            + [want])
+        os.environ["XLA_FLAGS"] = flags
+        if "jax" in sys.modules:  # too late for this process: re-exec
+            os.execv(sys.executable, [sys.executable] + sys.argv)
 
 
 def _q(times_sorted, frac):
@@ -570,11 +599,19 @@ def config_7_control_plane():
     in one call — pipelined (depth 2, solver/pipeline.py double buffering)
     and serial (depth 1) — with identical batching and chunk boundaries,
     so `nodes_created` must match exactly and the throughput ratio is
-    attributable to launch/bind ↔ solve overlap alone. Headline fields
+    attributable to launch/bind ↔ solve overlap alone. Adaptive depth is
+    PINNED OFF for both legs (an adaptive run would collapse the depth-2
+    leg under its own measurement and poison the A/B). Headline fields
     report the pipelined run; the side-by-side comparison lands in
-    ``pipeline_ab``. NOTE: on a 1-core host (this container) the overlap
-    is GIL-bound — the honest speedup ceiling is ~1.0× here; the ratio is
-    reported, not asserted."""
+    ``pipeline_ab`` with per-stage wall, per-device live bytes at peak,
+    and the ring's allocation/refill deltas per leg. NOTE: on a 1-core
+    host (this container) the overlap is GIL-bound — the honest speedup
+    ceiling is ~1.0× here; the ratio is reported, not asserted."""
+    # untimed prewarm at a fraction of the load: compiles the ring pjit +
+    # refill jits and leaves warm ring slots, so neither timed leg pays
+    # cold-compile inside its window (the legs share every jit cache —
+    # whichever ran first used to eat ~2 s of XLA lowering in 'marshal')
+    prewarm = _control_plane_run(pipeline_depth=2, n=4096)
     on = _control_plane_run(pipeline_depth=2)
     off = _control_plane_run(pipeline_depth=1)
     sps, pps = off["pods_bound_per_sec"], on["pods_bound_per_sec"]
@@ -583,6 +620,8 @@ def config_7_control_plane():
         "pipeline_ab": {
             "depth_pipelined": 2,
             "depth_serial": 1,
+            "adaptive": "pinned off for both legs",
+            "device_count": _device_count(),
             "chunk_items": _CP_CHUNK_ITEMS,
             "pods_bound_per_sec_pipelined": pps,
             "pods_bound_per_sec_serial": sps,
@@ -594,11 +633,18 @@ def config_7_control_plane():
             "nodes_equal": on["nodes_created"] == off["nodes_created"],
             "executors_pipelined": on["executor_delta"],
             "executors_serial": off["executor_delta"],
+            "stage_ms_pipelined": on["stage_ms"],
+            "stage_ms_serial": off["stage_ms"],
+            "ring_pipelined": on["ring"],
+            "ring_serial": off["ring"],
+            "peak_live_device_bytes": max(on["peak_live_device_bytes"],
+                                          off["peak_live_device_bytes"]),
+            "prewarm_wall_s": prewarm["wall_s"],
         },
     }
 
 
-def _control_plane_run(pipeline_depth: int):
+def _control_plane_run(pipeline_depth: int, n: int = 10_000):
     """Control-plane load: 10k unschedulable pods through the FULL stack —
     watch pump → selection (64 workers, non-blocking gate) → batcher →
     pipelined batched sharded solves → launch → bind — against the
@@ -626,11 +672,28 @@ def _control_plane_run(pipeline_depth: int):
     from karpenter_tpu.metrics.filter import (
         FILTER_BATCH_SECONDS, FILTER_FALLBACK_TOTAL,
     )
+    from karpenter_tpu.metrics.pipeline import PIPELINE_STAGE_SECONDS
 
     def _filter_snapshot():
         hist = {lv: (s, total) for lv, (_, s, total)
                 in FILTER_BATCH_SECONDS.collect().items()}
         return hist, dict(FILTER_FALLBACK_TOTAL.collect())
+
+    def _stage_snapshot():
+        return {lv: (s, n) for lv, (_, s, n)
+                in PIPELINE_STAGE_SECONDS.collect().items()}
+
+    def _stage_delta(before, after):
+        """Per-stage wall delta: where this leg's chunks actually spent
+        their time (marshal | device | launch_bind)."""
+        out = {}
+        for lv, (s1, n1) in after.items():
+            s0, n0 = before.get(lv, (0.0, 0))
+            if n1 - n0:
+                out[dict(lv).get("stage", "?")] = {
+                    "total_ms": round((s1 - s0) * 1000, 1),
+                    "chunks": n1 - n0}
+        return out
 
     def _filter_delta(before, after):
         hist0, fb0 = before
@@ -670,14 +733,17 @@ def _control_plane_run(pipeline_depth: int):
     def _executor_counts():
         return dict(DEFAULT.counter("solver_solves_total").collect())
 
-    N = 10_000
+    N = n
     catalog = make_catalog(100)
     kube = KubeCore()
     provider = decorate(FakeCloudProvider(catalog=catalog))
+    # adaptive=False: the A/B legs pin their depth — letting the adaptive
+    # controller re-step mid-leg would measure its policy, not the overlap
     provisioning = ProvisioningController(
         kube, provider,
         pipeline_config=PipelineConfig(depth=pipeline_depth,
-                                       chunk_items=_CP_CHUNK_ITEMS),
+                                       chunk_items=_CP_CHUNK_ITEMS,
+                                       adaptive=False),
         batcher_factory=functools.partial(
             Batcher, idle_seconds=1.0, max_seconds=60.0))
     manager = Manager(kube)
@@ -709,16 +775,37 @@ def _control_plane_run(pipeline_depth: int):
 
         watch_q = kube.watch("Pod", meta_only=True)
 
+        from karpenter_tpu.parallel.mesh import device_bytes_in_use
+        from karpenter_tpu.solver.pipeline import get_ring
+
         shapes = MIXED_SHAPES
         created_at = {}
         filter_before = _filter_snapshot()
+        stage_before = _stage_snapshot()
+        ring0 = get_ring().counters()
+        peak_bytes, peak_per_device = 0, {}
+
+        def _sample_device_bytes():
+            nonlocal peak_bytes, peak_per_device
+            per_dev = device_bytes_in_use()
+            total = sum(per_dev.values())
+            if total > peak_bytes:
+                peak_bytes, peak_per_device = total, per_dev
+
         overlap0 = _overlap_total()
         exec0 = _executor_counts()
+        from karpenter_tpu.api import wellknown
+
         t_start = _time.perf_counter()
         for i in range(N):
             c, m = shapes[i % len(shapes)]
+            # alternate zones: each chunk schedules into >= 2 problems so
+            # the window exercises the BATCHED sharded solve (the ring +
+            # donation path under test), not the solo per-problem kernel
             pod = unschedulable_pod(
                 requests={"cpu": f"{c}m", "memory": f"{m}Mi"},
+                node_selector={wellknown.LABEL_TOPOLOGY_ZONE:
+                               f"bench-zone-{1 + i % 2}"},
                 name=f"load-{i}")
             kube.create(pod)
             created_at[pod.metadata.name] = _time.perf_counter()
@@ -726,7 +813,11 @@ def _control_plane_run(pipeline_depth: int):
 
         bound_at = {}
         deadline = _time.monotonic() + 240.0
+        polls = 0
         while len(bound_at) < N and _time.monotonic() < deadline:
+            polls += 1
+            if polls % 50 == 0:  # ~10 s cadence: live-buffer walks aren't free
+                _sample_device_bytes()
             try:
                 event = watch_q.get(timeout=0.2)
             except _queue.Empty:
@@ -739,7 +830,10 @@ def _control_plane_run(pipeline_depth: int):
                              lambda p: bool(p.spec.node_name)):
                     bound_at[name] = _time.perf_counter()
         t_done = _time.perf_counter()
+        _sample_device_bytes()  # steady-state sample: the ring is resident
         filter_after = _filter_snapshot()
+        stage_after = _stage_snapshot()
+        ring1 = get_ring().counters()
         kube.unwatch(watch_q)
     finally:
         manager.stop()
@@ -764,11 +858,19 @@ def _control_plane_run(pipeline_depth: int):
         "pods_bound_per_sec": round(bound / total_s) if total_s > 0 else 0,
         "nodes_created": len(kube.list("Node")),
         "filter_ms": _filter_delta(filter_before, filter_after),
+        "stage_ms": _stage_delta(stage_before, stage_after),
+        "ring": {"allocations": ring1["allocations"] - ring0["allocations"],
+                 "refills": ring1["refills"] - ring0["refills"],
+                 "slots": ring1["slots"]},
+        "peak_live_device_bytes": peak_bytes,
+        "peak_live_device_bytes_per_device": {
+            str(k): v for k, v in sorted(peak_per_device.items())},
         "selection_workers": sel_workers,
         "stack": f"watch → selection({sel_workers}w adaptive, non-blocking)"
                  " → batcher(single-window) → pipelined batched sharded "
                  f"solve (depth {pipeline_depth}, chunks of "
-                 f"{_CP_CHUNK_ITEMS}) → launch → bulk bind (kubecore)",
+                 f"{_CP_CHUNK_ITEMS}, 2-zone spread → ring/donation path) "
+                 "→ launch → bulk bind (kubecore)",
     }
     assert bound == N, f"only {bound}/{N} pods bound"
     return out
@@ -781,6 +883,15 @@ def _backend_name():
         return jax.default_backend()
     except Exception:
         return "unknown"
+
+
+def _device_count():
+    try:
+        import jax
+
+        return jax.device_count()
+    except Exception:
+        return None
 
 
 def _persist_partial(extra):
@@ -819,7 +930,8 @@ def run_all(degraded: bool, probe_note: str = ""):
         headline_times, c4 = config_4_headline()   # headline first: fail fast
     else:
         headline_times, c4 = [], {"skipped": "not in --only"}
-    extra = {"backend": _backend_name(), "degraded": degraded}
+    extra = {"backend": _backend_name(), "degraded": degraded,
+             "device_count": _device_count()}
     if probe_note:
         extra["probe"] = probe_note
     if only is not None:
@@ -922,22 +1034,43 @@ def _run_child(mode: str, deadline_s: float, probe_note: str,
     return None
 
 
+def _parse_args(argv):
+    """`--only config_N ...` and `--devices N`, in either order. Both are
+    carried in the environment so the supervisor's child processes (and
+    their degraded re-execs) inherit the selection without re-parsing."""
+    usage = "usage: bench.py [--only config_N ...] [--devices N]"
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--devices":
+            if i + 1 >= len(argv) or not argv[i + 1].isdigit():
+                print(usage, file=sys.stderr)
+                return False
+            os.environ[_DEVICES_ENV] = argv[i + 1]
+            i += 2
+        elif argv[i] == "--only":
+            names = []
+            i += 1
+            while i < len(argv) and not argv[i].startswith("--"):
+                names.append(argv[i])
+                i += 1
+            if not names:
+                print(usage, file=sys.stderr)
+                return False
+            os.environ["KARPENTER_BENCH_ONLY"] = " ".join(names)
+        else:
+            print(f"unknown argument {argv[i]!r}; {usage}", file=sys.stderr)
+            return False
+    return True
+
+
 def main():
-    # `--only config_6 config_8`: restrict the run to the named configs.
-    # Carried in the environment so the supervisor's child processes (and
-    # their degraded re-execs) inherit the selection without re-parsing.
-    argv = sys.argv[1:]
-    if argv and argv[0] == "--only":
-        if len(argv) < 2:
-            print("usage: bench.py [--only config_N ...]", file=sys.stderr)
-            return 2
-        os.environ["KARPENTER_BENCH_ONLY"] = " ".join(argv[1:])
-    elif argv:
-        print(f"unknown arguments {argv!r}; "
-              "usage: bench.py [--only config_N ...]", file=sys.stderr)
+    if not _parse_args(sys.argv[1:]):
         return 2
     mode = os.environ.get(_MODE_ENV)
     note = os.environ.get("KARPENTER_BENCH_NOTE", "")
+    if mode in ("direct", "direct-cpu"):
+        # must precede any jax import in this child (re-execs if one won)
+        _apply_devices_env()
     if mode == "direct":
         print(json.dumps(run_all(degraded=False, probe_note=note)))
         return 0

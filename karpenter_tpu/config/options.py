@@ -46,6 +46,14 @@ class Options:
     # L0 chunk size the pipeline overlaps over; applied at every depth so
     # serial and pipelined runs see identical chunk boundaries; 0 disables
     pipeline_chunk_items: int = 4096
+    # step the depth 1↔3 from measured per-window overlap instead of
+    # pinning the flag (solver/pipeline.py _AdaptiveDepth); pipeline-depth
+    # becomes the starting point
+    pipeline_adaptive: bool = True
+    # device ring + buffer donation (solver/pipeline.py DeviceRing):
+    # steady-state chunks refill device-resident buffers in place instead
+    # of allocating; off restores fresh device_puts per chunk
+    solver_donate: bool = True
     # pre-compile the (shape × type) bucket ladder at boot (solver/warmup.py)
     solver_warmup: bool = False
     # JAX persistent compilation cache dir ("" disables): restarts re-load
@@ -159,6 +167,16 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                                 defaults.pipeline_chunk_items),
                    help="max pods per pipelined solve chunk at L0 "
                         "(0 disables chunking)")
+    p.add_argument("--pipeline-adaptive",
+                   action=argparse.BooleanOptionalAction,
+                   default=_env("pipeline-adaptive",
+                                defaults.pipeline_adaptive),
+                   help="adapt pipeline depth 1-3 to measured overlap "
+                        "(pipeline-depth is the starting point)")
+    p.add_argument("--solver-donate", action=argparse.BooleanOptionalAction,
+                   default=_env("solver-donate", defaults.solver_donate),
+                   help="device buffer ring + donation: steady-state solve "
+                        "chunks reuse device memory in place")
     p.add_argument("--solver-warmup", action=argparse.BooleanOptionalAction,
                    default=_env("solver-warmup", defaults.solver_warmup),
                    help="pre-compile the solver bucket ladder at boot on a "

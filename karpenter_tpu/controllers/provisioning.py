@@ -93,6 +93,10 @@ class ProvisionerWorker:
         self.solver_config = solver_config or SolverConfig()
         self.batcher = batcher or Batcher()
         self.pipeline_config = pipeline_config or PipelineConfig()
+        # ONE pipeline for the worker's lifetime: the adaptive-depth state
+        # machine learns across provisioning windows, and the device ring
+        # buffers it drives stay warm between windows (solver/pipeline.py)
+        self.pipeline = SolvePipeline(self.pipeline_config)
         self.scheduler = Scheduler(kube)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -177,8 +181,8 @@ class ProvisionerWorker:
             # while the next chunk's solve is already in flight; at L1+ the
             # effective depth collapses to 1 and this degenerates to the
             # serial chunk loop
-            pipeline = SolvePipeline(self.pipeline_config, monitor=monitor)
-            results = pipeline.run(
+            self.pipeline.set_monitor(monitor)
+            results = self.pipeline.run(
                 chunks, prepare=self._prepare_chunk,
                 dispatch=self._dispatch_chunk,
                 consume=self._complete_chunk,

@@ -81,15 +81,18 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
     from karpenter_tpu.solver.pipeline import PipelineConfig
 
     solver_warmup.configure_compilation_cache(options.solver_compile_cache_dir)
-    solver_config = SolverConfig(use_device=options.solver_use_device)
+    solver_config = SolverConfig(use_device=options.solver_use_device,
+                                 device_donate=options.solver_donate)
     if options.solver_warmup:
-        solver_warmup.start_warmup(solver_config)
+        solver_warmup.start_warmup(solver_config,
+                                   include_ring=options.solver_donate)
     provisioning = ProvisioningController(
         kube, cloud_provider,
         solver_config=solver_config,
         pipeline_config=PipelineConfig(
             depth=options.pipeline_depth,
-            chunk_items=options.pipeline_chunk_items),
+            chunk_items=options.pipeline_chunk_items,
+            adaptive=options.pipeline_adaptive),
         batcher_factory=lambda: Batcher(
             idle_seconds=options.batch_idle_seconds,
             max_seconds=options.batch_max_seconds,

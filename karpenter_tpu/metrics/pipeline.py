@@ -20,6 +20,24 @@ Four series, all on the process-wide registry (exposed with the
 - ``karpenter_pipeline_dispatch_wait_seconds`` histogram — per-chunk wait
   between dispatch completing and the fetch starting (queueing delay a
   handle experiences inside the pipeline's bounded window)
+
+Round-8 additions (device ring + adaptive depth):
+
+- ``karpenter_solver_device_bytes_in_use``     gauge — live device memory
+  summed over the mesh, from ``device.memory_stats()`` where the backend
+  implements it, else the client's live-buffer sizes (parallel/mesh.py
+  device_bytes_in_use). Best-effort: 0 where neither source exists.
+- ``karpenter_pipeline_ring_allocations_total`` counter — fresh device
+  buffer allocations made by the ring (slot creation, bucket change,
+  compaction re-bucket). FLAT in steady state — the zero-allocation
+  acceptance gate reads this.
+- ``karpenter_pipeline_ring_refills_total``    counter — in-place
+  donation-aliased refills of existing ring buffers (the steady-state
+  path: same device memory, new chunk data).
+
+``pipeline_depth`` now reports the ADAPTIVE effective depth: the
+per-window overlap measurement steps it 1↔2↔3 (solver/pipeline.py
+_AdaptiveDepth), and pressure L1+ still collapses it to 1.
 """
 
 from __future__ import annotations
@@ -42,3 +60,15 @@ PIPELINE_DISPATCH_WAIT_SECONDS = DEFAULT.histogram(
     "pipeline_dispatch_wait_seconds",
     "Seconds between a chunk's async dispatch completing and its fetch "
     "starting inside the pipeline window")
+SOLVER_DEVICE_BYTES_IN_USE = DEFAULT.gauge(
+    "solver_device_bytes_in_use",
+    "Live device memory across the solver mesh in bytes "
+    "(memory_stats where available, else live-buffer sizes; best-effort)")
+PIPELINE_RING_ALLOCATIONS_TOTAL = DEFAULT.counter(
+    "pipeline_ring_allocations_total",
+    "Fresh device buffer allocations by the solver ring (slot creation / "
+    "bucket change); flat in steady state")
+PIPELINE_RING_REFILLS_TOTAL = DEFAULT.counter(
+    "pipeline_ring_refills_total",
+    "In-place donation-aliased refills of existing ring buffers "
+    "(steady-state chunk intake: zero fresh device allocation)")

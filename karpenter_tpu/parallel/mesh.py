@@ -1,4 +1,4 @@
-"""Device mesh construction for the sharded solver.
+"""Device mesh construction for the sharded solver — the ONE mesh authority.
 
 Axes:
 - "batch": independent packing problems (schedules). The provisioning plane
@@ -8,29 +8,87 @@ Axes:
   goroutines, provisioner.go:53-60 — but data-parallel on ICI instead of
   host threads).
 
+Every sharded entry point (parallel/sharded_pack.py, parallel/type_sharded.py,
+solver/batch_solve.py) derives its ``NamedSharding``s from here, so the
+explicit-sharding ``pjit`` calls and the device ring (solver/pipeline.py)
+agree on placement — buffer donation only aliases when the donated input and
+the matching output carry the SAME sharding, which a second ad-hoc mesh
+would silently break.
+
 Multi-host: jax initializes the global device set; the same mesh spec spans
 slices (DCN between hosts is handled by XLA's collectives). Nothing here is
-TPU-count-specific — tests use a virtual 8-device CPU mesh.
+TPU-count-specific — tests use a virtual 8-device CPU mesh and the bench
+forces N virtual CPU devices via --xla_force_host_platform_device_count.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+_LOCK = threading.Lock()
+_CACHED: Optional[Mesh] = None
+
 
 def solver_mesh(devices: Optional[Sequence] = None) -> Mesh:
-    devs = list(devices) if devices is not None else jax.devices()
+    """The process-wide solver mesh over the global device set (cached —
+    ``Mesh`` equality is by device array, and the jit caches key on it, so
+    handing out one object keeps every compiled entry shared). Passing an
+    explicit ``devices`` sequence bypasses the cache (tests build sub-meshes)."""
+    global _CACHED
     import numpy as np
 
-    return Mesh(np.array(devs), axis_names=("batch",))
+    if devices is not None:
+        return Mesh(np.array(list(devices)), axis_names=("batch",))
+    with _LOCK:
+        if _CACHED is None:
+            _CACHED = Mesh(np.array(jax.devices()), axis_names=("batch",))
+        return _CACHED
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P("batch"))
+def device_count() -> int:
+    return solver_mesh().devices.size
 
 
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
+def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Leading axis sharded over "batch" — the placement of every per-problem
+    tensor in the batched solve AND of the ring slots that cycle through the
+    donated kernel (they must match for the alias to hold)."""
+    return NamedSharding(mesh if mesh is not None else solver_mesh(),
+                         P("batch"))
+
+
+def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh if mesh is not None else solver_mesh(), P())
+
+
+def device_bytes_in_use(devices: Optional[Sequence] = None) -> dict:
+    """Live device memory by device id: ``memory_stats()['bytes_in_use']``
+    where the backend implements it (TPU), else the sum of live buffer sizes
+    from the client (CPU test meshes report None for memory_stats). Returns
+    {} when neither source is available — callers must treat the gauge as
+    best-effort, never gate on it."""
+    devs = list(devices) if devices is not None else jax.devices()
+    out: dict = {}
+    for d in devs:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats and "bytes_in_use" in stats:
+            out[d.id] = int(stats["bytes_in_use"])
+    if out:
+        return out
+    try:
+        by_dev: dict = {}
+        for buf in devs[0].client.live_buffers():
+            dev = buf.device() if callable(getattr(buf, "device", None)) \
+                else getattr(buf, "device", None)
+            did = getattr(dev, "id", 0)
+            by_dev[did] = by_dev.get(did, 0) + buf.size * buf.dtype.itemsize
+        return {d.id: by_dev.get(d.id, 0) for d in devs}
+    except Exception:
+        return {}

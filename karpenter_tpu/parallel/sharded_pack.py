@@ -7,6 +7,24 @@ at once: ``vmap`` over problems within a device, ``shard_map`` over the
 "batch" mesh axis across devices. No collectives are needed in the solve
 itself (problems are independent); results are gathered by the host.
 
+Dispatch is explicit-sharding ``pjit``: every entry point is built by a
+cached factory that closes over ``in_shardings``/``out_shardings`` derived
+from the ONE mesh authority (parallel/mesh.py). Two variants share the same
+traced body:
+
+- :func:`pack_batch_sharded_flat` — the plain call (warmup, tests, solo
+  fallbacks, hedged re-dispatch): inputs survive the call.
+- :func:`pack_batch_sharded_ring` — the hot-loop call with
+  ``donate_argnums`` on the mutable (B, S) counts/dropped buffers. It
+  returns ``(flat, counts_next, dropped_next)`` where ``counts_next`` is
+  the post-chunk residual (the next resume's input) and ``dropped_next``
+  is a zeroed buffer — both shape/dtype/sharding-matched to the donated
+  inputs, so XLA writes them INTO the donated device memory instead of
+  allocating. Chunk-resume loops therefore ship zero bytes host→device
+  in steady state (solver/batch_solve.py), and the donated jax Arrays are
+  deleted — a stale read raises instead of returning garbage
+  (tests/test_pipeline.py use-after-donate guard).
+
 This is the framework's multi-chip scaling story (SURVEY.md §5.7): the
 solve dimension that grows with cluster size is the number of concurrent
 schedules × shapes, and it rides ICI by sharding the batch axis.
@@ -22,6 +40,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from karpenter_tpu.ops.pack import pack_chunk, pack_chunk_flat, unpack_flat
 from karpenter_tpu.parallel.compat import shard_map
+from karpenter_tpu.parallel.mesh import batch_sharding
 
 
 def _pack_one_problem(shapes, counts, dropped, totals, reserved0, valid,
@@ -61,31 +80,13 @@ def pack_batch_sharded(
     )(shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("num_iters", "mesh", "kernel", "interpret",
-                                    "cost_tiebreak"))
-def pack_batch_sharded_flat(
-    shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
-    *,
-    num_iters: int,
-    mesh: Mesh,
-    kernel: str = "xla",
-    interpret: bool = False,
-    prices=None,               # (B, T) int32 micro-$/h per problem
-    cost_tiebreak: bool = False,
-):
-    """pack_batch_sharded with the six per-problem outputs flattened into ONE
-    (B, 2S+1+2L+L·S) int32 buffer. The TPU sits behind a tunnel whose
-    round-trip latency (~tens of ms) dwarfs the kernel compute (~ms), so a
-    batch solve must cost exactly one device→host fetch — six separately
-    awaited outputs would each pay a full RTT. Each row is exactly one
-    ops.pack.pack_chunk_flat buffer (the layout lives only there).
-    ``kernel`` selects the per-problem executor ("xla" scan or the fused
-    "pallas" kernel, models/ffd.default_kernel semantics);
-    ``cost_tiebreak`` applies each problem's price row in-kernel
-    (ops.pack.pack_chunk semantics), either executor."""
-    if prices is None:
-        prices = jnp.zeros(valid.shape, jnp.int32)
+def _sharded_flat_body(mesh: Mesh, num_iters: int, kernel: str,
+                       interpret: bool, cost_tiebreak: bool):
+    """The vmapped + shard_mapped per-problem kernel, shared by the plain
+    and the donating entry. ``kernel`` selects the per-problem executor
+    ("xla" scan or the fused "pallas" kernel, models/ffd.default_kernel
+    semantics); ``cost_tiebreak`` applies each problem's price row
+    in-kernel (ops.pack.pack_chunk semantics), either executor."""
     if kernel == "pallas":
         from karpenter_tpu.ops.pack_pallas import pack_chunk_pallas_flat
 
@@ -115,8 +116,108 @@ def pack_batch_sharded_flat(
         in_specs=(spec,) * 9,
         out_specs=spec,
         check_vma=False,
-    )(shapes, counts, dropped, totals, reserved0, valid, last_valid,
-      pods_unit, prices)
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _flat_jit(mesh: Mesh, num_iters: int, kernel: str, interpret: bool,
+              cost_tiebreak: bool):
+    """Explicit-sharding pjit of the flat batch solve (no donation)."""
+    body = _sharded_flat_body(mesh, num_iters, kernel, interpret,
+                              cost_tiebreak)
+    bs = batch_sharding(mesh)
+    return jax.jit(body, in_shardings=(bs,) * 9, out_shardings=bs)
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_jit(mesh: Mesh, num_iters: int, kernel: str, interpret: bool,
+              cost_tiebreak: bool):
+    """Explicit-sharding pjit of the flat batch solve with the mutable
+    (B, S) buffers DONATED. Donation only aliases under explicit shardings
+    (plain-jit donation is a silent no-op on the host platforms the tests
+    and bench run on), which is why this entry exists separately instead of
+    a flag on the plain one."""
+    body = _sharded_flat_body(mesh, num_iters, kernel, interpret,
+                              cost_tiebreak)
+    bs = batch_sharding(mesh)
+
+    def ring_body(shapes, counts, dropped, totals, reserved0, valid,
+                  last_valid, pods_unit, prices):
+        flat = body(shapes, counts, dropped, totals, reserved0, valid,
+                    last_valid, pods_unit, prices)
+        S = counts.shape[1]
+        # the flat row layout (ops/pack.py flatten_chunk_outputs) leads with
+        # the residual counts: the slice IS the next resume's counts input.
+        # dropped restarts at zero every chunk (the host accumulates the
+        # per-chunk deltas from `flat` itself) — both outputs match the
+        # donated inputs by (shape, dtype, sharding), so XLA reuses the
+        # donated buffers in place.
+        counts_next = flat[:, :S]
+        dropped_next = jnp.zeros_like(dropped)
+        return flat, counts_next, dropped_next
+
+    return jax.jit(ring_body, in_shardings=(bs,) * 9,
+                   out_shardings=(bs, bs, bs), donate_argnums=(1, 2))
+
+
+def _with_prices(valid, prices):
+    return jnp.zeros(valid.shape, jnp.int32) if prices is None else prices
+
+
+def pack_batch_sharded_flat(
+    shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
+    *,
+    num_iters: int,
+    mesh: Mesh,
+    kernel: str = "xla",
+    interpret: bool = False,
+    prices=None,               # (B, T) int32 micro-$/h per problem
+    cost_tiebreak: bool = False,
+):
+    """pack_batch_sharded with the six per-problem outputs flattened into ONE
+    (B, 2S+1+2L+L·S) int32 buffer. The TPU sits behind a tunnel whose
+    round-trip latency (~tens of ms) dwarfs the kernel compute (~ms), so a
+    batch solve must cost exactly one device→host fetch — six separately
+    awaited outputs would each pay a full RTT. Each row is exactly one
+    ops.pack.pack_chunk_flat buffer (the layout lives only there)."""
+    fn = _flat_jit(mesh, num_iters, kernel, interpret, cost_tiebreak)
+    return fn(shapes, counts, dropped, totals, reserved0, valid, last_valid,
+              pods_unit, _with_prices(valid, prices))
+
+
+def pack_batch_sharded_ring(
+    shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
+    *,
+    num_iters: int,
+    mesh: Mesh,
+    kernel: str = "xla",
+    interpret: bool = False,
+    prices=None,
+    cost_tiebreak: bool = False,
+):
+    """Donating variant of :func:`pack_batch_sharded_flat` for the device
+    ring: returns ``(flat, counts_next, dropped_next)`` and CONSUMES the
+    ``counts``/``dropped`` arrays (deleted after dispatch — keep host
+    mirrors for any retry path). ``flat`` is identical to the plain call's
+    output; the extra outputs are device-resident and already positioned as
+    the next chunk-resume's inputs, closing the zero-transfer donation
+    chain."""
+    fn = _ring_jit(mesh, num_iters, kernel, interpret, cost_tiebreak)
+    return fn(shapes, counts, dropped, totals, reserved0, valid, last_valid,
+              pods_unit, _with_prices(valid, prices))
+
+
+def _clear_sharded_caches():
+    """Drop the memoized pjit executables so the per-problem kernel is
+    re-traced (tests monkeypatch the kernel body and need the trace to see
+    the patched function; the old directly-jitted entry exposed the same
+    hook as `.clear_cache()`)."""
+    _flat_jit.cache_clear()
+    _ring_jit.cache_clear()
+
+
+pack_batch_sharded_flat.clear_cache = _clear_sharded_caches
+pack_batch_sharded_ring.clear_cache = _clear_sharded_caches
 
 
 def unpack_batch_flat(buf, S: int, L: int):

@@ -227,8 +227,39 @@ def _local_pack(shapes, counts, dropped, totals_l, reserved0_l, valid_l,
                                  chosen_seq, q_seq, packed_seq)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("num_iters", "mesh", "cost_tiebreak"))
+@functools.lru_cache(maxsize=64)
+def _type_sharded_jit(mesh: Mesh, num_iters: int, cost_tiebreak: bool):
+    """Explicit-sharding pjit of the type-SPMD solve: the type-axis tensors
+    arrive pre-placed as shards of the mesh (``NamedSharding(mesh,
+    P("types"))``), everything else replicated, output replicated — one
+    fetch. Derived here (from the one mesh handed in) rather than inferred,
+    so a caller's committed arrays can never silently force a gather."""
+    from jax.sharding import NamedSharding
+
+    body = functools.partial(_local_pack, num_iters=num_iters,
+                             cost_tiebreak=cost_tiebreak)
+    spec_t = P(AXIS)
+    rep = P()
+    # check_vma=False: the early-terminating inner while_loop's trip count
+    # is device-varying by design (each shard exits once ITS types are all
+    # stopped), which the static replication checker cannot prove safe;
+    # every cross-device value still flows through an explicit collective,
+    # and the record-stream parity suite (tests/test_type_sharded.py) pins
+    # the replicated outputs bit-for-bit against the single-device kernel.
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(rep, rep, rep, spec_t, spec_t, spec_t, spec_t, rep, rep),
+        out_specs=rep,
+        check_vma=False,
+    )
+    sh_t = NamedSharding(mesh, spec_t)
+    sh_r = NamedSharding(mesh, rep)
+    return jax.jit(
+        mapped,
+        in_shardings=(sh_r, sh_r, sh_r, sh_t, sh_t, sh_t, sh_t, sh_r, sh_r),
+        out_shardings=sh_r)
+
+
 def pack_chunk_type_sharded(
     shapes, counts, dropped, totals, reserved0, valid, last_valid, pods_unit,
     *,
@@ -241,26 +272,15 @@ def pack_chunk_type_sharded(
     same flat buffer as pack_chunk_flat (replicated — one fetch). T must be
     a multiple of the mesh size (the TYPE_BUCKETS are powers of two, so any
     power-of-two mesh divides them). ``cost_tiebreak`` matches
-    ops.pack.pack_chunk: cheapest max-pods type wins (one extra pmin)."""
+    ops.pack.pack_chunk: cheapest max-pods type wins (one extra pmin).
+    Nothing here is donated: every type-axis tensor is a chunk invariant
+    reused by the resume loop, and the replicated flat output matches no
+    input — donating would only raise "unusable donation" noise."""
     T = totals.shape[0]
     n = mesh.devices.size
     assert T % n == 0, f"type axis {T} not divisible by mesh size {n}"
     if prices is None:
         prices = jnp.zeros((T,), jnp.int32)
-    body = functools.partial(_local_pack, num_iters=num_iters,
-                             cost_tiebreak=cost_tiebreak)
-    spec_t = P(AXIS)
-    rep = P()
-    # check_vma=False: the early-terminating inner while_loop's trip count
-    # is device-varying by design (each shard exits once ITS types are all
-    # stopped), which the static replication checker cannot prove safe;
-    # every cross-device value still flows through an explicit collective,
-    # and the record-stream parity suite (tests/test_type_sharded.py) pins
-    # the replicated outputs bit-for-bit against the single-device kernel.
-    return shard_map(
-        body, mesh=mesh,
-        in_specs=(rep, rep, rep, spec_t, spec_t, spec_t, spec_t, rep, rep),
-        out_specs=rep,
-        check_vma=False,
-    )(shapes, counts, dropped, totals, reserved0, valid, prices,
-      last_valid, pods_unit)
+    fn = _type_sharded_jit(mesh, num_iters, cost_tiebreak)
+    return fn(shapes, counts, dropped, totals, reserved0, valid, prices,
+              last_valid, pods_unit)

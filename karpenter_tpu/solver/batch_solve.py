@@ -225,6 +225,8 @@ class BatchHandle:
                 log.exception(
                     "batched device solve failed; falling back per problem")
                 host_results = None
+            finally:
+                run.close()  # ring slot back to the pool (buffers stay warm)
             if host_results is not None:
                 solve_module.record_executor("device-batch",
                                              count=len(self._batch_idx))
@@ -259,12 +261,17 @@ def _finish_device_batch(run: "_DeviceBatchRun"):
 class _DeviceBatchRun:
     """Device-side state of one in-flight batched solve.
 
-    One (or rarely more) pack_batch_sharded_flat call(s) solving all
+    One (or rarely more) pack_batch_sharded call(s) solving all
     encoded problems; chunk-resumes any problem that outlives num_iters.
     Invariant tensors ship host→device ONCE (``__init__``, which also
     async-launches the first chunk — JAX returns a device future without
     blocking; trace/compile errors still surface synchronously and retry on
-    the XLA kernel); resumes send only the small counts/dropped rows.
+    the XLA kernel). With ``config.device_donate`` (default) the run rides
+    a ring slot (solver/pipeline.py DeviceRing): invariants refill the
+    previous chunk's device buffers in place, the mutable counts/dropped
+    rows chain through ``donate_argnums`` across resumes, and a resume
+    ships ZERO bytes host→device; without it, resumes send the small
+    counts/dropped rows.
     ``prices_list`` carries each problem's per-packable effective $/h (or
     None); rows without prices get all-INT32_MAX price vectors, which
     degrade the in-kernel tie-break to Go's first-smallest — exactly what
@@ -274,9 +281,9 @@ class _DeviceBatchRun:
                  config: SolverConfig):
         import jax
 
-        from karpenter_tpu.parallel.mesh import solver_mesh
+        from karpenter_tpu.parallel.mesh import batch_sharding, solver_mesh
         from karpenter_tpu.parallel.sharded_pack import (
-            pack_batch_sharded_flat, pad_problems,
+            pack_batch_sharded_flat, pack_batch_sharded_ring, pad_problems,
         )
 
         self.encs = encs
@@ -284,7 +291,9 @@ class _DeviceBatchRun:
         self.config = config
         self._jax = jax
         self._pack = pack_batch_sharded_flat
+        self._pack_ring = pack_batch_sharded_ring
         self.mesh = solver_mesh()
+        self._bs = batch_sharding(self.mesh)
         self.on_tpu = jax.default_backend() == "tpu"
         kernel = config.device_kernel or default_kernel()
         if kernel == "type-spmd":
@@ -319,37 +328,131 @@ class _DeviceBatchRun:
         self.kernel = kernel
         self.use_cost = config.cost_tiebreak and any(
             p is not None for p in prices_list)
-        prices_arr = None
+        T = totals.shape[1]
         if self.use_cost:
-            T = totals.shape[1]
             prices_arr = np.full((shapes.shape[0], T),
                                  np.iinfo(np.int32).max, np.int32)
             for b, pr in enumerate(prices_list):
                 if pr is not None:
                     prices_arr[b] = encode_prices(pr, T)
+        else:
+            # an explicit zero row per problem (the kernel's "unpriced"
+            # sentinel) so the price buffer joins the ring/one-shot
+            # transfer instead of being rebuilt per dispatch
+            prices_arr = np.zeros((shapes.shape[0], T), np.int32)
         # one transfer for the invariants (tunnel-latency bound,
-        # models/ffd.py)
+        # models/ffd.py) — or, with the device ring, an in-place refill of
+        # the previous chunk's buffers (zero fresh allocation, solver/
+        # pipeline.py DeviceRing)
         self.shapes_host = shapes  # original (B, S, R) — compaction gathers
-        (self.shapes_d, self.totals, self.reserved0, self.valid,
-         self.last_valid, self.pods_unit) = jax.device_put(
-            (shapes, totals, reserved0, valid, last_valid, pods_unit))
-        self.prices_arr = (jax.device_put(prices_arr)
-                           if prices_arr is not None else None)
-        self.counts_d, self.dropped_d = jax.device_put((counts, dropped))
-        self._pending = None
-        self._pending_lock = threading.Lock()
-        self.launch()
+        # host mirrors of the PRE-chunk mutable rows: the donating dispatch
+        # consumes the device copies, so every retry path (hedge second
+        # attempt, pallas→xla fallback) re-places these instead
+        self.counts_host = counts
+        self.dropped_host = dropped
+        self._ring = self._slot = None
+        if config.device_donate:
+            from karpenter_tpu.solver.pipeline import DeviceRing, get_ring
+
+            self._ring = get_ring()
+            host = {"shapes": shapes, "counts": counts, "dropped": dropped,
+                    "totals": totals, "reserved0": reserved0, "valid": valid,
+                    "last_valid": last_valid, "pods_unit": pods_unit,
+                    "prices": prices_arr}
+            self._slot = self._ring.acquire(DeviceRing.signature(host))
+        try:
+            if self._slot is not None:
+                put = lambda name, arr: self._ring.fill(  # noqa: E731
+                    self._slot, name, arr, self._bs)
+                self.shapes_d = put("shapes", shapes)
+                self.totals = put("totals", totals)
+                self.reserved0 = put("reserved0", reserved0)
+                self.valid = put("valid", valid)
+                self.last_valid = put("last_valid", last_valid)
+                self.pods_unit = put("pods_unit", pods_unit)
+                self.prices_arr = put("prices", prices_arr)
+                self.counts_d = put("counts", counts)
+                self.dropped_d = put("dropped", dropped)
+            else:
+                (self.shapes_d, self.totals, self.reserved0, self.valid,
+                 self.last_valid, self.pods_unit) = jax.device_put(
+                    (shapes, totals, reserved0, valid, last_valid, pods_unit))
+                self.prices_arr = jax.device_put(prices_arr)
+                self.counts_d, self.dropped_d = jax.device_put(
+                    (counts, dropped))
+            self._pending = None
+            self._pending_lock = threading.Lock()
+            self.launch()
+        except BaseException:
+            self.close()
+            raise
+
+    def close(self) -> None:
+        """Release the ring slot (idempotent). The buffers stay device-
+        resident in the slot for the next chunk to refill in place."""
+        slot, self._slot = self._slot, None
+        if slot is not None and self._ring is not None:
+            self._ring.release(slot)
 
     # -- dispatch side -------------------------------------------------------
     def _dispatch_chunk(self):
         """Async-dispatch one chunk against the current tensors; returns the
-        un-materialized device buffer."""
+        un-materialized device buffer.
+
+        Ring mode runs the DONATING pjit: the mutable (B, S) counts/dropped
+        device rows are consumed (a stale read raises "Array has been
+        deleted" — never garbage) and the returned ``counts_next``/
+        ``dropped_next`` alias their memory, pre-positioned as the next
+        chunk-resume's inputs. They are handed back to the ring slot so the
+        buffers outlive this run."""
+        if self._slot is not None:
+            flat, counts_next, dropped_next = self._pack_ring(
+                self.shapes_d, self.counts_d, self.dropped_d, self.totals,
+                self.reserved0, self.valid, self.last_valid, self.pods_unit,
+                num_iters=self.L, mesh=self.mesh, kernel=self.kernel,
+                interpret=self.kernel == "pallas" and not self.on_tpu,
+                prices=self.prices_arr, cost_tiebreak=self.use_cost)
+            self.counts_d, self.dropped_d = counts_next, dropped_next
+            self._ring.hand_back(self._slot, counts=counts_next,
+                                 dropped=dropped_next)
+            return flat
         return self._pack(
             self.shapes_d, self.counts_d, self.dropped_d, self.totals,
             self.reserved0, self.valid, self.last_valid, self.pods_unit,
             num_iters=self.L, mesh=self.mesh, kernel=self.kernel,
             interpret=self.kernel == "pallas" and not self.on_tpu,
             prices=self.prices_arr, cost_tiebreak=self.use_cost)
+
+    def _redispatch_chunk(self):
+        """Re-run the IN-FLIGHT chunk (hedge second attempt, dropped-buffer
+        retry). In ring mode the device rows have already advanced past this
+        chunk (donating dispatch), so re-place the PRE-chunk host mirrors in
+        fresh temporaries and run the non-donating kernel — a counted
+        allocation on a tail event, never the steady state."""
+        if self._slot is None:
+            return self._dispatch_chunk()
+        self._ring.note_allocation(2)
+        counts_d, dropped_d = self._jax.device_put(
+            (self.counts_host, self.dropped_host), self._bs)
+        return self._pack(
+            self.shapes_d, counts_d, dropped_d, self.totals,
+            self.reserved0, self.valid, self.last_valid, self.pods_unit,
+            num_iters=self.L, mesh=self.mesh, kernel=self.kernel,
+            interpret=self.kernel == "pallas" and not self.on_tpu,
+            prices=self.prices_arr, cost_tiebreak=self.use_cost)
+
+    def _restore_mutable(self) -> None:
+        """Kernel-retry path: re-place the PRE-chunk counts/dropped rows
+        from the host mirrors (the failed donating dispatch consumed or
+        advanced the device copies)."""
+        if self._slot is not None:
+            self.counts_d = self._ring.fill(
+                self._slot, "counts", self.counts_host, self._bs)
+            self.dropped_d = self._ring.fill(
+                self._slot, "dropped", self.dropped_host, self._bs)
+        else:
+            self.counts_d, self.dropped_d = self._jax.device_put(
+                (self.counts_host, self.dropped_host))
 
     def launch(self) -> None:
         """Queue the next chunk without blocking; a no-op when a chunk is
@@ -365,6 +468,9 @@ class _DeviceBatchRun:
             log.exception(
                 "pallas batch kernel failed at dispatch; retrying with xla")
             self.kernel = "xla"
+            if self._slot is not None:
+                self._restore_mutable()  # the failed donating call may have
+                # consumed/advanced the device rows
             buf = self._dispatch_chunk()
         with self._pending_lock:
             self._pending = buf
@@ -387,7 +493,7 @@ class _DeviceBatchRun:
         def attempt():
             buf = self._take_pending()
             if buf is None:
-                buf = self._dispatch_chunk()
+                buf = self._redispatch_chunk()
             return np.asarray(buf)
 
         if not self.config.device_hedge:
@@ -429,6 +535,8 @@ class _DeviceBatchRun:
                 log.exception("pallas batch kernel failed; retrying with xla")
                 self.kernel = "xla"
                 self._take_pending()  # drop the failed pallas buffer
+                if self._slot is not None:
+                    self._restore_mutable()  # pre-chunk rows for the re-run
                 self.launch()
                 buf = self._fetch_chunk()
             counts_f, dropped_f, done, chosen, q, packed = unpack_batch_flat(
@@ -450,11 +558,35 @@ class _DeviceBatchRun:
                 perms, shapes_c, counts_c = compact_rows(
                     counts_f, perms, self.shapes_host, S_new)
                 S_cur = S_new
-                self.shapes_d, self.counts_d, self.dropped_d = jax.device_put(
-                    (shapes_c, counts_c, np.zeros_like(counts_c)))
+                zeros_c = np.zeros_like(counts_c)
+                self.counts_host, self.dropped_host = counts_c, zeros_c
+                if self._slot is not None:
+                    # the row shape changed: the donation chain restarts in
+                    # smaller buffers (fill() sees the mismatch and makes a
+                    # COUNTED fresh allocation — compaction is an event, not
+                    # the steady state the zero-alloc gate measures)
+                    self.shapes_d = self._ring.fill(
+                        self._slot, "shapes", shapes_c, self._bs)
+                    self.counts_d = self._ring.fill(
+                        self._slot, "counts", counts_c, self._bs)
+                    self.dropped_d = self._ring.fill(
+                        self._slot, "dropped", zeros_c, self._bs)
+                else:
+                    (self.shapes_d, self.counts_d,
+                     self.dropped_d) = jax.device_put(
+                        (shapes_c, counts_c, zeros_c))
             else:
-                self.counts_d, self.dropped_d = jax.device_put(
-                    (counts_f, np.zeros_like(counts_f)))
+                self.counts_host = counts_f
+                self.dropped_host = np.zeros_like(counts_f)
+                if self._slot is not None:
+                    # zero-transfer resume: counts_d/dropped_d ALREADY hold
+                    # the donated kernel's counts_next/dropped_next outputs,
+                    # aliased into the ring slot's device memory — nothing
+                    # ships host→device here
+                    pass
+                else:
+                    self.counts_d, self.dropped_d = jax.device_put(
+                        (counts_f, self.dropped_host))
         else:
             raise RuntimeError("batched solve did not converge")
 

@@ -1,4 +1,5 @@
-"""Bounded-depth pipelined executor for the provisioning hot loop.
+"""Bounded-depth pipelined executor + the device buffer ring for the
+provisioning hot loop.
 
 The serial hot loop stacks its costs end-to-end: marshal/encode chunk N,
 block on the device solve, launch + bulk-bind over the kube/EC2 wire while
@@ -27,18 +28,38 @@ up unfetched batches. Guarantees:
 - **Hedge**: a depth>1 window runs inside `hedge.pipeline_scope`, which
   self-disables the hedged fetcher (a duplicate dispatch would queue
   behind the in-flight batch — solver/hedge.py).
+
+Round 8 adds two pieces (docs/solver.md §12):
+
+- :class:`DeviceRing` — a process-wide pool of device-resident batch
+  tensors keyed by bucket signature. Steady-state chunks REFILL an
+  existing slot in place through a donation-aliased
+  ``dynamic_update_slice`` pjit (same device buffer, new bytes) instead of
+  allocating; only slot creation, bucket changes, and compaction
+  re-buckets allocate. ``allocations`` / ``refills`` counters make "zero
+  fresh device allocation in steady state" an assertable property, not a
+  bench anecdote.
+- :class:`_AdaptiveDepth` — per-window realized-overlap measurement
+  (`solver_overlap_seconds_total` delta vs window wall) stepping the
+  depth 1↔2↔3: depth that cannot pay (1-core hosts, tiny windows)
+  collapses to serial on its own, and a periodic probe window re-tries
+  depth 2 so real meshes climb back without operator action.
 """
 
 from __future__ import annotations
 
+import functools
 import logging
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from karpenter_tpu.metrics.pipeline import (
-    PIPELINE_DEPTH, PIPELINE_DISPATCH_WAIT_SECONDS, PIPELINE_STAGE_SECONDS,
+    PIPELINE_DEPTH, PIPELINE_DISPATCH_WAIT_SECONDS,
+    PIPELINE_RING_ALLOCATIONS_TOTAL, PIPELINE_RING_REFILLS_TOTAL,
+    PIPELINE_STAGE_SECONDS, SOLVER_DEVICE_BYTES_IN_USE,
     SOLVER_OVERLAP_SECONDS_TOTAL,
 )
 from karpenter_tpu.solver import hedge
@@ -46,29 +67,291 @@ from karpenter_tpu.solver import hedge
 log = logging.getLogger("karpenter.solver.pipeline")
 
 
+# --------------------------------------------------------------------------
+# Device buffer ring
+# --------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=16)
+def _refill_jit(sharding, ndim: int):
+    """Donating in-place refill: ``dst`` (the slot's existing device buffer)
+    is donated and the output aliases it — the host payload lands in the
+    SAME device memory. ``dynamic_update_slice`` rather than identity
+    because XLA forwards an identity/foldable output to the source buffer
+    and quietly drops the alias (probed on this backend); DUS forces a
+    write into the donated destination."""
+    import jax
+
+    def _refill(dst, src):
+        return jax.lax.dynamic_update_slice(dst, src, (0,) * ndim)
+
+    return jax.jit(_refill, in_shardings=(sharding, sharding),
+                   out_shardings=sharding, donate_argnums=(0,))
+
+
+class _RingSlot:
+    """One set of named device-resident batch tensors (one in-flight chunk's
+    working set). ``arrays`` is mutated by :meth:`DeviceRing.fill` (refill /
+    allocate) and :meth:`DeviceRing.hand_back` (donated kernel outputs
+    returned to slot ownership so the buffer survives the run)."""
+
+    __slots__ = ("sig", "arrays", "in_use", "last_used")
+
+    def __init__(self, sig):
+        self.sig = sig
+        self.arrays: Dict[str, object] = {}
+        self.in_use = False
+        self.last_used = 0.0
+
+
+class DeviceRing:
+    """Bounded pool of reusable device buffer sets for the batched solver.
+
+    Slots are keyed by signature — the tuple of (name, shape, dtype) of
+    every tensor in the working set — so a slot is only reused when every
+    buffer matches the incoming bucket exactly (donation aliasing requires
+    identical shape/dtype/sharding). ``max_slots`` bounds device memory:
+    pipeline depth d needs d+1 live slots (d in flight + 1 filling); the
+    least-recently-used free slot is evicted beyond the cap, releasing its
+    buffers to the backend allocator."""
+
+    def __init__(self, max_slots: int = 4):
+        self.max_slots = max(1, int(max_slots))
+        self._slots: List[_RingSlot] = []
+        self._lock = threading.Lock()
+        self.allocations = 0   # fresh device_puts (slot create/bucket change)
+        self.refills = 0       # in-place donation-aliased refills
+
+    @staticmethod
+    def signature(host_arrays: Dict[str, object]) -> Tuple:
+        import numpy as np
+
+        return tuple(sorted(
+            (name, tuple(np.shape(a)), str(np.asarray(a).dtype) if not
+             hasattr(a, "dtype") else str(a.dtype))
+            for name, a in host_arrays.items() if a is not None))
+
+    def acquire(self, sig) -> _RingSlot:
+        """A free slot with this signature, else a new empty one (whose
+        first fill allocates). Never blocks: concurrent in-flight chunks
+        each get their own slot — that IS the double buffer."""
+        with self._lock:
+            for slot in self._slots:
+                if not slot.in_use and slot.sig == sig:
+                    slot.in_use = True
+                    slot.last_used = time.monotonic()
+                    return slot
+            slot = _RingSlot(sig)
+            slot.in_use = True
+            slot.last_used = time.monotonic()
+            self._slots.append(slot)
+            self._evict_locked()
+            return slot
+
+    def release(self, slot: _RingSlot) -> None:
+        with self._lock:
+            slot.in_use = False
+            slot.last_used = time.monotonic()
+
+    def _evict_locked(self) -> None:
+        free = [s for s in self._slots if not s.in_use]
+        while len(self._slots) > self.max_slots and free:
+            victim = min(free, key=lambda s: s.last_used)
+            free.remove(victim)
+            self._slots.remove(victim)
+            victim.arrays.clear()  # drop the device references
+
+    def fill(self, slot: _RingSlot, name: str, host_array, sharding):
+        """Place ``host_array`` on device as ``name`` in this slot: an
+        in-place donated refill when a matching live buffer exists (zero
+        fresh allocation), else a counted fresh ``device_put``."""
+        import jax
+        import numpy as np
+
+        old = slot.arrays.get(name)
+        reusable = (
+            old is not None
+            and not getattr(old, "is_deleted", lambda: False)()
+            and tuple(old.shape) == tuple(np.shape(host_array))
+            and str(old.dtype) == str(np.asarray(host_array).dtype)
+            and old.sharding == sharding
+        )
+        if reusable:
+            new = _refill_jit(sharding, old.ndim)(old, host_array)
+            self.refills += 1
+            PIPELINE_RING_REFILLS_TOTAL.inc()
+        else:
+            new = jax.device_put(host_array, sharding)
+            self.allocations += 1
+            PIPELINE_RING_ALLOCATIONS_TOTAL.inc()
+        slot.arrays[name] = new
+        return new
+
+    def hand_back(self, slot: _RingSlot, **arrays) -> None:
+        """Return donated-kernel OUTPUTS (which alias the slot's buffers) to
+        slot ownership, so releasing the run doesn't free the device memory
+        the next chunk will refill in place."""
+        slot.arrays.update(arrays)
+
+    def note_allocation(self, count: int = 1) -> None:
+        """Off-ring fresh device allocations that belong in the same ledger
+        (compaction re-buckets, hedge re-dispatch mirrors)."""
+        self.allocations += count
+        PIPELINE_RING_ALLOCATIONS_TOTAL.inc(amount=float(count))
+
+    def counters(self) -> Dict[str, int]:
+        return {"allocations": self.allocations, "refills": self.refills,
+                "slots": len(self._slots)}
+
+
+_RING: Optional[DeviceRing] = None
+_RING_LOCK = threading.Lock()
+
+
+def get_ring() -> DeviceRing:
+    """The process-wide ring (device memory is a process-wide resource —
+    every worker and the warmup prebuild share it, exactly like the device)."""
+    global _RING
+    with _RING_LOCK:
+        if _RING is None:
+            _RING = DeviceRing()
+        return _RING
+
+
+def reset_ring() -> None:
+    """Drop the process ring (tests; a fresh ring re-counts from zero)."""
+    global _RING
+    with _RING_LOCK:
+        _RING = None
+
+
+def observe_device_bytes() -> int:
+    """Refresh the ``solver_device_bytes_in_use`` gauge; returns the total
+    (0 when the backend exposes nothing — best-effort by contract)."""
+    try:
+        from karpenter_tpu.parallel.mesh import device_bytes_in_use
+
+        total = sum(device_bytes_in_use().values())
+    except Exception:
+        total = 0
+    SOLVER_DEVICE_BYTES_IN_USE.set(float(total))
+    return total
+
+
+# --------------------------------------------------------------------------
+# Adaptive depth
+# --------------------------------------------------------------------------
+
+class _AdaptiveDepth:
+    """Step the pipeline depth from measured overlap instead of a flag.
+
+    Per uncollapsed window the pipeline reports (wall, overlap) — overlap
+    being the seconds dispatched batches spent in flight while the host did
+    other pipeline work (the `solver_overlap_seconds_total` delta for the
+    window). The state is just the current target depth:
+
+    - at depth > 1: ``overlap/wall < pay_frac`` for ``collapse_after``
+      consecutive windows steps DOWN (the device answers faster than the
+      host can generate overlap — extra depth only adds latency);
+      ``overlap/wall >= raise_frac`` steps UP to ``max_depth`` (the device
+      is saturated behind host work — a deeper window may hide more).
+    - at depth 1 (by adaptation, not pressure): every ``probe_every``-th
+      window probes depth 2, so a host that gains a real mesh — or sheds
+      load — climbs back without operator action.
+
+    Pressure-collapsed windows are NOT observed: L1+ forces serial for
+    latency reasons and says nothing about whether overlap pays."""
+
+    def __init__(self, base_depth: int, max_depth: int = 3,
+                 pay_frac: float = 0.10, raise_frac: float = 0.35,
+                 collapse_after: int = 2, probe_every: int = 8):
+        self.depth = min(max(1, int(base_depth)), max(1, int(max_depth)))
+        self.max_depth = max(1, int(max_depth))
+        self.pay_frac = pay_frac
+        self.raise_frac = raise_frac
+        self.collapse_after = collapse_after
+        self.probe_every = probe_every
+        self._no_pay = 0
+        self._serial_windows = 0
+
+    def observe(self, wall_s: float, overlap_s: float,
+                depth_used: int) -> int:
+        if wall_s <= 1e-4:
+            return self.depth  # too small to signal anything
+        if depth_used <= 1:
+            self._serial_windows += 1
+            if self.depth <= 1 and self._serial_windows >= self.probe_every:
+                self._serial_windows = 0
+                self.depth = min(2, self.max_depth)
+                log.info("adaptive depth: probing depth %d", self.depth)
+            return self.depth
+        self._serial_windows = 0
+        frac = overlap_s / wall_s
+        if frac < self.pay_frac:
+            self._no_pay += 1
+            if self._no_pay >= self.collapse_after:
+                self._no_pay = 0
+                self.depth = max(1, self.depth - 1)
+                log.info("adaptive depth: overlap %.1f%% of wall cannot pay; "
+                         "stepping down to %d", 100 * frac, self.depth)
+        else:
+            self._no_pay = 0
+            if frac >= self.raise_frac and self.depth < self.max_depth:
+                self.depth += 1
+                log.info("adaptive depth: overlap %.1f%% of wall; probing "
+                         "depth %d", 100 * frac, self.depth)
+        return self.depth
+
+
+# --------------------------------------------------------------------------
+# The pipelined executor
+# --------------------------------------------------------------------------
+
 @dataclass
 class PipelineConfig:
     """``depth`` bounds dispatched-but-unfetched chunks (1 = serial, 2 =
     double-buffered). ``chunk_items`` is the L0 chunk size the provisioning
     loop feeds the pipeline — applied at EVERY depth so depth 1 and depth 2
     see identical chunk boundaries and stay node-for-node comparable (the
-    L1+ pressure split, which is smaller or equal, takes precedence)."""
+    L1+ pressure split, which is smaller or equal, takes precedence).
+    ``adaptive`` makes ``depth`` the STARTING point of the measured-overlap
+    state machine (bounded by ``max_depth``); False pins it (the A/B bench
+    pins both legs)."""
 
     depth: int = 2
     chunk_items: int = 4096
+    adaptive: bool = True
+    max_depth: int = 3
 
 
 class SolvePipeline:
     """Drive ``prepare → dispatch → fetch → consume`` over ordered chunks
-    with at most ``depth`` handles in flight."""
+    with at most ``depth`` handles in flight. Hold ONE instance per worker:
+    the adaptive-depth state machine learns across provisioning windows,
+    and the ring buffers it reuses are only warm while the instance (and
+    the process ring) persists."""
 
     def __init__(self, config: Optional[PipelineConfig] = None, monitor=None):
         self.config = config or PipelineConfig()
         self._monitor = monitor
+        self._adaptive = (_AdaptiveDepth(self.config.depth,
+                                         self.config.max_depth)
+                          if self.config.adaptive else None)
+        self.last_window: Dict[str, float] = {}
+
+    def set_monitor(self, monitor) -> None:
+        """Per-window monitor rebind (the worker resolves it per batch)."""
+        self._monitor = monitor
+
+    def target_depth(self) -> int:
+        """The depth this pipeline is AIMING for (adaptive state if on,
+        else the configured flag) — before the pressure collapse."""
+        if self._adaptive is not None:
+            return self._adaptive.depth
+        return max(1, int(self.config.depth))
 
     def effective_depth(self) -> int:
-        """Configured depth, collapsed to 1 (serial) at pressure L1+."""
-        depth = max(1, int(self.config.depth))
+        """Target depth, collapsed to 1 (serial) at pressure L1+."""
+        depth = self.target_depth()
         if depth > 1 and self._monitor is not None \
                 and int(self._monitor.level()) >= 1:
             return 1
@@ -86,8 +369,27 @@ class SolvePipeline:
         worker for the binpacking histogram)."""
         depth = self.effective_depth()
         PIPELINE_DEPTH.set(float(depth))
-        with hedge.pipeline_scope(depth):
-            return self._run(chunks, prepare, dispatch, consume, on_chunk)
+        self._window_overlap = 0.0
+        self._window_max_depth = depth
+        t0 = time.perf_counter()
+        try:
+            with hedge.pipeline_scope(depth):
+                return self._run(chunks, prepare, dispatch, consume, on_chunk)
+        finally:
+            wall = time.perf_counter() - t0
+            self.last_window = {
+                "wall_s": wall, "overlap_s": self._window_overlap,
+                "depth": self._window_max_depth,
+            }
+            collapsed = self._monitor is not None \
+                and int(self._monitor.level()) >= 1
+            if self._adaptive is not None and not collapsed:
+                new_depth = self._adaptive.observe(
+                    wall, self._window_overlap, self._window_max_depth)
+                PIPELINE_DEPTH.set(float(
+                    new_depth if self._monitor is None
+                    or int(self._monitor.level()) < 1 else 1))
+            observe_device_bytes()
 
     def _run(self, chunks, prepare, dispatch, consume, on_chunk) -> List:
         inflight: deque = deque()  # FIFO of (prep, handle, t_disp, stats)
@@ -97,6 +399,7 @@ class SolvePipeline:
                 # re-read the ladder before every dispatch: a mid-window
                 # rise to L1+ must stop us running ahead immediately
                 depth = self.effective_depth()
+                self._window_max_depth = max(self._window_max_depth, depth)
                 PIPELINE_DEPTH.set(float(depth))
                 while len(inflight) >= depth:
                     self._complete(inflight.popleft(), consume, outs,
@@ -123,6 +426,8 @@ class SolvePipeline:
         stats["inflight_s"] = t0 - t_disp
         PIPELINE_DISPATCH_WAIT_SECONDS.observe(stats["inflight_s"])
         SOLVER_OVERLAP_SECONDS_TOTAL.inc(amount=stats["inflight_s"])
+        self._window_overlap = getattr(self, "_window_overlap", 0.0) \
+            + stats["inflight_s"]
         results = handle.fetch()
         t1 = time.perf_counter()
         out = consume(prep, results)

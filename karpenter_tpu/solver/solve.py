@@ -274,6 +274,13 @@ class SolverConfig:
     # tunnel-jitter p99 reduction at the cost of one duplicate dispatch on
     # tail events only. Self-disables for cold compiles and long solves.
     device_hedge: bool = True
+    # device-resident hot loop (solver/pipeline.py DeviceRing): batched
+    # dispatches acquire ring slots, refill them in place through the
+    # donation-aliased pjit, and chain the mutable counts/dropped buffers
+    # through donate_argnums across chunk resumes — steady-state chunks do
+    # zero fresh device allocation. False restores fresh device_puts per
+    # chunk (the differential suite pins ring == no-ring node-for-node).
+    device_donate: bool = True
     # auto-select the type-SPMD kernel (device_kernel=None) only when the
     # padded type bucket reaches this size AND the mesh has more than one
     # device: below it, the per-node collective round-trips cost more than

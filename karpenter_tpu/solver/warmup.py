@@ -16,6 +16,11 @@ config/options.py:
   ``compute_maxfit`` bound — with throwaway one-pod problems. The jit
   cache keys on (array shapes, static num_iters/cost_tiebreak), so a
   warmed bucket is a compile-free bucket no matter what real pods arrive.
+  It also PRE-BUILDS the device ring (``include_ring``): the donating
+  ``pack_batch_sharded_ring`` pjit and the in-place refill jit compile at
+  boot, and each warmed bucket leaves a slot's buffers device-resident —
+  the first real window refills them instead of allocating, so first-window
+  latency doesn't eat the donation win.
 
 The ladder defaults to the buckets real windows land in first (shapes ≤
 ``DEFAULT_WARM_MAX_SHAPES``, types ≤ ``DEFAULT_WARM_MAX_TYPES``) — the
@@ -104,11 +109,42 @@ def _resolve_kernel(config: SolverConfig, S: int) -> str:
     return kernel
 
 
+def _warm_ring(batch: dict, mesh, L: int, kernel: str, on_tpu: bool) -> int:
+    """Pre-build the device ring for this bucket: compile the donating pjit
+    AND the refill jit, and leave a slot's buffers device-resident — the
+    first real window at this bucket refills in place instead of paying
+    allocation + compile inside the serving path (solver/pipeline.py)."""
+    from karpenter_tpu.parallel.mesh import batch_sharding
+    from karpenter_tpu.parallel.sharded_pack import pack_batch_sharded_ring
+    from karpenter_tpu.solver.pipeline import DeviceRing, get_ring
+
+    B, T = batch["valid"].shape
+    host = dict(batch, prices=np.zeros((B, T), np.int32))
+    ring = get_ring()
+    slot = ring.acquire(DeviceRing.signature(host))
+    try:
+        bs = batch_sharding(mesh)
+        dev = {name: ring.fill(slot, name, arr, bs)
+               for name, arr in host.items()}
+        flat, counts_next, dropped_next = pack_batch_sharded_ring(
+            dev["shapes"], dev["counts"], dev["dropped"], dev["totals"],
+            dev["reserved0"], dev["valid"], dev["last_valid"],
+            dev["pods_unit"], num_iters=L, mesh=mesh, kernel=kernel,
+            interpret=kernel == "pallas" and not on_tpu,
+            prices=dev["prices"])
+        ring.hand_back(slot, counts=counts_next, dropped=dropped_next)
+        np.asarray(flat)
+        return 1
+    finally:
+        ring.release(slot)
+
+
 def warmup_pass(config: Optional[SolverConfig] = None,
                 shape_buckets: Optional[Sequence[int]] = None,
                 type_buckets: Optional[Sequence[int]] = None,
                 include_batch: bool = True,
-                include_solo: bool = True) -> int:
+                include_solo: bool = True,
+                include_ring: bool = True) -> int:
     """Compile the ladder synchronously; returns the number of (bucket
     pair × entry) compilations driven. Safe to call concurrently with
     serving — jit compilation is internally locked and a bucket warmed
@@ -161,19 +197,30 @@ def warmup_pass(config: Optional[SolverConfig] = None,
 
                     mesh = solver_mesh()
                     B = mesh.devices.size
+                    batch = dict(
+                        shapes=np.broadcast_to(
+                            shapes, (B,) + shapes.shape).copy(),
+                        counts=np.broadcast_to(
+                            counts, (B,) + counts.shape).copy(),
+                        dropped=np.broadcast_to(
+                            dropped, (B,) + dropped.shape).copy(),
+                        totals=np.broadcast_to(
+                            totals, (B,) + totals.shape).copy(),
+                        reserved0=np.broadcast_to(
+                            reserved0, (B,) + reserved0.shape).copy(),
+                        valid=np.broadcast_to(
+                            valid, (B,) + valid.shape).copy(),
+                        last_valid=np.zeros((B,), np.int32),
+                        pods_unit=np.ones((B,), np.int32))
                     buf = pack_batch_sharded_flat(
-                        np.broadcast_to(shapes, (B,) + shapes.shape).copy(),
-                        np.broadcast_to(counts, (B,) + counts.shape).copy(),
-                        np.broadcast_to(dropped, (B,) + dropped.shape).copy(),
-                        np.broadcast_to(totals, (B,) + totals.shape).copy(),
-                        np.broadcast_to(reserved0,
-                                        (B,) + reserved0.shape).copy(),
-                        np.broadcast_to(valid, (B,) + valid.shape).copy(),
-                        np.zeros((B,), np.int32), np.ones((B,), np.int32),
+                        *batch.values(),
                         num_iters=L, mesh=mesh, kernel=kernel,
                         interpret=kernel == "pallas" and not on_tpu)
                     np.asarray(buf)
                     compiled += 1
+                    if include_ring:
+                        compiled += _warm_ring(batch, mesh, L, kernel,
+                                               on_tpu)
             except Exception:
                 # a bucket that fails to warm is a bucket that compiles in
                 # the serving path instead — degraded, never fatal

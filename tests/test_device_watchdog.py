@@ -58,15 +58,19 @@ class TestWatchdog:
 
         def first():
             results["first"] = wd.run(
-                lambda: time.sleep(0.3) or "a", timeout_s=0.5, breaker_s=60.0)
+                lambda: time.sleep(0.9) or "a", timeout_s=2.0, breaker_s=60.0)
 
         t = threading.Thread(target=first)
         t.start()
         time.sleep(0.05)  # let the first call occupy the worker
-        # second call: 0.25s queue wait + 0.15s run > 0.3s deadline if
-        # measured from submit; must pass when measured from start
+        # second call: ~0.85s queue wait + 0.3s run > 1.0s deadline if
+        # measured from submit; must pass when measured from start. Margins
+        # are deliberately wide: the old 0.25s-wait + 0.15s-run vs 0.3s
+        # deadline left ZERO slack against the run-budget floor
+        # (max(t/2, t-wait) = 0.15s for a 0.15s sleep) and flaked on
+        # loaded 1-core CI hosts; this shape leaves 0.2s.
         results["second"] = wd.run(
-            lambda: time.sleep(0.15) or "b", timeout_s=0.3, breaker_s=60.0)
+            lambda: time.sleep(0.3) or "b", timeout_s=1.0, breaker_s=60.0)
         t.join()
         assert results == {"first": "a", "second": "b"}
         assert not wd.tripped()

@@ -57,7 +57,11 @@ def _overlap_value():
 
 
 def _run(depth=2, chunks=(1, 2, 3), monitor=None):
-    pipeline = SolvePipeline(PipelineConfig(depth=depth, chunk_items=0),
+    # adaptive pinned off: under a loaded host the controller may step the
+    # depth mid-run, and these tests assert the gauge for a FIXED depth
+    # (adaptive stepping has its own suite below)
+    pipeline = SolvePipeline(PipelineConfig(depth=depth, chunk_items=0,
+                                            adaptive=False),
                              monitor=monitor)
     return pipeline, pipeline.run(
         list(chunks),
@@ -100,6 +104,20 @@ class TestPipelineSeries:
 
     def test_series_appear_in_prometheus_exposition(self):
         _run(depth=2)
+        # counters only expose once incremented: drive one real ring fill
+        # (an allocation) and one refill so the round-8 series carry samples
+        import numpy as np
+
+        from karpenter_tpu.parallel.mesh import batch_sharding, solver_mesh
+        from karpenter_tpu.solver.pipeline import DeviceRing
+
+        mesh = solver_mesh()
+        ring = DeviceRing()
+        host = np.zeros((mesh.devices.size, 2), np.int32)
+        slot = ring.acquire(DeviceRing.signature({"x": host}))
+        bs = batch_sharding(mesh)
+        ring.fill(slot, "x", host, bs)
+        ring.fill(slot, "x", host, bs)
         exposed = DEFAULT.expose()
         assert "karpenter_pipeline_depth{}" in exposed
         for stage in ("marshal", "device", "launch_bind"):
@@ -107,7 +125,59 @@ class TestPipelineSeries:
                     in exposed), stage
         assert "karpenter_solver_overlap_seconds_total{}" in exposed
         assert "karpenter_pipeline_dispatch_wait_seconds_count{}" in exposed
+        # round-8 series: the ring's allocation ledger and the device
+        # memory gauge (refreshed at the end of every run)
+        assert "karpenter_solver_device_bytes_in_use{}" in exposed
+        assert "karpenter_pipeline_ring_allocations_total{}" in exposed
+        assert "karpenter_pipeline_ring_refills_total{}" in exposed
 
     def test_results_returned_in_chunk_order(self):
         _pipeline, outs = _run(depth=3, chunks=("a", "b", "c", "d"))
         assert outs == ["a", "b", "c", "d"]
+
+
+class TestDeviceBytesGauge:
+    def test_gauge_set_after_run(self):
+        from karpenter_tpu.metrics.pipeline import SOLVER_DEVICE_BYTES_IN_USE
+        from karpenter_tpu.solver.pipeline import observe_device_bytes
+
+        total = observe_device_bytes()
+        assert total >= 0
+        assert SOLVER_DEVICE_BYTES_IN_USE.collect()[()] == float(total)
+        # a run refreshes it too (the finally block), so the gauge is
+        # never stale after a provisioning window
+        _run(depth=2)
+        assert SOLVER_DEVICE_BYTES_IN_USE.collect()[()] >= 0.0
+
+
+class TestAdaptiveDepthGauge:
+    def test_depth_gauge_follows_adaptive_collapse(self):
+        """Windows whose overlap cannot pay (device answers instantly,
+        host consume dominates) must step the ADAPTIVE depth down and the
+        gauge must report the stepped value, not the configured flag."""
+        pipeline = SolvePipeline(
+            PipelineConfig(depth=2, chunk_items=0, adaptive=True))
+        for _ in range(3):
+            pipeline.run(
+                [0, 1, 2],
+                prepare=lambda c: c,
+                # all the wall lands in the LAST chunk's blocking fetch —
+                # nothing overlaps behind it, so overlap/wall < pay_frac
+                dispatch=lambda prep: FakeHandle(
+                    [prep], wall_s=0.05 if prep == 2 else 0.0),
+                consume=lambda prep, results: results[0])
+        assert pipeline.target_depth() == 1
+        assert PIPELINE_DEPTH.collect()[()] == 1.0
+
+    def test_pinned_config_never_steps(self):
+        pipeline = SolvePipeline(
+            PipelineConfig(depth=2, chunk_items=0, adaptive=False))
+        for _ in range(3):
+            pipeline.run(
+                [0, 1, 2],
+                prepare=lambda c: c,
+                dispatch=lambda prep: FakeHandle(
+                    [prep], wall_s=0.05 if prep == 2 else 0.0),
+                consume=lambda prep, results: results[0])
+        assert pipeline.target_depth() == 2
+        assert PIPELINE_DEPTH.collect()[()] == 2.0

@@ -30,7 +30,22 @@ class TestWarmupPass:
     def test_smallest_bucket_compiles_solo_and_batch(self):
         n = warmup.warmup_pass(SolverConfig(), shape_buckets=[8],
                                type_buckets=[8])
-        assert n == 2  # one solo entry + one batch entry
+        assert n == 3  # one solo entry + one batch entry + the ring prebuild
+
+    def test_ring_prebuild_leaves_warm_slot(self):
+        from karpenter_tpu.solver import pipeline as pl
+
+        pl.reset_ring()
+        warmup.warmup_pass(SolverConfig(), shape_buckets=[8],
+                           type_buckets=[8], include_solo=False)
+        c1 = pl.get_ring().counters()
+        assert c1["slots"] >= 1 and c1["allocations"] >= 1
+        # a second pass over the same bucket must REFILL, not allocate
+        warmup.warmup_pass(SolverConfig(), shape_buckets=[8],
+                           type_buckets=[8], include_solo=False)
+        c2 = pl.get_ring().counters()
+        assert c2["allocations"] == c1["allocations"]
+        assert c2["refills"] > c1["refills"]
 
     def test_failed_bucket_is_swallowed(self, monkeypatch):
         # force the synthetic builder to blow up: the pass must log and

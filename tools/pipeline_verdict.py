@@ -1,0 +1,78 @@
+"""Pipeline A/B verdict: one human-readable line from the bench JSON.
+
+`make bench-pipeline` pipes bench.py's stdout through this filter. The
+bench line passes through UNCHANGED on stdout (so `> BENCH_rNN.json`
+redirects still capture the pure JSON); the verdict goes to stderr:
+
+    pipeline A/B: 1.31x (depth 2 vs 1) devices=2 nodes_equal=True \
+fallbacks=none ring=0 allocs steady — PASS (>1.2x)
+
+PASS needs speedup > 1.2 at device_count >= 2 with nodes_equal and no
+pipeline-attributable executor fallbacks (the round-8 acceptance gate).
+On fewer devices (or a 1-core host) the line still prints, labelled
+with why the gate is not applicable.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+GATE_SPEEDUP = 1.2
+GATE_DEVICES = 2
+
+
+def verdict(line: dict) -> str:
+    extra = line.get("extra", {})
+    cfg = extra.get("config_7_control_plane_10k_pods", {})
+    if "error" in cfg or "pipeline_ab" not in cfg:
+        return ("pipeline A/B: no pipeline_ab in bench line "
+                f"({cfg.get('error', 'config_7 not run')}) — NO VERDICT")
+    ab = cfg["pipeline_ab"]
+    speedup = ab.get("speedup")
+    devices = ab.get("device_count") or extra.get("device_count")
+    nodes_equal = ab.get("nodes_equal")
+    # a pipeline-attributable fallback = executor counts that differ
+    # between the legs (e.g. host/native solves only in the pipelined one)
+    fallbacks = "none" if (ab.get("executors_pipelined")
+                           == ab.get("executors_serial")) else (
+        f"EXECUTOR DRIFT {ab.get('executors_pipelined')} "
+        f"vs {ab.get('executors_serial')}")
+    ring = ab.get("ring_pipelined", {})
+    ring_note = (f"ring={ring.get('allocations', '?')} allocs/"
+                 f"{ring.get('refills', '?')} refills")
+    head = (f"pipeline A/B: {speedup}x (depth {ab.get('depth_pipelined')} "
+            f"vs {ab.get('depth_serial')}) devices={devices} "
+            f"nodes_equal={nodes_equal} fallbacks={fallbacks} {ring_note}")
+    if devices is None or devices < GATE_DEVICES:
+        return (f"{head} — GATE N/A (needs device_count >= {GATE_DEVICES}; "
+                "rerun with --devices 2)")
+    ok = (speedup is not None and speedup > GATE_SPEEDUP
+          and nodes_equal and fallbacks == "none")
+    return f"{head} — {'PASS' if ok else 'FAIL'} (gate >{GATE_SPEEDUP}x)"
+
+
+def main() -> int:
+    last = None
+    for raw in sys.stdin:
+        sys.stdout.write(raw)  # pass-through: stdout stays the pure JSON
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            line = json.loads(raw)
+            if isinstance(line, dict) and "metric" in line:
+                last = line
+        except ValueError:
+            continue
+    sys.stdout.flush()
+    if last is None:
+        print("pipeline A/B: no bench JSON line on stdin — NO VERDICT",
+              file=sys.stderr)
+        return 1
+    print(verdict(last), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
