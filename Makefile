@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes bench-control bench-pipeline native dryrun lint chart chaos-soak chaos-overload clean help
+.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate native dryrun lint chart chaos-soak chaos-overload clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -26,6 +26,10 @@ DEVICES ?= 2  # virtual host devices for bench-pipeline (--xla_force_host_platfo
 bench-pipeline: ## Pipeline A/B at DEVICES virtual devices (DEVICES=N); prints verdict line on stderr
 	python bench.py --only config_7 --devices $(DEVICES) \
 		| python tools/pipeline_verdict.py
+
+bench-consolidate: ## Batched what-if consolidation window (config_5); prints verdict line on stderr
+	python bench.py --only config_5 \
+		| python tools/consolidate_verdict.py
 
 native: ## Build the C++ FFD kernel explicitly (normally built lazily)
 	g++ -O3 -std=c++17 -shared -fPIC \

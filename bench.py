@@ -407,7 +407,152 @@ def config_5_consolidation():
             "planned_nodes": plan.planned_nodes,
             "node_parity_vs_per_pod_go_oracle": f"{oracle_label} — re-pack forward solve",
             "cost_before_per_hour": round(plan.current_cost_per_hour, 2),
-            "cost_after_per_hour": round(plan.planned_cost_per_hour, 2)}
+            "cost_after_per_hour": round(plan.planned_cost_per_hour, 2),
+            "consolidation_window": _consolidation_window_bench()}
+
+
+def _consolidation_window_bench():
+    """Steady-state 2k-node what-if window (the bench-consolidate gate):
+    W near-full candidate nodes (a DaemonSet filler pins most of each bin,
+    3 movable pods ride on top), a mostly-full fleet, and a scarce tail of
+    empty receivers. The host-incremental leg answers each "does node i
+    drain?" with its own place_onto scan (the old one-node-per-pass cost);
+    the batched leg answers the whole window with one encode + one kernel.
+    Every executed drain is independently re-verified here with a fresh
+    place_onto commit sequence — the zero-unverified-drains evidence the
+    verdict gate reads."""
+    from karpenter_tpu.api import wellknown
+    from karpenter_tpu.api.core import (
+        Node, NodeSpec, NodeStatus, ObjectMeta, OwnerReference,
+    )
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.models.consolidate import (
+        node_bin, place_onto, repack_plan, reschedulable_pods,
+    )
+    from karpenter_tpu.ops.whatif import encode_window
+    from karpenter_tpu.solver.whatif import (
+        WhatIfConfig, plan_window, solve_window,
+    )
+    from karpenter_tpu.utils.resources import parse_resource_list
+
+    W, FULL, RECV = 384, 1592, 24
+    catalog = make_catalog(100)
+    big = max(catalog, key=lambda it: it.cpu.nano)
+
+    def mk_node(name):
+        return Node(
+            metadata=ObjectMeta(name=name, namespace="", labels={
+                wellknown.LABEL_INSTANCE_TYPE: big.name,
+                wellknown.LABEL_CAPACITY_TYPE: "on-demand",
+                wellknown.PROVISIONER_NAME_LABEL: "bench"}),
+            spec=NodeSpec(),
+            status=NodeStatus(allocatable=parse_resource_list({
+                "cpu": str(big.cpu), "memory": str(big.memory),
+                "pods": str(big.pods)})))
+
+    ds = OwnerReference(api_version="apps/v1", kind="DaemonSet",
+                        name="filler", uid="ds")
+    # filler bins keep 100m free (< any movable pod); candidates keep 850m
+    # so their movable load fits nowhere but the receiver tail
+    fill_m = (big.cpu.nano - 100 * 10**6) // 10**6
+    cand_fill_m = (big.cpu.nano - 850 * 10**6) // 10**6
+
+    def mk_pods(prefix, shapes, owner=None):
+        out = []
+        for j, (c, m) in enumerate(shapes):
+            p = make_pods(1, [(c, m)])[0]
+            p.metadata.name = f"{prefix}-{j}"
+            if owner is not None:
+                p.metadata.owner_references = [owner]
+            out.append(p)
+        return out
+
+    nodes, pods_by = [], {}
+    for i in range(W):
+        n = mk_node(f"cand-{i}")
+        nodes.append(n)
+        pods_by[n.metadata.name] = (
+            mk_pods(f"cfill-{i}", [(cand_fill_m, 128)], owner=ds)
+            + mk_pods(f"mv-{i}", [(250, 256)] * 3))
+    for i in range(FULL):
+        n = mk_node(f"full-{i}")
+        nodes.append(n)
+        pods_by[n.metadata.name] = mk_pods(
+            f"fill-{i}", [(fill_m, 128)], owner=ds)
+    for i in range(RECV):
+        n = mk_node(f"recv-{i}")
+        nodes.append(n)
+        pods_by[n.metadata.name] = []
+
+    bins = [node_bin(n, pods_by[n.metadata.name]) for n in nodes]
+    cand_idx = list(range(W))
+    cand_movable = [reschedulable_pods(pods_by[f"cand-{i}"])[0]
+                    for i in range(W)]
+
+    # leg 1: host-incremental — one place_onto scan per candidate
+    t0 = time.perf_counter()
+    host_feas = [
+        place_onto(cand_movable[i], bins[:i] + bins[i + 1:]) is not None
+        for i in cand_idx]
+    t_inc = time.perf_counter() - t0
+
+    # leg 2: batched what-if — one encode + one kernel for the window
+    cfg = WhatIfConfig(device_min_cells=0)
+    solve_window(encode_window(bins, cand_idx, cand_movable), cfg)  # warm-up
+    t0 = time.perf_counter()
+    enc = encode_window(bins, cand_idx, cand_movable)
+    feas, _, executor = solve_window(enc, cfg)
+    t_bat = time.perf_counter() - t0
+    parity = [bool(f) for f in feas] == host_feas
+
+    plan = plan_window(enc, feas, [big.price] * W, max_drains=W)
+    # independent re-verification: replay the plan as place_onto commits on
+    # a FRESH bin set (drained bins drop out as the replay proceeds)
+    vbins = [node_bin(n, pods_by[n.metadata.name]) for n in nodes]
+    drained = set()
+    unverified = 0
+    for action in plan.actions:
+        surviving = [b for j, b in enumerate(vbins)
+                     if j != action.bin and j not in drained]
+        if place_onto(cand_movable[action.cand], surviving,
+                      commit=True) is None:
+            unverified += 1
+        else:
+            drained.add(action.bin)
+
+    # leg 3: LP/ADMM relaxation re-pack of the candidate subset
+    constraints = universe_constraints(catalog)
+    cand_nodes = nodes[:W]
+    cand_pods_by = {n.metadata.name: pods_by[n.metadata.name]
+                    for n in cand_nodes}
+    t0 = time.perf_counter()
+    rplan = repack_plan(cand_nodes, cand_pods_by, constraints, catalog,
+                        backend="relax")
+    t_relax = time.perf_counter() - t0
+    relax = rplan.relax
+
+    return {
+        "fleet_nodes": len(nodes), "candidates": W,
+        "host_incremental_s": round(t_inc, 4),
+        "host_incremental_evals_per_s": round(W / t_inc, 1),
+        "batched_s": round(t_bat, 4),
+        "batched_evals_per_s": round(W / t_bat, 1),
+        "speedup": round(t_inc / t_bat, 1),
+        "executor": executor,
+        "parity": parity,
+        "feasible": int(sum(host_feas)),
+        "drains": len(plan.actions),
+        "unverified_drains": unverified,
+        "reclaimed_per_hour": round(plan.reclaimed_per_hour, 2),
+        "relax": None if relax is None else {
+            "seconds": round(t_relax, 3),
+            "used": relax.used, "reason": relax.reason,
+            "relax_cost": round(relax.relax_cost, 4)
+            if relax.relax_cost != float("inf") else None,
+            "ffd_cost": round(relax.ffd_cost, 4)
+            if relax.ffd_cost != float("inf") else None,
+            "planned_nodes": rplan.planned_nodes},
+    }
 
 
 def config_6_high_cardinality():

@@ -1,37 +1,152 @@
-"""Consolidation controller: drain under-utilized nodes one safe step at a
-time.
+"""Consolidation controller: one batched what-if solve per window.
 
 A deprovisioning capability beyond the reference (which only deletes empty
-nodes, node/emptiness.go). Per Provisioner with ``consolidationEnabled``:
-find a ready node whose reschedulable pods provably fit in the surviving
-nodes' free capacity (models/consolidate.py), delete it, and let the
-existing machinery do the rest — the termination finalizer cordons/drains
-(termination/terminate.go flow), evicted pods go pending, selection routes
-them, and they land on the surviving capacity or trigger a cheaper launch.
+nodes, node/emptiness.go). Per Provisioner with ``consolidationEnabled``,
+each reconcile runs ONE window:
+
+1. Gather settled capacity (ready, not deleting) into bins and filter the
+   candidates that may actually drain: a ``karpenter.sh/do-not-evict`` pod
+   pins its node, and a node whose movable pods would breach a
+   PodDisruptionBudget's headroom (or whose PDBs are misconfigured — >1
+   selecting a pod, or both minAvailable and maxUnavailable set — which
+   the eviction subresource 500s) never enters the batch.
+2. Encode "cluster minus node i" for every candidate i as one tensor
+   program (ops/whatif.py) and solve the whole window in a single batched
+   device call (solver/whatif.py) riding the DeviceRing + watchdog — N
+   candidate evaluations for one device round trip.
+3. Score feasible drains in $/h (models/cost.py via fleet_prices) and
+   execute the cheapest feasible multi-node plan, each drain re-verified
+   exactly on host before its delete (zero unverified drains). Deletion
+   rides the existing termination finalizer flow — cordon/drain, evicted
+   pods go pending, selection routes them onto surviving capacity.
+
+Nodes whose instance type has left the catalog price at $0 but REMAIN
+candidates (the old path silently skipped them, so they were never
+consolidated); they are logged once per window with a counter.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.core import Node, Pod
-from karpenter_tpu.models.consolidate import removable_nodes
+from karpenter_tpu.metrics.consolidation import (
+    CONSOLIDATION_CANDIDATES_TOTAL, CONSOLIDATION_DRAINS_TOTAL,
+    CONSOLIDATION_FILTERED_TOTAL, CONSOLIDATION_RECLAIMED_TOTAL,
+    CONSOLIDATION_SOLVE_SECONDS, CONSOLIDATION_UNKNOWN_TYPE_TOTAL,
+    CONSOLIDATION_WINDOW_CANDIDATES, CONSOLIDATION_WINDOW_RECLAIMED)
+from karpenter_tpu.models.consolidate import (
+    fleet_prices, node_bin, reschedulable_pods)
+from karpenter_tpu.models.cost import CostConfig
+from karpenter_tpu.ops.whatif import encode_window
 from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
+from karpenter_tpu.solver.whatif import (
+    WhatIfConfig, dispatch_window, plan_window)
 from karpenter_tpu.utils import node as nodeutil
 
 log = logging.getLogger("karpenter.consolidation")
 
 
+class _PdbHeadroom:
+    """Read-only mirror of the eviction subresource's PDB math
+    (runtime/kubecore.py evict_pod), evaluated once per window: per-PDB
+    (healthy, desired) over the namespace's pods, so candidate filtering
+    costs one pass instead of one dry-run eviction per pod."""
+
+    def __init__(self, kube: KubeCore):
+        self.kube = kube
+        self._by_ns: Dict[str, list] = {}
+
+    def _pdbs(self, namespace: str) -> list:
+        cached = self._by_ns.get(namespace)
+        if cached is not None:
+            return cached
+        from karpenter_tpu.runtime.kubecore import _scaled_int_or_percent
+
+        entries = []
+        pods = self.kube.list("Pod", namespace=namespace)
+        for pdb in self.kube.list("PodDisruptionBudget", namespace=namespace):
+            if pdb.selector is None:
+                continue
+            expected = healthy = 0
+            for p in pods:
+                if not pdb.selector.matches(p.metadata.labels):
+                    continue
+                expected += 1
+                if getattr(p.spec, "node_name", None) \
+                        and p.metadata.deletion_timestamp is None:
+                    healthy += 1
+            both = pdb.min_available is not None \
+                and pdb.max_unavailable is not None
+            desired: Optional[int] = None
+            if not both:
+                try:
+                    if pdb.min_available is not None:
+                        desired = _scaled_int_or_percent(
+                            pdb.min_available, expected, pdb.metadata.name)
+                    elif pdb.max_unavailable is not None:
+                        desired = expected - _scaled_int_or_percent(
+                            pdb.max_unavailable, expected, pdb.metadata.name)
+                except Exception:
+                    both = True  # malformed IntOrString → conservative block
+            entries.append((pdb, desired, healthy, both))
+        self._by_ns[namespace] = entries
+        return entries
+
+    def blocks_drain(self, movable: Sequence[Pod]) -> bool:
+        """Would draining ALL these pods at once breach any PDB? Mirrors
+        evict_pod: >1 matching PDB or both fields set blocks outright;
+        else the node's total healthy loss per PDB must fit its headroom
+        (healthy − desired)."""
+        loss: Dict[int, int] = {}
+        by_id: Dict[int, tuple] = {}
+        for pod in movable:
+            matched = []
+            for entry in self._pdbs(pod.metadata.namespace):
+                if entry[0].selector.matches(pod.metadata.labels):
+                    matched.append(entry)
+            if not matched:
+                continue
+            if len(matched) > 1:
+                return True  # eviction would 500: misconfigured
+            pdb, desired, healthy, both = matched[0]
+            if both or desired is None and (
+                    pdb.min_available is not None
+                    or pdb.max_unavailable is not None):
+                return True
+            if desired is None:
+                continue  # selector-only PDB: no budget expressed
+            if getattr(pod.spec, "node_name", None) \
+                    and pod.metadata.deletion_timestamp is None:
+                key = id(pdb)
+                by_id[key] = matched[0]
+                loss[key] = loss.get(key, 0) + 1
+        for key, n in loss.items():
+            _, desired, healthy, _ = by_id[key]
+            if healthy - n < desired:
+                return True
+        return False
+
+
 class ConsolidationController:
-    """Watches Provisioners; one consolidation action per reconcile."""
+    """Watches Provisioners; one batched what-if window per reconcile."""
 
     REQUEUE_SECONDS = 30.0
 
-    def __init__(self, kube: KubeCore, max_actions_per_pass: int = 1):
+    def __init__(self, kube: KubeCore, provider=None,
+                 max_actions_per_pass: int = 8,
+                 window_size: int = 512,
+                 whatif_config: Optional[WhatIfConfig] = None,
+                 cost_config: CostConfig = CostConfig()):
         self.kube = kube
+        self.provider = provider
         self.max_actions_per_pass = max_actions_per_pass
+        self.window_size = window_size
+        self.whatif_config = whatif_config or WhatIfConfig()
+        self.cost_config = cost_config
 
     def kind(self) -> str:
         return "Provisioner"
@@ -46,7 +161,7 @@ class ConsolidationController:
         if provisioner.metadata.deletion_timestamp is not None:
             return None
 
-        candidates: List[Node] = []
+        fleet: List[Node] = []
         pods_by_node: Dict[str, List[Pod]] = {}
         for node in self.kube.list("Node"):
             if node.metadata.labels.get(wellknown.PROVISIONER_NAME_LABEL) != name:
@@ -56,16 +171,84 @@ class ConsolidationController:
                 continue
             if not nodeutil.is_ready(node):
                 continue
-            candidates.append(node)
+            fleet.append(node)
             pods_by_node[node.metadata.name] = self.kube.pods_on_node(
                 node.metadata.name)
 
-        for node in removable_nodes(
-                candidates, pods_by_node, max_actions=self.max_actions_per_pass):
-            log.info("consolidating node %s (%d pods fit on surviving capacity)",
-                     node.metadata.name, len(pods_by_node[node.metadata.name]))
+        catalog = self.provider.get_instance_types(
+            provisioner.spec.constraints) if self.provider is not None else []
+        prices, unknown = fleet_prices(fleet, catalog, self.cost_config)
+        if unknown and catalog:
+            # once per window, not per node — the counter carries cardinality
+            CONSOLIDATION_UNKNOWN_TYPE_TOTAL.inc(len(unknown))
+            log.warning(
+                "consolidation window: %d node(s) have instance types absent "
+                "from the catalog (e.g. %s=%r on %s); priced at $0/h but "
+                "still consolidation candidates", len(unknown),
+                wellknown.LABEL_INSTANCE_TYPE,
+                unknown[0].metadata.labels.get(wellknown.LABEL_INSTANCE_TYPE),
+                unknown[0].metadata.name)
+
+        # every settled node is a receiver bin; only filtered nodes drain
+        bins = [node_bin(n, pods_by_node[n.metadata.name]) for n in fleet]
+        pdb = _PdbHeadroom(self.kube)
+        cand_idx: List[int] = []
+        cand_movable: List[List[Pod]] = []
+        savings: List[float] = []
+        # the incremental removable_nodes pass's receiver set (drainable or
+        # empty unpinned nodes, fewest movable pods first) — plan_window's
+        # at-least-as-cheap-as-incremental emulation leg scans exactly it
+        inc_targets: List[Tuple[int, int]] = []
+        for i, node in enumerate(fleet):
+            movable, ok = reschedulable_pods(pods_by_node[node.metadata.name])
+            if not ok:
+                CONSOLIDATION_FILTERED_TOTAL.inc(reason="do-not-evict")
+                continue
+            inc_targets.append((len(movable), i))
+            if not movable:
+                continue  # empty nodes are the emptiness controller's job
+            if pdb.blocks_drain(movable):
+                CONSOLIDATION_FILTERED_TOTAL.inc(reason="pdb")
+                continue
+            if len(cand_idx) >= self.window_size:
+                break
+            cand_idx.append(i)
+            cand_movable.append(movable)
+            savings.append(prices.get(node.metadata.name, 0.0))
+
+        CONSOLIDATION_WINDOW_CANDIDATES.set(float(len(cand_idx)))
+        if len(cand_idx) == 0 or len(bins) < 2:
+            CONSOLIDATION_WINDOW_RECLAIMED.set(0.0)
+            return self.REQUEUE_SECONDS
+
+        t0 = time.perf_counter()
+        enc = encode_window(bins, cand_idx, cand_movable)
+        feasible, _, executor = dispatch_window(enc, self.whatif_config).fetch()
+        solve_s = time.perf_counter() - t0
+        CONSOLIDATION_SOLVE_SECONDS.observe(solve_s)
+        CONSOLIDATION_CANDIDATES_TOTAL.inc(float(len(cand_idx)))
+
+        plan = plan_window(enc, feasible, savings,
+                           max_drains=self.max_actions_per_pass,
+                           incremental_targets=[i for _, i
+                                                in sorted(inc_targets)])
+        CONSOLIDATION_WINDOW_RECLAIMED.set(plan.reclaimed_per_hour)
+        if plan.actions:
+            log.info(
+                "consolidation window: %d candidates → %d feasible → "
+                "%d drains reclaiming $%.4f/h (%s, %.3fs)",
+                plan.evaluated, plan.feasible, len(plan.actions),
+                plan.reclaimed_per_hour, executor, solve_s)
+        for action in plan.actions:
+            node = fleet[action.bin]
+            log.info("consolidating node %s (%d pods fit on surviving "
+                     "capacity; reclaims $%.4f/h)", node.metadata.name,
+                     len(enc.cand_pods[action.cand]), action.saving)
             try:
-                self.kube.delete("Node", node.metadata.name, node.metadata.namespace)
+                self.kube.delete("Node", node.metadata.name,
+                                 node.metadata.namespace)
             except NotFound:
-                pass
+                continue
+            CONSOLIDATION_DRAINS_TOTAL.inc()
+            CONSOLIDATION_RECLAIMED_TOTAL.inc(action.saving)
         return self.REQUEUE_SECONDS

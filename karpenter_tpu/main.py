@@ -114,7 +114,7 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
             kube, cloud_provider,
             interval_seconds=options.gc_interval_seconds,
             grace_seconds=options.gc_grace_seconds))
-    manager.register(ConsolidationController(kube))
+    manager.register(ConsolidationController(kube, provider=cloud_provider))
     manager.register(PVCController(kube))
     manager.register(NodeMetricsController(kube))
     manager.register(PodMetricsController(kube))

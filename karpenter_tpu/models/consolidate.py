@@ -42,22 +42,42 @@ def node_instance_type(node: Node, catalog: Sequence[InstanceType]) -> Optional[
     return None
 
 
+def fleet_prices(
+    nodes: Sequence[Node],
+    catalog: Sequence[InstanceType],
+    cost_config: CostConfig = CostConfig(),
+) -> Tuple[Dict[str, float], List[Node]]:
+    """$/h per node name at its actual capacity type, plus the nodes whose
+    instance-type label is absent from the catalog (stale label, or the
+    type left the offering set). Unknown nodes price at $0 — they stay
+    consolidatable (draining them reclaims SOMETHING; skipping them, the
+    old callers' behavior, meant they were never consolidated and never
+    priced). Callers log the unknowns once per window with the
+    consolidation_unknown_instance_type_total counter."""
+    by_name = {it.name: it for it in catalog}
+    prices: Dict[str, float] = {}
+    unknown: List[Node] = []
+    for node in nodes:
+        it = by_name.get(node.metadata.labels.get(wellknown.LABEL_INSTANCE_TYPE))
+        if it is None:
+            prices[node.metadata.name] = 0.0
+            unknown.append(node)
+            continue
+        capacity_type = node.metadata.labels.get(
+            wellknown.LABEL_CAPACITY_TYPE, wellknown.CAPACITY_TYPE_ON_DEMAND)
+        prices[node.metadata.name] = node_price(it, capacity_type, cost_config)
+    return prices, unknown
+
+
 def current_cost(
     nodes: Sequence[Node],
     catalog: Sequence[InstanceType],
     cost_config: CostConfig = CostConfig(),
 ) -> float:
-    """$/h of the running fleet, priced at each node's actual capacity type."""
-    by_name = {it.name: it for it in catalog}
-    total = 0.0
-    for node in nodes:
-        it = by_name.get(node.metadata.labels.get(wellknown.LABEL_INSTANCE_TYPE))
-        if it is None:
-            continue
-        capacity_type = node.metadata.labels.get(
-            wellknown.LABEL_CAPACITY_TYPE, wellknown.CAPACITY_TYPE_ON_DEMAND)
-        total += node_price(it, capacity_type, cost_config)
-    return total
+    """$/h of the running fleet, priced at each node's actual capacity type.
+    Nodes the catalog can't price contribute $0 (see fleet_prices)."""
+    prices, _ = fleet_prices(nodes, catalog, cost_config)
+    return sum(prices.values())
 
 
 def reschedulable_pods(pods: Sequence[Pod]) -> Tuple[List[Pod], bool]:
@@ -83,6 +103,7 @@ class ConsolidationPlan:
     current_nodes: int
     current_cost_per_hour: float
     planned_cost_per_hour: float
+    relax: Optional[object] = None  # solver.relax.RelaxInfo when backend="relax"
 
     @property
     def planned_nodes(self) -> int:
@@ -117,18 +138,26 @@ def repack_plan(
     daemons: Sequence[Pod] = (),
     solver_config: Optional[SolverConfig] = None,
     cost_config: CostConfig = CostConfig(),
+    backend: str = "ffd",
 ) -> ConsolidationPlan:
     """Minimal-set re-pack of every candidate node's reschedulable pods —
-    one solve on the same device kernel as provisioning."""
+    one solve on the same device kernel as provisioning.
+
+    ``backend="relax"`` routes the replacement solve through the LP/ADMM
+    relaxation (solver/relax.py): its rounded plan is used only when
+    strictly cheaper AND fully feasible, else the exact FFD plan — the
+    returned plan is always exact-FFD-verified either way."""
     return repack_plan_multi(
         [Fleet(nodes, pods_by_node, constraints, catalog, daemons)],
-        solver_config=solver_config, cost_config=cost_config)[0]
+        solver_config=solver_config, cost_config=cost_config,
+        backend=backend)[0]
 
 
 def repack_plan_multi(
     fleets: Sequence[Fleet],
     solver_config: Optional[SolverConfig] = None,
     cost_config: CostConfig = CostConfig(),
+    backend: str = "ffd",
 ) -> List[ConsolidationPlan]:
     """Whole-fleet re-packs for MANY provisioners in one batched device
     call: the per-fleet forward solves ride solver/batch_solve.solve_batch
@@ -150,7 +179,19 @@ def repack_plan_multi(
             movable.extend(pods)
         prepared.append((fleet, candidates, movable))
 
-    if len(prepared) == 1:  # solo fleet: no batch machinery
+    relax_infos: List[Optional[object]] = [None] * len(prepared)
+    if backend == "relax":
+        from karpenter_tpu.solver.relax import relax_solve
+
+        replacements = []
+        for idx, (fleet, _, movable) in enumerate(prepared):
+            replacement, info = relax_solve(
+                fleet.constraints, movable, fleet.catalog,
+                daemons=fleet.daemons, config=solver_config,
+                cost_config=cost_config)
+            replacements.append(replacement)
+            relax_infos[idx] = info
+    elif len(prepared) == 1:  # solo fleet: no batch machinery
         fleet, candidates, movable = prepared[0]
         replacements = [solve(fleet.constraints, movable, fleet.catalog,
                               daemons=fleet.daemons, config=solver_config)]
@@ -171,8 +212,10 @@ def repack_plan_multi(
             planned_cost_per_hour=plan_cost(
                 replacement.packings, fleet.constraints.requirements,
                 cost_config),
+            relax=info,
         )
-        for (fleet, candidates, _), replacement in zip(prepared, replacements)
+        for (fleet, candidates, _), replacement, info
+        in zip(prepared, replacements, relax_infos)
     ]
 
 
@@ -212,6 +255,12 @@ def _bin_for(node: Node, pods: Sequence[Pod]) -> _Bin:
         labels=node.metadata.labels,
         taints=Taints(node.spec.taints),
     )
+
+
+def node_bin(node: Node, pods: Sequence[Pod]) -> _Bin:
+    """Public form of _bin_for: the what-if window encoder
+    (ops/whatif.encode_window) consumes these as its bin set."""
+    return _bin_for(node, pods)
 
 
 def _compatible(pod: Pod, b: _Bin) -> bool:

@@ -1,0 +1,229 @@
+"""LP/ADMM-relaxation packer: cost-minimizing global repack in JAX.
+
+FFD minimizes node COUNT; with a priced catalog the cheapest fleet is not
+always the smallest (two small cheap nodes can undercut one large one).
+CvxCluster (PAPERS.md) shows granular allocation decisions formulated as
+relaxed optimization solve orders of magnitude faster than incremental
+search — this module is that formulation for the repack problem:
+
+    minimize    Σ_t price_t · n_t
+    subject to  Σ_t x_st = c_s            (every pod shape fully assigned)
+                Σ_s x_st · shape_sr ≤ n_t · cap_tr   (type capacity)
+                x ≥ 0, n ≥ 0
+
+solved by projected gradient descent on the augmented (penalty) objective
+— the ADMM-flavored splitting: assignment x and node-count n take
+alternating gradient steps against quadratic penalties on the coupling
+constraints, projected onto the nonnegative orthant each iteration. The
+relaxation is NOT trusted: its only output is a *support* (which instance
+types the optimum uses). Rounding = the exact host FFD restricted to that
+support. The contract, enforced here and asserted by the differential
+suite:
+
+- rounded plan infeasible (any pod unschedulable)  → exact FFD plan
+- rounded plan costlier than the exact FFD plan    → exact FFD plan
+- anything unencodable / unpriced / jax failure    → exact FFD plan
+
+so every plan that leaves this module is an exact-FFD-verified packing;
+the relaxation can only ever LOWER cost, never regress correctness.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.api.core import Pod
+from karpenter_tpu.cloudprovider.spi import InstanceType
+from karpenter_tpu.models.cost import CostConfig, effective_price
+from karpenter_tpu.solver import host_ffd
+from karpenter_tpu.solver.host_ffd import HostSolveResult, MAX_INSTANCE_TYPES
+from karpenter_tpu.solver.solve import (
+    SolveResult, SolverConfig, materialize, solve)
+
+log = logging.getLogger("karpenter.solver.relax")
+
+_BIG = 1e9  # price stand-in for unpriced/unviable types in the objective
+
+
+@dataclass
+class RelaxInfo:
+    """What the relaxation did — every field observable by metrics/bench."""
+
+    used: bool
+    reason: str            # "relaxation" or "fallback-<why>"
+    relax_cost: float = float("inf")
+    ffd_cost: float = float("inf")
+    support: int = 0       # instance types the relaxation selected
+    iters: int = 0
+    seconds: float = 0.0
+
+
+def _hsr_cost(result: HostSolveResult, prices: Sequence[float]) -> float:
+    """$/h of a host solve result, charging each node its cheapest viable
+    option — the same convention as models/cost.plan_cost."""
+    total = 0.0
+    for p in result.packings:
+        total += min(prices[j] for j in p.instance_type_indices) \
+            * p.node_quantity
+    return total
+
+
+def _relax_support(enc, prices_by_packable: Sequence[float],
+                   iters: int) -> Optional[List[int]]:
+    """Run the projected-gradient relaxation; returns packable positions in
+    the optimum's support, or None when jax/the numerics fail."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    S, T = enc.num_shapes, enc.num_types
+    shapes = np.asarray(enc.shapes[:S], dtype=np.float32)
+    caps = np.asarray(enc.totals[:T], dtype=np.float32)
+    counts = np.asarray(enc.counts[:S], dtype=np.float32)
+    # per-resource normalization keeps every constraint O(1) in float32
+    norm = np.maximum(np.maximum(shapes.max(axis=0, initial=1.0),
+                                 caps.max(axis=0, initial=1.0)), 1.0)
+    shapes, caps = shapes / norm, caps / norm
+    prices = np.asarray(prices_by_packable, dtype=np.float32)
+    pmax = float(prices.max()) or 1.0
+
+    rho, mu, lr = 8.0, 8.0, 0.05
+
+    def loss(x, n):
+        load = jnp.einsum("st,sr->tr", x, shapes)       # (T, R)
+        over = jax.nn.relu(load - n[:, None] * caps)
+        short = jnp.sum(x, axis=1) - counts             # (S,)
+        return (jnp.dot(prices / pmax, n)
+                + rho / 2.0 * jnp.sum(over * over)
+                + mu / 2.0 * jnp.sum(short * short))
+
+    grad = jax.grad(loss, argnums=(0, 1))
+
+    def body(_, xn):
+        x, n = xn
+        gx, gn = grad(x, n)
+        return (jax.nn.relu(x - lr * gx), jax.nn.relu(n - lr * gn))
+
+    @jax.jit
+    def run(x0, n0):
+        return jax.lax.fori_loop(0, iters, body, (x0, n0))
+
+    # warm start: spread each shape's count evenly, size n to cover it
+    x0 = jnp.asarray(np.tile((counts / max(T, 1))[:, None], (1, T)))
+    need = np.einsum("s,sr->r", counts, np.asarray(shapes))
+    denom = np.maximum(np.asarray(caps), 1e-6)
+    n0 = jnp.asarray(np.max(need[None, :] / denom, axis=1)
+                     / max(T, 1), dtype=np.float32)
+    try:
+        x, n = run(x0, n0)
+        n = np.asarray(n)
+    except Exception:
+        log.exception("relaxation solve failed")
+        return None
+    if not np.all(np.isfinite(n)):
+        return None
+    # a type carries the support when the optimum provisions a meaningful
+    # fraction of a node there (0.4 absorbs rounding noise; n is in nodes)
+    keep = [t for t in range(T) if n[t] >= max(0.4, 0.02 * float(n.max()))]
+    return keep
+
+
+def relax_pack(
+    pod_vecs: Sequence[Sequence[int]],
+    pod_ids: Sequence[int],
+    packables,
+    prices_sorted_types: Sequence[float],
+    max_instance_types: int = MAX_INSTANCE_TYPES,
+    iters: int = 300,
+) -> Tuple[HostSolveResult, RelaxInfo]:
+    """The backend core: exact FFD baseline + relaxation-restricted FFD
+    rounding, cheapest feasible wins. ``pod_vecs`` must be sorted
+    descending (host_ffd.pack's contract); ``prices_sorted_types`` is $/h
+    per sorted_types position (packable .index domain)."""
+    t0 = time.perf_counter()
+    ffd = host_ffd.pack(pod_vecs, pod_ids, packables,
+                        max_instance_types=max_instance_types)
+    ffd_cost = _hsr_cost(ffd, prices_sorted_types) if ffd.packings else 0.0
+
+    def fallback(reason: str, relax_cost: float = float("inf"),
+                 ) -> Tuple[HostSolveResult, RelaxInfo]:
+        return ffd, RelaxInfo(used=False, reason=f"fallback-{reason}",
+                              relax_cost=relax_cost, ffd_cost=ffd_cost,
+                              iters=iters,
+                              seconds=time.perf_counter() - t0)
+
+    if not packables or not pod_vecs:
+        return fallback("empty")
+    by_pos = [prices_sorted_types[p.index] for p in packables]
+    if not any(0.0 < v < _BIG for v in by_pos):
+        return fallback("unpriced")  # objective degenerate without prices
+
+    from karpenter_tpu.ops.encode import encode
+
+    enc = encode(pod_vecs, pod_ids, packables, pad=False)
+    if enc is None:
+        return fallback("unencodable")
+    keep = _relax_support(
+        enc, [min(v, _BIG) if v > 0 else _BIG for v in by_pos], iters)
+    if not keep:
+        return fallback("no-support" if keep == [] else "jax-error")
+    restricted = [packables[t].copy() for t in keep]
+    rounded = host_ffd.pack(pod_vecs, pod_ids, restricted,
+                            max_instance_types=max_instance_types)
+    if rounded.unschedulable:
+        return fallback("infeasible")
+    relax_cost = _hsr_cost(rounded, prices_sorted_types)
+    if ffd.unschedulable == [] and relax_cost >= ffd_cost - 1e-12:
+        return fallback("costlier", relax_cost)
+    return rounded, RelaxInfo(
+        used=True, reason="relaxation", relax_cost=relax_cost,
+        ffd_cost=ffd_cost, support=len(keep), iters=iters,
+        seconds=time.perf_counter() - t0)
+
+
+def relax_solve(
+    constraints: Constraints,
+    pods: Sequence[Pod],
+    instance_types: Sequence[InstanceType],
+    daemons: Sequence[Pod] = (),
+    config: Optional[SolverConfig] = None,
+    cost_config: CostConfig = CostConfig(),
+    iters: int = 300,
+) -> Tuple[SolveResult, RelaxInfo]:
+    """solve() with the relaxation backend: the exact path (device FFD +
+    its fallback rings) always runs; the relaxation's rounded plan replaces
+    it only when strictly cheaper AND fully feasible. Emits the fallback
+    counter either way (metrics/consolidation.py)."""
+    from karpenter_tpu.metrics.consolidation import (
+        CONSOLIDATION_RELAX_FALLBACKS, CONSOLIDATION_RELAX_USED)
+    from karpenter_tpu.solver.adapter import (
+        build_packables_cached, marshal_pods_interned)
+
+    config = config or SolverConfig()
+    exact = solve(constraints, pods, instance_types,
+                  daemons=daemons, config=config)
+    pod_vecs, required, _ = marshal_pods_interned(pods)
+    packables, sorted_types = build_packables_cached(
+        instance_types, constraints, pods, daemons, required=required)
+    if not packables:
+        CONSOLIDATION_RELAX_FALLBACKS.inc(reason="no-packables")
+        return exact, RelaxInfo(used=False, reason="fallback-no-packables")
+    order = sorted(range(len(pods)),
+                   key=lambda i: (-pod_vecs[i][0], -pod_vecs[i][1]))
+    prices = [effective_price(it, constraints.requirements, cost_config)[0]
+              for it in sorted_types]
+    prices = [0.0 if p == float("inf") else p for p in prices]
+    rounded, info = relax_pack(
+        [pod_vecs[i] for i in order], order, packables, prices,
+        max_instance_types=config.max_instance_types, iters=iters)
+    if not info.used:
+        CONSOLIDATION_RELAX_FALLBACKS.inc(
+            reason=info.reason.replace("fallback-", ""))
+        return exact, info
+    CONSOLIDATION_RELAX_USED.inc()
+    return materialize(rounded, list(pods), sorted_types,
+                       constraints, config), info

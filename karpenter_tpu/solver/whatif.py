@@ -1,0 +1,274 @@
+"""Batched what-if consolidation solves: N candidate drains, one kernel.
+
+The engine rides the same machinery as the forward batched solver
+(solver/batch_solve.py): a non-blocking dispatch half that marshals the
+window onto the device through the process DeviceRing (signature-keyed
+slots, donation-aliased refills — steady-state windows allocate nothing
+fresh), and a fetch half that materializes under the device watchdog /
+circuit breaker. A window of candidates therefore costs ONE device round
+trip instead of N incremental host re-packs.
+
+The device answer is a *filter*, never an authority: plan selection
+(``plan_window``) walks the feasible candidates in savings order and
+re-verifies each accepted drain exactly on host nano ints
+(ops/whatif.verify_and_commit) against the free capacity remaining after
+earlier drains in the same window — zero unverified drains, by
+construction, even if the kernel were wrong.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from functools import lru_cache
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.ops.whatif import (
+    WhatIfEncoding, host_whatif, verify_and_commit)
+from karpenter_tpu.solver import solve as solve_module
+from karpenter_tpu.solver.solve import record_executor
+
+log = logging.getLogger("karpenter.solver.whatif")
+
+
+@dataclass
+class WhatIfConfig:
+    use_device: bool = True
+    # below this many padded cells (NB*KB*BB) the jit compile outweighs the
+    # solve — tiny test windows stay on the exact host mirror
+    device_min_cells: int = 1 << 15
+    device_timeout_s: float = 120.0
+    device_breaker_seconds: float = 120.0
+
+
+@lru_cache(maxsize=32)
+def _whatif_jit(nb: int, kb: int, bb: int):
+    """One executable per (candidates, pods, bins) bucket triple: vmap over
+    the candidate axis of a first-fit scan over the pod axis. All int32."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(cand_b, pvecs, pvalid, pcompat, free0):
+        bin_ok = jnp.arange(bb, dtype=jnp.int32) != cand_b
+
+        def step(free, xs):
+            vec, ok_pod, cmp = xs
+            fits = jnp.all(free >= vec[None, :], axis=1) & cmp & bin_ok
+            can = fits.any()
+            b = jnp.argmax(fits).astype(jnp.int32)
+            placed = can & ok_pod
+            free = free.at[b].add(-jnp.where(placed, vec, 0))
+            return free, (jnp.where(placed, b, jnp.int32(-1)), can | ~ok_pod)
+
+        _, (slots, oks) = jax.lax.scan(step, free0, (pvecs, pvalid, pcompat))
+        return jnp.all(oks), slots
+
+    def kernel(pods, valid, compat, free0, cand_bin):
+        return jax.vmap(one, in_axes=(0, 0, 0, 0, None))(
+            cand_bin, pods, valid, compat, free0)
+
+    return jax.jit(kernel)
+
+
+@dataclass
+class WhatIfHandle:
+    """The in-flight half of a window solve. ``fetch()`` blocks (under the
+    watchdog when on device) and is idempotent."""
+
+    enc: WhatIfEncoding
+    config: WhatIfConfig
+    _out: Optional[tuple] = None     # device futures (feas, slots)
+    _slot: Optional[object] = None   # DeviceRing slot to release on fetch
+    _ring: Optional[object] = None
+    _result: Optional[Tuple[np.ndarray, np.ndarray, str]] = None
+    dispatch_seconds: float = 0.0
+
+    def fetch(self) -> Tuple[np.ndarray, np.ndarray, str]:
+        """(feasible (N,), slots (N,K), executor). Device failure or a
+        tripped breaker falls through to the exact host mirror — the
+        engine never stalls a reconcile on a sick transport."""
+        if self._result is not None:
+            return self._result
+        feas = slots = None
+        executor = "host-whatif"
+        if self._out is not None:
+            try:
+                def _materialize():
+                    f, s = self._out
+                    return np.asarray(f), np.asarray(s)
+
+                if self.config.device_timeout_s > 0:
+                    feas, slots = solve_module._WATCHDOG.run(
+                        _materialize, self.config.device_timeout_s,
+                        self.config.device_breaker_seconds)
+                else:
+                    feas, slots = _materialize()
+                feas = feas[:self.enc.n]
+                slots = slots[:self.enc.n, :max(self.enc.k, 1)]
+                if self.enc.kept is not None and len(self.enc.kept):
+                    # device bins are receiver-pruned positions; translate
+                    # back to original bin indices (the host contract)
+                    kept = np.asarray(self.enc.kept, dtype=np.int32)
+                    slots = np.where(
+                        slots >= 0,
+                        kept[np.clip(slots, 0, len(kept) - 1)],
+                        np.int32(-1))
+                executor = "device-whatif"
+            except Exception:
+                log.exception(
+                    "device what-if fetch failed; host mirror fallback")
+                feas = slots = None
+            finally:
+                if self._ring is not None and self._slot is not None:
+                    self._ring.release(self._slot)
+                    self._slot = None
+        if feas is None:
+            feas, slots = host_whatif(self.enc)
+        record_executor(executor, count=max(self.enc.n, 1))
+        self._result = (feas, slots, executor)
+        return self._result
+
+
+def dispatch_window(enc: WhatIfEncoding,
+                    config: Optional[WhatIfConfig] = None) -> WhatIfHandle:
+    """Marshal the window to the device and launch WITHOUT blocking (jax
+    async dispatch). Buffers cycle through the process DeviceRing keyed by
+    the padded bucket signature, so steady-state windows refill pinned
+    device memory in place instead of allocating."""
+    config = config or WhatIfConfig()
+    handle = WhatIfHandle(enc=enc, config=config)
+    if (not config.use_device or not enc.device_ready
+            or enc.cells < config.device_min_cells
+            or solve_module._WATCHDOG.tripped()):
+        return handle
+    t0 = time.perf_counter()
+    try:
+        from karpenter_tpu.parallel.mesh import (
+            batch_sharding, replicated, solver_mesh)
+        from karpenter_tpu.solver.pipeline import DeviceRing, get_ring
+
+        mesh = solver_mesh()
+        nb = enc.d_pods.shape[0]
+        cand_sh = batch_sharding(mesh) if nb % mesh.devices.size == 0 \
+            else replicated(mesh)
+        rep = replicated(mesh)
+        host = {"wi_pods": enc.d_pods, "wi_valid": enc.d_valid,
+                "wi_compat": enc.d_compat, "wi_free0": enc.d_free0,
+                "wi_cand": enc.d_cand_bin}
+        ring = get_ring()
+        slot = ring.acquire(DeviceRing.signature(host))
+        dev = {}
+        for name, arr in host.items():
+            sharding = rep if name == "wi_free0" else cand_sh
+            dev[name] = ring.fill(slot, name, arr, sharding)
+        fn = _whatif_jit(*enc.d_compat.shape)
+        handle._out = fn(dev["wi_pods"], dev["wi_valid"], dev["wi_compat"],
+                         dev["wi_free0"], dev["wi_cand"])
+        handle._slot, handle._ring = slot, ring
+    except Exception:
+        log.exception("device what-if dispatch failed; host mirror fallback")
+        handle._out = handle._slot = handle._ring = None
+    handle.dispatch_seconds = time.perf_counter() - t0
+    return handle
+
+
+def solve_window(enc: WhatIfEncoding,
+                 config: Optional[WhatIfConfig] = None
+                 ) -> Tuple[np.ndarray, np.ndarray, str]:
+    """dispatch + fetch in one call (bench and tests)."""
+    return dispatch_window(enc, config).fetch()
+
+
+@dataclass
+class WindowAction:
+    """One verified drain: candidate index, its bin, the receiving bins
+    (one per pod, host-verified), and the $/h it reclaims."""
+
+    cand: int
+    bin: int
+    placements: List[int]
+    saving: float
+
+
+@dataclass
+class WindowPlan:
+    actions: List[WindowAction] = field(default_factory=list)
+    reclaimed_per_hour: float = 0.0
+    evaluated: int = 0
+    feasible: int = 0
+
+    @property
+    def drained_bins(self) -> List[int]:
+        return [a.bin for a in self.actions]
+
+
+def plan_window(
+    enc: WhatIfEncoding,
+    feasible: np.ndarray,
+    savings: Sequence[float],
+    max_drains: int = 8,
+    incremental_targets: Optional[List[int]] = None,
+) -> WindowPlan:
+    """Greedy cheapest-feasible plan over the window, re-verifying each
+    accepted drain on exact host ints against the capacity remaining after
+    earlier drains in the same window — and never draining a bin that
+    RECEIVED pods this window (its free vector now backs a placement, the
+    same receiver invariant as models/consolidate.removable_nodes).
+
+    Greedy order matters: draining the priciest node first can consume
+    receiver slack that would have let several cheaper drains through. So
+    the planner runs THREE greedy legs over the same verified machinery —
+    $/h-saved descending, fewest-pods-to-move first, and an exact
+    emulation of the incremental removable_nodes pass — and keeps
+    whichever plan reclaims more. ``incremental_targets`` is that pass's
+    receiver set: the bins of every drainable-or-empty node, in its
+    fewest-movable-pods-first order (the caller knows which bins those
+    are; default approximates with the candidate bins). The third leg
+    makes "at least as cheap as the old one-node-per-pass loop" true by
+    construction."""
+    plan = WindowPlan(evaluated=enc.n, feasible=int(np.sum(feasible[:enc.n])))
+    if enc.n == 0:
+        return plan
+    candidates = [i for i in range(enc.n) if feasible[i]]
+
+    def greedy(order: List[int],
+               scan: Optional[List[int]] = None) -> WindowPlan:
+        p = WindowPlan(evaluated=plan.evaluated, feasible=plan.feasible)
+        free_state = [list(bn.free) for bn in enc.bins]
+        drained: set = set()
+        receivers: set = set()
+        for i in order:
+            if len(p.actions) >= max_drains:
+                break
+            bidx = enc.cand_bin[i]
+            if bidx in drained or bidx in receivers:
+                continue
+            placements = verify_and_commit(enc, i, free_state, drained,
+                                           scan=scan)
+            if placements is None:
+                continue  # earlier drains consumed the slack the kernel saw
+            drained.add(bidx)
+            receivers.update(placements)
+            p.actions.append(WindowAction(
+                cand=i, bin=bidx, placements=placements, saving=savings[i]))
+            p.reclaimed_per_hour += savings[i]
+        return p
+
+    by_savings = greedy(sorted(
+        candidates, key=lambda i: (-savings[i], len(enc.cand_pods[i]), i)))
+    by_moves = greedy(sorted(
+        candidates, key=lambda i: (len(enc.cand_pods[i]), -savings[i], i)))
+    # removable_nodes emulation: candidates by fewest movable pods (stable),
+    # receivers restricted to the incremental pass's target bins in its order
+    inc_order = sorted(candidates, key=lambda i: len(enc.cand_pods[i]))
+    scan = incremental_targets if incremental_targets is not None \
+        else [enc.cand_bin[i] for i in inc_order]
+    pos = {b: p for p, b in enumerate(scan)}
+    inc_order = sorted((i for i in inc_order if enc.cand_bin[i] in pos),
+                       key=lambda i: pos[enc.cand_bin[i]])
+    incremental = greedy(inc_order, scan=scan)
+    return max(by_moves, by_savings, incremental,
+               key=lambda p: p.reclaimed_per_hour)

@@ -291,6 +291,9 @@ class TestConsolidationController:
     def test_deletes_underutilized_node(self, env):
         kube, catalog, provider, provisioning, selection, termination, consolidation = env
         self._seed(kube, catalog, n_nodes=3, pods_each=3)
+        # one action per pass: the cheapest single drain, like the old
+        # incremental engine (the default window drains several — below)
+        consolidation = ConsolidationController(kube, max_actions_per_pass=1)
         requeue = consolidation.reconcile("default")
         assert requeue == ConsolidationController.REQUEUE_SECONDS
         node = kube.get("Node", "node-0", "")
@@ -298,6 +301,93 @@ class TestConsolidationController:
         # survivors untouched
         for name in ("node-1", "node-2"):
             assert kube.get("Node", name, "").metadata.deletion_timestamp is None
+
+    def test_window_executes_multi_node_plan(self, env):
+        # the batched window drains EVERY feasible candidate in one pass,
+        # but never a node that received pods this window: node-0's pod
+        # lands on node-1, so node-1 must survive while node-2 also drains
+        kube, catalog, provider, provisioning, selection, termination, consolidation = env
+        self._seed(kube, catalog, n_nodes=3, pods_each=3)
+        consolidation.reconcile("default")
+        assert kube.get("Node", "node-0", "").metadata.deletion_timestamp is not None
+        assert kube.get("Node", "node-2", "").metadata.deletion_timestamp is not None
+        assert kube.get("Node", "node-1", "").metadata.deletion_timestamp is None
+
+    def test_do_not_evict_pod_filters_candidate(self, env):
+        kube, catalog, provider, provisioning, selection, termination, consolidation = env
+        self._seed(kube, catalog, n_nodes=3, pods_each=3)
+        pod = kube.get("Pod", "pod-0-0")
+        pod.metadata.annotations[wellknown.DO_NOT_EVICT_ANNOTATION] = "true"
+        kube.update(pod)
+        consolidation.reconcile("default")
+        # the annotated pod pins node-0 before the batch; node-2 still drains
+        assert kube.get("Node", "node-0", "").metadata.deletion_timestamp is None
+        assert kube.get("Node", "node-2", "").metadata.deletion_timestamp is not None
+
+    def test_pdb_headroom_filters_candidate(self, env):
+        from karpenter_tpu.api.core import LabelSelector, PodDisruptionBudget
+
+        kube, catalog, provider, provisioning, selection, termination, consolidation = env
+        self._seed(kube, catalog, n_nodes=3, pods_each=3)
+        pod = kube.get("Pod", "pod-0-0")
+        pod.metadata.labels["app"] = "web"
+        kube.update(pod)
+        # minAvailable=1 with a single healthy replica: draining node-0
+        # would leave 0 < 1 — the candidate never enters the batch
+        kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="web-pdb"),
+            selector=LabelSelector(match_labels={"app": "web"}),
+            min_available=1))
+        consolidation.reconcile("default")
+        assert kube.get("Node", "node-0", "").metadata.deletion_timestamp is None
+        assert kube.get("Node", "node-2", "").metadata.deletion_timestamp is not None
+
+    def test_pdb_with_headroom_allows_drain(self, env):
+        from karpenter_tpu.api.core import LabelSelector, PodDisruptionBudget
+
+        kube, catalog, provider, provisioning, selection, termination, consolidation = env
+        self._seed(kube, catalog, n_nodes=3, pods_each=3)
+        # two healthy replicas, only one on node-0: losing it keeps 1 >= 1
+        for name in ("pod-0-0", "pod-1-0"):
+            pod = kube.get("Pod", name)
+            pod.metadata.labels["app"] = "web"
+            kube.update(pod)
+        kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="web-pdb"),
+            selector=LabelSelector(match_labels={"app": "web"}),
+            min_available=1))
+        consolidation.reconcile("default")
+        assert kube.get("Node", "node-0", "").metadata.deletion_timestamp is not None
+
+    def test_unknown_instance_type_logged_and_still_consolidated(self, env, caplog):
+        # regression: node_instance_type -> None made callers silently skip
+        # the node forever; it must price $0, warn ONCE per window (with a
+        # counter), and remain a consolidation candidate
+        import logging
+
+        from karpenter_tpu.metrics.consolidation import (
+            CONSOLIDATION_UNKNOWN_TYPE_TOTAL)
+
+        kube, catalog, provider, provisioning, selection, termination, consolidation = env
+        self._seed(kube, catalog, n_nodes=3, pods_each=3)
+        for name in ("node-1", "node-2"):
+            node = kube.get("Node", name, "")
+            node.metadata.labels[wellknown.LABEL_INSTANCE_TYPE] = "retired-type"
+            kube.update(node)
+        consolidation = ConsolidationController(kube, provider=provider)
+        before = CONSOLIDATION_UNKNOWN_TYPE_TOTAL.collect().get((), 0.0)
+        with caplog.at_level(logging.WARNING,
+                             logger="karpenter.consolidation"):
+            consolidation.reconcile("default")
+        assert CONSOLIDATION_UNKNOWN_TYPE_TOTAL.collect().get((), 0.0) \
+            == before + 2.0
+        warnings = [r for r in caplog.records
+                    if "absent from the catalog" in r.getMessage()]
+        assert len(warnings) == 1  # once per window, not per node
+        # the known-type node drains first (it has a real price), and the
+        # retired-type node-2 STILL consolidates despite pricing $0
+        assert kube.get("Node", "node-0", "").metadata.deletion_timestamp is not None
+        assert kube.get("Node", "node-2", "").metadata.deletion_timestamp is not None
 
     def test_disabled_by_default(self, env):
         kube, catalog, provider, provisioning, selection, termination, consolidation = env

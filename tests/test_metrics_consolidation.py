@@ -1,0 +1,140 @@
+"""Consolidation observability (metrics/consolidation.py).
+
+Every series the batched what-if engine promises must actually be emitted
+by a reconcile: the window gauges, the evaluated/filtered/drain counters,
+the solve-seconds histogram, and the relaxation used/fallback counters.
+The registry is process-wide, so counts are asserted as deltas around one
+driven window (the test_metrics_pipeline.py idiom).
+"""
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.core import LabelSelector, ObjectMeta, PodDisruptionBudget
+from karpenter_tpu.cloudprovider.fake.provider import FakeCloudProvider
+from karpenter_tpu.controllers.consolidation import ConsolidationController
+from karpenter_tpu.metrics.consolidation import (
+    CONSOLIDATION_CANDIDATES_TOTAL, CONSOLIDATION_DRAINS_TOTAL,
+    CONSOLIDATION_FILTERED_TOTAL, CONSOLIDATION_RECLAIMED_TOTAL,
+    CONSOLIDATION_RELAX_FALLBACKS, CONSOLIDATION_RELAX_USED,
+    CONSOLIDATION_SOLVE_SECONDS, CONSOLIDATION_WINDOW_CANDIDATES,
+    CONSOLIDATION_WINDOW_RECLAIMED,
+)
+from karpenter_tpu.controllers.provisioning import universe_constraints
+from karpenter_tpu.models.consolidate import repack_plan
+from karpenter_tpu.runtime.kubecore import KubeCore
+
+from tests.expectations import make_provisioner
+from tests.test_consolidation import priced_catalog, running_node, running_pod
+from tests.test_whatif import random_fleet
+
+
+def _counter(series, **labels):
+    key = tuple(sorted(labels.items()))
+    return series.collect().get(key, 0.0)
+
+
+def _histogram_total(series):
+    return series.collect().get((), (None, 0.0, 0))[2]
+
+
+class TestConsolidationSeries:
+    @pytest.fixture()
+    def env(self):
+        kube = KubeCore()
+        catalog = priced_catalog()
+        provider = FakeCloudProvider(catalog=catalog)
+        kube.create(make_provisioner(
+            constraints=universe_constraints(catalog),
+            consolidation_enabled=True))
+        controller = ConsolidationController(kube, provider=provider)
+        medium = catalog[1]
+        for i in range(3):
+            node = running_node(f"node-{i}", medium)
+            node.metadata.finalizers.append(wellknown.TERMINATION_FINALIZER)
+            kube.create(node)
+            for j in range(1 if i == 0 else 3):
+                pod = running_pod(f"pod-{i}-{j}", cpu="500m")
+                kube.create(pod)
+                kube.bind_pod(pod, f"node-{i}")
+        return kube, catalog, controller
+
+    def test_window_emits_gauges_counters_and_histogram(self, env):
+        kube, catalog, controller = env
+        evaluated0 = _counter(CONSOLIDATION_CANDIDATES_TOTAL)
+        drains0 = _counter(CONSOLIDATION_DRAINS_TOTAL)
+        reclaimed0 = _counter(CONSOLIDATION_RECLAIMED_TOTAL)
+        solves0 = _histogram_total(CONSOLIDATION_SOLVE_SECONDS)
+
+        controller.reconcile("default")
+
+        # all three nodes carried movable pods → all entered the batch
+        assert CONSOLIDATION_WINDOW_CANDIDATES.collect()[()] == 3.0
+        assert _counter(CONSOLIDATION_CANDIDATES_TOTAL) == evaluated0 + 3.0
+        assert _histogram_total(CONSOLIDATION_SOLVE_SECONDS) == solves0 + 1
+        # node-0 and node-2 drain (node-1 received node-0's pod), each
+        # charging the medium price onto the reclaimed counter + gauge
+        drains = _counter(CONSOLIDATION_DRAINS_TOTAL) - drains0
+        assert drains == 2.0
+        reclaimed = _counter(CONSOLIDATION_RECLAIMED_TOTAL) - reclaimed0
+        assert reclaimed == pytest.approx(2 * 0.19)
+        assert CONSOLIDATION_WINDOW_RECLAIMED.collect()[()] == \
+            pytest.approx(reclaimed)
+
+    def test_filtered_counters_by_reason(self, env):
+        kube, catalog, controller = env
+        dne0 = _counter(CONSOLIDATION_FILTERED_TOTAL, reason="do-not-evict")
+        pdb0 = _counter(CONSOLIDATION_FILTERED_TOTAL, reason="pdb")
+
+        pinned = kube.get("Pod", "pod-1-0")
+        pinned.metadata.annotations[wellknown.DO_NOT_EVICT_ANNOTATION] = "true"
+        kube.update(pinned)
+        blocked = kube.get("Pod", "pod-2-0")
+        blocked.metadata.labels["app"] = "web"
+        kube.update(blocked)
+        kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="web-pdb"),
+            selector=LabelSelector(match_labels={"app": "web"}),
+            min_available=1))
+
+        controller.reconcile("default")
+
+        assert _counter(CONSOLIDATION_FILTERED_TOTAL,
+                        reason="do-not-evict") == dne0 + 1.0
+        assert _counter(CONSOLIDATION_FILTERED_TOTAL,
+                        reason="pdb") == pdb0 + 1.0
+        # only node-0 survived the filter into the batch
+        assert CONSOLIDATION_WINDOW_CANDIDATES.collect()[()] == 1.0
+
+    def test_relax_counters_cover_used_and_fallback(self):
+        used0 = _counter(CONSOLIDATION_RELAX_USED)
+        # the crafted case where the relaxation strictly wins (cheaper
+        # small-node fleet) must bump the used counter...
+        from karpenter_tpu.cloudprovider.fake.provider import make_instance_type
+
+        catalog = [
+            make_instance_type("small", cpu="2", memory="4Gi", pods="20",
+                               price=0.10),
+            make_instance_type("large", cpu="8", memory="16Gi", pods="80",
+                               price=0.90),
+        ]
+        constraints = universe_constraints(catalog)
+        nodes = [running_node(f"n{i}", catalog[1]) for i in range(4)]
+        pods_by = {
+            f"n{i}": [running_pod(f"p{i}-{j}", cpu="1", memory="512Mi")
+                      for j in range(2)]
+            for i in range(4)}
+        plan = repack_plan(nodes, pods_by, constraints, catalog,
+                           backend="relax")
+        assert plan.relax.used
+        assert _counter(CONSOLIDATION_RELAX_USED) == used0 + 1.0
+
+        # ...and a fallback run must bump the reason-labelled counter
+        catalog, nodes, pods_by = random_fleet(7, n_nodes=6)
+        constraints = universe_constraints(catalog)
+        plan = repack_plan(nodes, pods_by, constraints, catalog,
+                           backend="relax")
+        if not plan.relax.used:
+            reason = plan.relax.reason.replace("fallback-", "")
+            assert _counter(CONSOLIDATION_RELAX_FALLBACKS,
+                            reason=reason) >= 1.0

@@ -1,0 +1,211 @@
+"""Differential suite for the batched what-if consolidation engine.
+
+Seeded random fleets (seeds 1/7/42) pin the engine's three contracts:
+
+- PARITY: the device kernel's (feasible, slots) equals the exact host
+  mirror bit-for-bit — GCD scaling is exact division and receiver pruning
+  only drops bins that can never be chosen, so the scaled int32 program IS
+  the nano-int program.
+- NEVER OVER-DRAIN: every action in a window plan replays cleanly as an
+  independent place_onto commit sequence on a fresh bin set — the engine
+  never drains a node whose pods don't fit on what actually survives.
+- AT LEAST AS CHEAP: the one-window batched plan reclaims at least the
+  $/h the old incremental removable_nodes pass would have.
+
+Plus the relaxation backend's fallback contract: its plan is used only
+when strictly cheaper AND fully feasible, else byte-for-byte the exact
+FFD plan (solver/relax.py).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.cloudprovider.fake.provider import make_instance_type
+from karpenter_tpu.controllers.provisioning import universe_constraints
+from karpenter_tpu.models.consolidate import (
+    node_bin, place_onto, removable_nodes, repack_plan, reschedulable_pods,
+)
+from karpenter_tpu.models.cost import CostConfig, effective_price
+from karpenter_tpu.ops.whatif import encode_window, host_whatif
+from karpenter_tpu.solver.whatif import (
+    WhatIfConfig, plan_window, solve_window,
+)
+
+from tests.test_consolidation import priced_catalog, running_node, running_pod
+
+SEEDS = (1, 7, 42)
+FORCE_DEVICE = WhatIfConfig(device_min_cells=0)
+
+
+def random_fleet(seed, n_nodes=12):
+    """A seeded fleet over the priced catalog: mixed node sizes, 0-4 small
+    pods each — enough slack that some drains are feasible, some not."""
+    rng = np.random.RandomState(seed)
+    catalog = priced_catalog()
+    nodes, pods_by = [], {}
+    for i in range(n_nodes):
+        it = catalog[rng.randint(len(catalog))]
+        node = running_node(f"n{i}", it)
+        nodes.append(node)
+        pods = []
+        for j in range(rng.randint(5)):
+            pods.append(running_pod(
+                f"p{i}-{j}",
+                cpu=f"{rng.choice([100, 250, 500, 1000])}m",
+                memory=f"{rng.choice([64, 128, 256, 512])}Mi"))
+        pods_by[node.metadata.name] = pods
+    return catalog, nodes, pods_by
+
+
+def window_of(nodes, pods_by, catalog):
+    bins = [node_bin(n, pods_by[n.metadata.name]) for n in nodes]
+    cand_idx, cand_movable, savings = [], [], []
+    constraints = universe_constraints(catalog)
+    by_type = {it.name: it for it in catalog}
+    for i, n in enumerate(nodes):
+        movable, ok = reschedulable_pods(pods_by[n.metadata.name])
+        if not ok or not movable:
+            continue
+        cand_idx.append(i)
+        cand_movable.append(movable)
+        it = by_type[n.metadata.labels[wellknown.LABEL_INSTANCE_TYPE]]
+        savings.append(effective_price(
+            it, constraints.requirements, CostConfig())[0])
+    return bins, cand_idx, cand_movable, savings
+
+
+class TestWhatIfParity:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_device_matches_host_mirror(self, seed):
+        catalog, nodes, pods_by = random_fleet(seed)
+        bins, cand_idx, cand_movable, _ = window_of(nodes, pods_by, catalog)
+        enc = encode_window(bins, cand_idx, cand_movable)
+        assert enc.device_ready, "seeded fleets must be int32-encodable"
+        feas, slots, executor = solve_window(enc, FORCE_DEVICE)
+        assert executor == "device-whatif"
+        host_feas, host_slots = host_whatif(enc)
+        assert np.array_equal(feas, host_feas)
+        assert np.array_equal(slots, host_slots)
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_pruned_mirror_matches_unpruned_scan(self, seed):
+        # host_whatif walks only the receiver-pruned bins; forcing the full
+        # scan must give the identical answer (pruning is exact)
+        catalog, nodes, pods_by = random_fleet(seed)
+        bins, cand_idx, cand_movable, _ = window_of(nodes, pods_by, catalog)
+        enc = encode_window(bins, cand_idx, cand_movable)
+        pruned = host_whatif(enc)
+        enc.kept = None
+        full = host_whatif(enc)
+        assert np.array_equal(pruned[0], full[0])
+        assert np.array_equal(pruned[1], full[1])
+
+    def test_unencodable_window_runs_host_executor(self):
+        # coprime byte-level memory requests push the GCD to 1 and the
+        # scaled column past int32 — the device tensors must be omitted
+        # and the solve must still answer exactly, on host
+        catalog = priced_catalog()
+        nodes = [running_node(f"n{i}", catalog[2]) for i in range(2)]
+        pods_by = {
+            "n0": [running_pod("a", cpu="100m", memory="3")],
+            "n1": [running_pod("b", cpu="100m", memory="7")],
+        }
+        bins, cand_idx, cand_movable, _ = window_of(nodes, pods_by, catalog)
+        enc = encode_window(bins, cand_idx, cand_movable)
+        assert not enc.device_ready
+        feas, _, executor = solve_window(enc, FORCE_DEVICE)
+        assert executor == "host-whatif"
+        assert list(feas) == [True, True]
+
+
+class TestWindowPlan:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_every_drain_replays_on_fresh_bins(self, seed):
+        catalog, nodes, pods_by = random_fleet(seed)
+        bins, cand_idx, cand_movable, savings = window_of(
+            nodes, pods_by, catalog)
+        enc = encode_window(bins, cand_idx, cand_movable)
+        feas, _, _ = solve_window(enc, FORCE_DEVICE)
+        plan = plan_window(enc, feas, savings, max_drains=len(nodes))
+        # independent replay: every executed drain must fit on what
+        # actually survives, in plan order, on a FRESH bin set
+        vbins = [node_bin(n, pods_by[n.metadata.name]) for n in nodes]
+        drained = set()
+        for action in plan.actions:
+            movable = cand_movable[action.cand]
+            surviving = [b for j, b in enumerate(vbins)
+                         if j != action.bin and j not in drained]
+            assert place_onto(movable, surviving, commit=True) is not None, \
+                f"seed {seed}: drained bin {action.bin} does not replay"
+            drained.add(action.bin)
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_batched_reclaims_at_least_incremental(self, seed):
+        catalog, nodes, pods_by = random_fleet(seed)
+        bins, cand_idx, cand_movable, savings = window_of(
+            nodes, pods_by, catalog)
+        enc = encode_window(bins, cand_idx, cand_movable)
+        feas, _, _ = solve_window(enc, FORCE_DEVICE)
+        # the incremental pass's receiver set: every unpinned node (empty
+        # ones included), fewest movable pods first — what the controller
+        # hands plan_window so its emulation leg matches removable_nodes
+        targets = [i for _, i in sorted(
+            (len(reschedulable_pods(pods_by[n.metadata.name])[0]), i)
+            for i, n in enumerate(nodes))]
+        plan = plan_window(enc, feas, savings, max_drains=len(nodes),
+                           incremental_targets=targets)
+
+        removed = removable_nodes(nodes, pods_by, max_actions=len(nodes))
+        constraints = universe_constraints(catalog)
+        by_type = {it.name: it for it in catalog}
+        incremental = sum(
+            effective_price(
+                by_type[n.metadata.labels[wellknown.LABEL_INSTANCE_TYPE]],
+                constraints.requirements, CostConfig())[0]
+            for n in removed)
+        assert plan.reclaimed_per_hour >= incremental - 1e-9
+
+
+class TestRelaxContract:
+    def test_relaxation_wins_when_cheaper_fleet_exists(self):
+        # FFD minimizes node count → one big 8-cpu node ($0.90); the
+        # relaxation sees four 2-cpu nodes cost $0.40 and must beat it
+        catalog = [
+            make_instance_type("small", cpu="2", memory="4Gi", pods="20",
+                               price=0.10),
+            make_instance_type("large", cpu="8", memory="16Gi", pods="80",
+                               price=0.90),
+        ]
+        constraints = universe_constraints(catalog)
+        nodes = [running_node(f"n{i}", catalog[1]) for i in range(4)]
+        pods_by = {
+            f"n{i}": [running_pod(f"p{i}-{j}", cpu="1", memory="512Mi")
+                      for j in range(2)]
+            for i in range(4)}
+        plan = repack_plan(nodes, pods_by, constraints, catalog,
+                           backend="relax")
+        assert plan.relax is not None and plan.relax.used
+        assert plan.relax.reason == "relaxation"
+        assert plan.relax.relax_cost < plan.relax.ffd_cost
+        assert plan.replacement.unschedulable == []
+        assert plan.planned_cost_per_hour < 0.90
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_fallback_is_exact_ffd_parity(self, seed):
+        # whatever the relaxation does on a seeded fleet, the emitted plan
+        # is always feasible; on fallback it is the exact-FFD plan verbatim
+        catalog, nodes, pods_by = random_fleet(seed, n_nodes=8)
+        constraints = universe_constraints(catalog)
+        relaxed = repack_plan(nodes, pods_by, constraints, catalog,
+                              backend="relax")
+        assert relaxed.replacement.unschedulable == []
+        assert relaxed.relax is not None
+        if relaxed.relax.used:
+            assert relaxed.relax.relax_cost < relaxed.relax.ffd_cost
+        else:
+            exact = repack_plan(nodes, pods_by, constraints, catalog)
+            assert relaxed.relax.reason.startswith("fallback-")
+            assert relaxed.planned_nodes == exact.planned_nodes
+            assert relaxed.planned_cost_per_hour == pytest.approx(
+                exact.planned_cost_per_hour)

@@ -1,0 +1,78 @@
+"""Consolidation window verdict: one human-readable line from the bench JSON.
+
+`make bench-consolidate` pipes bench.py's stdout through this filter. The
+bench line passes through UNCHANGED on stdout (so `> BENCH_rNN.json`
+redirects still capture the pure JSON); the verdict goes to stderr:
+
+    consolidate window: 384 candidates, one batched solve \
+(device-whatif) 15.3x vs host-incremental, parity=True, 384 drains \
+(0 unverified) reclaiming $1843.20/h, relax=fallback-costlier — PASS
+
+PASS needs >= 100 candidates in ONE batched solve, batched
+candidate-evaluations/sec >= 10x the host-incremental leg, exact
+feasibility parity, and zero unverified drains (every executed drain
+re-verified by an independent place_onto replay) — the round-9
+acceptance gate.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+GATE_CANDIDATES = 100
+GATE_SPEEDUP = 10.0
+
+
+def verdict(line: dict) -> str:
+    extra = line.get("extra", {})
+    cfg = extra.get("config_5_consolidate_2k_nodes", {})
+    if "error" in cfg or "consolidation_window" not in cfg:
+        return ("consolidate window: no consolidation_window in bench line "
+                f"({cfg.get('error', 'config_5 not run')}) — NO VERDICT")
+    w = cfg["consolidation_window"]
+    candidates = w.get("candidates", 0)
+    speedup = w.get("speedup")
+    parity = w.get("parity")
+    unverified = w.get("unverified_drains")
+    relax = w.get("relax") or {}
+    relax_note = relax.get("reason", "not-run")
+    head = (f"consolidate window: {candidates} candidates, one batched solve "
+            f"({w.get('executor')}) {speedup}x vs host-incremental "
+            f"({w.get('batched_evals_per_s')} vs "
+            f"{w.get('host_incremental_evals_per_s')} evals/s), "
+            f"parity={parity}, {w.get('drains')} drains "
+            f"({unverified} unverified) reclaiming "
+            f"${w.get('reclaimed_per_hour', 0):.2f}/h, relax={relax_note}")
+    ok = (candidates >= GATE_CANDIDATES
+          and speedup is not None and speedup >= GATE_SPEEDUP
+          and parity is True and unverified == 0)
+    return (f"{head} — {'PASS' if ok else 'FAIL'} "
+            f"(gate >={GATE_CANDIDATES} candidates, >={GATE_SPEEDUP}x, "
+            "parity, 0 unverified)")
+
+
+def main() -> int:
+    last = None
+    for raw in sys.stdin:
+        sys.stdout.write(raw)  # pass-through: stdout stays the pure JSON
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            line = json.loads(raw)
+            if isinstance(line, dict) and "metric" in line:
+                last = line
+        except ValueError:
+            continue
+    sys.stdout.flush()
+    if last is None:
+        print("consolidate window: no bench JSON line on stdin — NO VERDICT",
+              file=sys.stderr)
+        return 1
+    print(verdict(last), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
