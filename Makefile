@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate native dryrun lint chart chaos-soak chaos-overload clean help
+.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-replay bench-history replay-smoke native dryrun lint chart chaos-soak chaos-overload clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -30,6 +30,16 @@ bench-pipeline: ## Pipeline A/B at DEVICES virtual devices (DEVICES=N); prints v
 bench-consolidate: ## Batched what-if consolidation window (config_5); prints verdict line on stderr
 	python bench.py --only config_5 \
 		| python tools/consolidate_verdict.py
+
+bench-replay: ## Million-pod replay across 4 shards + 100k-object store A/B (config_9); verdict on stderr
+	python bench.py --only config_9 \
+		| python tools/replay_verdict.py
+
+replay-smoke: ## 10k-pod 2-shard replay smoke (<60s) with chaos + pressure active
+	JAX_PLATFORMS=cpu python -m pytest tests/test_replay.py -q -s -m slow
+
+bench-history: ## Render the BENCH_r*.json trajectory as one table
+	python tools/bench_history.py
 
 native: ## Build the C++ FFD kernel explicitly (normally built lazily)
 	g++ -O3 -std=c++17 -shared -fPIC \

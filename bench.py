@@ -730,6 +730,31 @@ def config_8_large_catalog_type_spmd():
     return out
 
 
+def config_9_million_pod_replay():
+    """Million-pod traffic replay against the horizontally sharded control
+    plane (karpenter_tpu/replay.py, docs/scale.md §3): 1M offered pods
+    across 4 shard workers and 8 tenant Provisioners with chaos faults and
+    the pressure ladder active, plus the 100k-object store list-by-kind
+    A/B vs the naive single-dict store. Heavy (minutes) — skipped on the
+    default full run; `make bench-replay` selects it via --only config_9
+    and gates the result with tools/replay_verdict.py."""
+    import os as _os
+
+    from karpenter_tpu.replay import ReplayConfig, run_replay, store_ab
+
+    ab = store_ab(objects=100_000, minority=2_000)
+    report = run_replay(ReplayConfig())  # the 1M / 4-shard default shape
+    return {
+        "replay": report,
+        "store_ab": ab,
+        "nproc": _os.cpu_count(),
+        "device_count": _device_count(),
+        "note": "single-core host: the shard win is algorithmic (per-shard "
+                "admission isolation + by-kind store index), not parallel "
+                "speedup; nproc is recorded honestly above",
+    }
+
+
 def jax_devices_first():
     import jax
 
@@ -1092,8 +1117,14 @@ def run_all(degraded: bool, probe_note: str = ""):
         ("config_6_high_shape_cardinality", config_6_high_cardinality),
         ("config_7_control_plane_10k_pods", config_7_control_plane),
         ("config_8_large_catalog_type_spmd", config_8_large_catalog_type_spmd),
+        ("config_9_million_pod_replay", config_9_million_pod_replay),
     ):
         if not _selected(key, only):
+            continue
+        if key == "config_9_million_pod_replay" and only is None:
+            # minutes of wall per run: opt-in only (make bench-replay)
+            extra[key] = {"skipped": "heavy: run via --only config_9 "
+                                     "(make bench-replay)"}
             continue
         try:
             extra[key] = fn()

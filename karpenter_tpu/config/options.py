@@ -38,6 +38,10 @@ class Options:
     batch_idle_seconds: float = 1.0
     batch_max_seconds: float = 10.0
     batch_max_items: int = 50_000
+    # horizontal control-plane shards (docs/scale.md §1): N long-lived
+    # intake/provisioning workers, provisioners assigned by crc32(name)%N;
+    # 0 = one worker per Provisioner CR (the reference's shape)
+    provisioning_shards: int = 0
     # solver
     solver_use_device: bool = True
     # pipelined hot loop (solver/pipeline.py): dispatched-but-unfetched
@@ -102,6 +106,9 @@ class Options:
                 f"pressure-split-items must be >= 1: {self.pressure_split_items}")
         if self.pressure_aging_seconds < 0:
             errs.append("pressure-aging-seconds must be >= 0")
+        if self.provisioning_shards < 0:
+            errs.append("provisioning-shards must be >= 0 (0 = one worker "
+                        f"per provisioner): {self.provisioning_shards}")
         if self.pipeline_depth < 1:
             errs.append(f"pipeline-depth must be >= 1: {self.pipeline_depth}")
         if self.pipeline_chunk_items < 0:
@@ -156,6 +163,12 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                    default=_env("batch-max-seconds", defaults.batch_max_seconds))
     p.add_argument("--batch-max-items", type=int,
                    default=_env("batch-max-items", defaults.batch_max_items))
+    p.add_argument("--provisioning-shards", type=int,
+                   default=_env("provisioning-shards",
+                                defaults.provisioning_shards),
+                   help="horizontal control-plane shards: N long-lived "
+                        "intake/provisioning workers keyed by provisioner "
+                        "hash (0 = one worker per Provisioner CR)")
     p.add_argument("--solver-use-device", action=argparse.BooleanOptionalAction,
                    default=_env("solver-use-device", defaults.solver_use_device))
     p.add_argument("--pipeline-depth", type=int,

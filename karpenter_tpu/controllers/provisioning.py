@@ -1,4 +1,4 @@
-"""Provisioning controller + per-Provisioner worker.
+"""Provisioning controller + sharded Provisioner workers.
 
 Reference: pkg/controllers/provisioning/{controller.go,provisioner.go}.
 - The controller reconciles Provisioner CRs into in-memory workers (one
@@ -6,6 +6,27 @@ Reference: pkg/controllers/provisioning/{controller.go,provisioner.go}.
   the live instance-type catalog, and restarts workers on spec change.
 - The worker owns the hot loop: batch → filter → schedule → TPU solve →
   launch → bind.
+
+Sharding model (docs/scale.md §1): the per-Provisioner machinery —
+scheduler, solve pipeline, launch/bind path — is factored into
+:class:`ProvisionerEngine`. A :class:`ProvisionerWorker` is one intake
+shard: one thread, one bounded priority batcher, hosting one or more
+engines. Two deployment shapes share the code:
+
+- **Legacy (shards=0, the default):** one worker per Provisioner CR,
+  exactly the reference's model — every existing call site and test keeps
+  its shape (``worker.provisioner``, ``worker.add(pod)``, ``worker._bind``).
+- **Sharded (shards=N):** the controller runs N long-lived shard workers
+  and assigns each Provisioner's engine to ``crc32(name) % N``. Intake,
+  window assembly, and the solve pipeline parallelize per shard while the
+  pressure ladder (process-wide monitor), leader election, and kube-client
+  rate limits stay global — sharding multiplies throughput, not the blast
+  radius of overload.
+
+Batched items carry their engine routing as ``(provisioner_name, pod)``
+tuples so one shard window can serve many tenants; the window's priority
+order is preserved within each engine group (a system-critical pod still
+solves in its engine's first chunk).
 """
 
 from __future__ import annotations
@@ -13,8 +34,9 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.constraints import Constraints
@@ -42,6 +64,13 @@ class _NoChange(Exception):
     """Raised inside a patch fn to abort a no-op status write (kubecore.patch
     applies fn under the store lock; an exception leaves the store untouched,
     so no MODIFIED event fires and condition refreshes cannot self-loop)."""
+
+
+def shard_of(name: str, shards: int) -> int:
+    """Stable provisioner→shard assignment: crc32 of the CR name. Stable
+    across processes and restarts so shard-labeled metrics stay comparable
+    between runs."""
+    return zlib.crc32(name.encode()) % shards
 
 
 def global_requirements(instance_types: List[InstanceType]) -> Requirements:
@@ -75,37 +104,117 @@ class _ChunkPrep:
     dispatch_s: float = field(default=0.0)
 
 
+class ProvisionerEngine:
+    """Per-Provisioner solve machinery, independent of intake: scheduler +
+    ONE long-lived SolvePipeline (the adaptive-depth state machine learns
+    across provisioning windows and its device rings stay warm between
+    them, solver/pipeline.py). A shard worker hosts one engine per tenant
+    Provisioner; in the legacy one-worker-per-Provisioner shape it hosts
+    exactly one."""
+
+    def __init__(self, provisioner: Provisioner, kube: KubeCore,
+                 pipeline_config: Optional[PipelineConfig] = None,
+                 shard: str = ""):
+        self.provisioner = provisioner
+        self.pipeline_config = pipeline_config or PipelineConfig()
+        self.pipeline = SolvePipeline(self.pipeline_config, shard=shard)
+        self.scheduler = Scheduler(kube)
+        self.shard = shard
+
+
 class ProvisionerWorker:
-    """One worker per Provisioner CR (provisioner.go:41-76)."""
+    """One intake shard: a thread + bounded priority batcher hosting the
+    engine(s) of the Provisioner(s) assigned to it (provisioner.go:41-76 —
+    one CR per worker in the reference; here N CRs share a shard when the
+    controller runs with shards>0)."""
 
     def __init__(
         self,
-        provisioner: Provisioner,
+        provisioner: Optional[Provisioner],
         kube: KubeCore,
         cloud_provider: CloudProvider,
         solver_config: Optional[SolverConfig] = None,
         batcher: Optional[Batcher] = None,
         pipeline_config: Optional[PipelineConfig] = None,
+        shard: str = "",
     ):
-        self.provisioner = provisioner
         self.kube = kube
         self.cloud_provider = cloud_provider
         self.solver_config = solver_config or SolverConfig()
         self.batcher = batcher or Batcher()
         self.pipeline_config = pipeline_config or PipelineConfig()
-        # ONE pipeline for the worker's lifetime: the adaptive-depth state
-        # machine learns across provisioning windows, and the device ring
-        # buffers it drives stay warm between windows (solver/pipeline.py)
-        self.pipeline = SolvePipeline(self.pipeline_config)
-        self.scheduler = Scheduler(kube)
+        self.shard = shard
+        if shard:
+            self.batcher.shard = shard  # per-shard intake metric labels
+        # engine map is copy-on-write (REPLACED under _engines_lock, never
+        # mutated) so the hot loop and selection's targets() iterate a
+        # snapshot without taking the lock
+        self._engines: Dict[str, ProvisionerEngine] = {}
+        self._engines_lock = threading.Lock()
+        # the engine a provision pass is currently serving; the chunk-stage
+        # callbacks (and the monkeypatchable _bind) resolve through this so
+        # their signatures stay engine-free. Only the worker thread writes
+        # it during a pass; direct test calls see the default engine.
+        self._current: Optional[ProvisionerEngine] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        if provisioner is not None:
+            self.attach(provisioner)
+
+    # -- engine management ----------------------------------------------------
+    def attach(self, provisioner: Provisioner) -> None:
+        """Add (or replace, on spec change) the engine for a Provisioner."""
+        eng = ProvisionerEngine(provisioner, self.kube,
+                                pipeline_config=self.pipeline_config,
+                                shard=self.shard)
+        with self._engines_lock:
+            engines = dict(self._engines)
+            engines[provisioner.metadata.name] = eng
+            self._engines = engines
+
+    def detach(self, name: str) -> None:
+        with self._engines_lock:
+            if name in self._engines:
+                engines = dict(self._engines)
+                del engines[name]
+                self._engines = engines
+
+    def engines(self) -> List[ProvisionerEngine]:
+        """Snapshot of hosted engines in attach order."""
+        return list(self._engines.values())
+
+    def _default_engine(self) -> Optional[ProvisionerEngine]:
+        for eng in self._engines.values():
+            return eng
+        return None
+
+    def _engine(self) -> ProvisionerEngine:
+        eng = self._current or self._default_engine()
+        if eng is None:
+            raise RuntimeError("worker has no attached provisioner engine")
+        return eng
+
+    @property
+    def provisioner(self) -> Provisioner:
+        """The Provisioner a direct caller means: the engine currently
+        being served, else the first attached one (the legacy single-
+        provisioner worker's CR)."""
+        return self._engine().provisioner
+
+    @property
+    def pipeline(self) -> SolvePipeline:
+        return self._engine().pipeline
+
+    @property
+    def scheduler(self) -> Scheduler:
+        return self._engine().scheduler
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._run, name=f"provisioner-{self.provisioner.metadata.name}",
-            daemon=True)
+        name = (f"provisioner-shard-{self.shard}" if self.shard
+                else f"provisioner-{self.provisioner.metadata.name}")
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
@@ -120,13 +229,17 @@ class ProvisionerWorker:
                 log.exception("provisioning failed")
 
     # -- API for the selection controller -----------------------------------
-    def add(self, pod: Pod, key=None) -> Optional[threading.Event]:
+    def add(self, pod: Pod, key=None,
+            provisioner: Optional[str] = None) -> Optional[threading.Event]:
         """Enqueue a pod; returns the gate to block on (provisioner.go:80-82)
         or None when brownout admission shed the pod (it re-enters via the
         selection requeue once pressure falls). ``key`` (namespace, name)
-        enables :meth:`pending` de-duplication."""
+        enables :meth:`pending` de-duplication. ``provisioner`` routes the
+        pod to that engine's group within the shard window; None means the
+        default (first attached) engine — the legacy single-tenant call."""
         band, priority = pressure.classify(pod)
-        return self.batcher.add(pod, key=key, band=band, priority=priority)
+        return self.batcher.add((provisioner, pod), key=key, band=band,
+                                priority=priority)
 
     def pending(self, key) -> bool:
         """True while a pod with this (namespace, name) key awaits a batch
@@ -142,67 +255,100 @@ class ProvisionerWorker:
             log.info("batched %d pods in %.2fs", len(items), window)
             # dedupe within the batch: the non-blocking selection path can
             # requeue a still-pending pod into the same window (selection.py
-            # concurrency note); packing it twice would double-count it
+            # concurrency note); packing it twice would double-count it.
+            # Then group by engine, PRESERVING the window's priority order
+            # within each group (dict insertion order) — a critical pod
+            # still lands in its engine's first chunk.
             seen = set()
-            deduped = []
-            for p in items:
+            groups: Dict[Optional[str], List[Pod]] = {}
+            for item in items:
+                pname, p = item
                 key = (p.metadata.namespace, p.metadata.name)
-                if key not in seen:
-                    seen.add(key)
-                    deduped.append(p)
-            pods = [p for p in deduped if self._is_provisionable(p)]
-            # L1+ batch-split: the batcher returns windows in priority
-            # order, so chunking preserves it — critical pods solve and
-            # bind in the FIRST chunk while the tail is still queued, and
-            # each chunk bounds solve p99 under pressure
-            monitor = self.batcher._monitor()
-            split = monitor.config.split_items
-            if int(monitor.level()) >= 1 and 0 < split < len(pods):
-                chunks = [pods[i:i + split]
-                          for i in range(0, len(pods), split)]
-                WINDOW_SPLITS_TOTAL.inc(amount=float(len(chunks) - 1))
-                log.info("pressure L%d: split %d-pod window into %d "
-                         "chunks of <=%d", int(monitor.level()), len(pods),
-                         len(chunks), split)
-            else:
-                # L0: bound chunks to the pipeline's unit size so depth>1
-                # has work to overlap. The SAME boundaries apply at depth 1
-                # — chunking is governed by chunk_items, depth only by the
-                # pipeline — so serial and pipelined runs stay node-for-node
-                # identical (the A/B bench and differential suite rely on it)
-                ci = self.pipeline_config.chunk_items
-                if 0 < ci < len(pods):
-                    chunks = [pods[i:i + ci]
-                              for i in range(0, len(pods), ci)]
-                else:
-                    chunks = [pods]
-            # the pipeline consumes FIFO, so the first chunk still launches
-            # and binds as soon as its solve lands (first-chunk-binds-early)
-            # while the next chunk's solve is already in flight; at L1+ the
-            # effective depth collapses to 1 and this degenerates to the
-            # serial chunk loop
-            self.pipeline.set_monitor(monitor)
-            results = self.pipeline.run(
-                chunks, prepare=self._prepare_chunk,
-                dispatch=self._dispatch_chunk,
-                consume=self._complete_chunk,
-                on_chunk=self._observe_chunk)
+                if key in seen:
+                    continue
+                seen.add(key)
+                groups.setdefault(pname, []).append(p)
             last_result = None
-            for result in results:
+            for pname, pods in groups.items():
+                eng = (self._engines.get(pname) if pname is not None
+                       else self._default_engine())
+                if eng is None:
+                    # provisioner deleted while its pods sat in the window:
+                    # the pods stay Pending and the selection requeue
+                    # re-routes them to a surviving provisioner
+                    log.info("dropping %d pod(s) for detached provisioner "
+                             "%s", len(pods), pname)
+                    continue
+                result = self._provision_group(eng, pods)
                 if result is not None:
                     last_result = result
             return last_result
         finally:
             self.batcher.flush()
 
+    def _provision_group(self, eng: ProvisionerEngine,
+                         pods: List[Pod]) -> Optional[SolveResult]:
+        """Run one engine's share of the window through its pipeline."""
+        pods = [p for p in pods if self._is_provisionable(p)]
+        # L1+ batch-split: the batcher returns windows in priority
+        # order, so chunking preserves it — critical pods solve and
+        # bind in the FIRST chunk while the tail is still queued, and
+        # each chunk bounds solve p99 under pressure
+        monitor = self.batcher._monitor()
+        split = monitor.config.split_items
+        if int(monitor.level()) >= 1 and 0 < split < len(pods):
+            chunks = [pods[i:i + split]
+                      for i in range(0, len(pods), split)]
+            if self.shard:
+                WINDOW_SPLITS_TOTAL.inc(amount=float(len(chunks) - 1),
+                                        shard=self.shard)
+            else:
+                WINDOW_SPLITS_TOTAL.inc(amount=float(len(chunks) - 1))
+            log.info("pressure L%d: split %d-pod window into %d "
+                     "chunks of <=%d", int(monitor.level()), len(pods),
+                     len(chunks), split)
+        else:
+            # L0: bound chunks to the pipeline's unit size so depth>1
+            # has work to overlap. The SAME boundaries apply at depth 1
+            # — chunking is governed by chunk_items, depth only by the
+            # pipeline — so serial and pipelined runs stay node-for-node
+            # identical (the A/B bench and differential suite rely on it)
+            ci = eng.pipeline_config.chunk_items
+            if 0 < ci < len(pods):
+                chunks = [pods[i:i + ci]
+                          for i in range(0, len(pods), ci)]
+            else:
+                chunks = [pods]
+        # the pipeline consumes FIFO, so the first chunk still launches
+        # and binds as soon as its solve lands (first-chunk-binds-early)
+        # while the next chunk's solve is already in flight; at L1+ the
+        # effective depth collapses to 1 and this degenerates to the
+        # serial chunk loop
+        eng.pipeline.set_monitor(monitor)
+        self._current = eng
+        try:
+            results = eng.pipeline.run(
+                chunks, prepare=self._prepare_chunk,
+                dispatch=self._dispatch_chunk,
+                consume=self._complete_chunk,
+                on_chunk=self._observe_chunk)
+        finally:
+            self._current = None
+        last_result = None
+        for result in results:
+            if result is not None:
+                last_result = result
+        return last_result
+
     # -- pipeline stages (one schedule → solve → launch pass per chunk) ------
     def _prepare_chunk(self, pods: List[Pod]) -> _ChunkPrep:
         """Host marshal stage: schedule the chunk and build its packing
         problems. Catalog/daemon I/O stays OUTSIDE the binpacking histogram
         so that measures the solver alone."""
+        eng = self._engine()
         with HISTOGRAMS.time("scheduling_duration_seconds",
-                             provisioner=self.provisioner.metadata.name):
-            schedules = self.scheduler.solve(self.provisioner, pods)
+                             provisioner=eng.provisioner.metadata.name):
+            schedules = eng.scheduler.solve(eng.provisioner, pods)
             problems = [
                 Problem(
                     constraints=s.constraints,
@@ -244,7 +390,7 @@ class ProvisionerWorker:
         # pipeline's win and lands in solver_overlap_seconds_total instead
         HISTOGRAMS.histogram("binpacking_duration_seconds").observe(
             prep.dispatch_s + stats.get("device_s", 0.0),
-            provisioner=self.provisioner.metadata.name)
+            provisioner=self._engine().provisioner.metadata.name)
 
     def _is_provisionable(self, candidate: Pod) -> bool:
         """Fresh read per pod to avoid duplicate binds (provisioner.go:
@@ -270,11 +416,12 @@ class ProvisionerWorker:
     def _launch(self, constraints: Constraints, packing) -> Optional[str]:
         """Limits check + CloudProvider.Create with bind callback
         (provisioner.go:137-157)."""
+        provisioner = self._engine().provisioner
         try:
-            latest = self.kube.get("Provisioner", self.provisioner.metadata.name)
+            latest = self.kube.get("Provisioner", provisioner.metadata.name)
         except NotFound:
             return "provisioner deleted"
-        err = self.provisioner.spec.limits.exceeded_by(latest.status.resources)
+        err = provisioner.spec.limits.exceeded_by(latest.status.resources)
         if err is not None:
             return err
         pods_per_node = list(packing.pods)
@@ -292,12 +439,13 @@ class ProvisionerWorker:
     def _bind(self, node: Node, pods: List[Pod]) -> Optional[str]:
         """Create the node object (finalizer + not-ready taint) and bind pods
         (provisioner.go:159-198)."""
+        provisioner = self._engine().provisioner
         with HISTOGRAMS.time("bind_duration_seconds",
-                             provisioner=self.provisioner.metadata.name):
+                             provisioner=provisioner.metadata.name):
             node.metadata.namespace = ""
             node.metadata.finalizers.append(wellknown.TERMINATION_FINALIZER)
             node.metadata.labels.setdefault(
-                wellknown.PROVISIONER_NAME_LABEL, self.provisioner.metadata.name)
+                wellknown.PROVISIONER_NAME_LABEL, provisioner.metadata.name)
             # prevent the kube scheduler racing our binds (provisioner.go:164-176)
             node.spec.taints.append(Taint(key=wellknown.NOT_READY_TAINT_KEY,
                                           effect="NoSchedule"))
@@ -338,19 +486,26 @@ class ProvisionerWorker:
 
 
 class ProvisioningController:
-    """Reconciles Provisioner CRs into workers (controller.go:44-128)."""
+    """Reconciles Provisioner CRs into workers (controller.go:44-128).
+
+    ``shards=0`` (default): one worker per Provisioner, the reference's
+    shape. ``shards=N``: N long-lived shard workers; each Provisioner's
+    engine attaches to shard ``crc32(name) % N`` (docs/scale.md §1)."""
 
     REQUEUE_SECONDS = 5 * 60  # catch zone/type drift (controller.go:82-83)
 
     def __init__(self, kube: KubeCore, cloud_provider: CloudProvider,
                  solver_config: Optional[SolverConfig] = None,
                  batcher_factory: Optional[Callable[[], Batcher]] = None,
-                 pipeline_config: Optional[PipelineConfig] = None):
+                 pipeline_config: Optional[PipelineConfig] = None,
+                 shards: int = 0):
         self.kube = kube
         self.cloud_provider = cloud_provider
         self.solver_config = solver_config
         self.pipeline_config = pipeline_config
         self.batcher_factory = batcher_factory or Batcher
+        self.shards = int(shards or 0)
+        # legacy: provisioner name → its worker; sharded: "shard-i" → worker
         self.workers: Dict[str, ProvisionerWorker] = {}
         self._hashes: Dict[str, tuple] = {}
         self._lock = threading.Lock()
@@ -358,13 +513,52 @@ class ProvisioningController:
     def kind(self) -> str:
         return "Provisioner"
 
+    def targets(self) -> List[Tuple[Provisioner, ProvisionerWorker]]:
+        """Routing snapshot for the selection controller: every hosted
+        (provisioner, worker) pair across all workers, in worker-creation
+        then engine-attach order (deterministic — selection's first-match
+        semantics depend on a stable iteration order). Works identically
+        for both deployment shapes; legacy workers host exactly one
+        engine, so this reduces to the old per-worker iteration."""
+        with self._lock:
+            workers = list(self.workers.values())
+        out = []
+        for w in workers:
+            for eng in w.engines():
+                out.append((eng.provisioner, w))
+        return out
+
+    def _shard_worker(self, name: str) -> ProvisionerWorker:
+        """Get-or-create the shard worker hosting ``name``'s engine.
+        Caller holds self._lock."""
+        sid = shard_of(name, self.shards)
+        wname = f"shard-{sid}"
+        worker = self.workers.get(wname)
+        if worker is None:
+            worker = ProvisionerWorker(
+                None, self.kube, self.cloud_provider,
+                solver_config=self.solver_config,
+                batcher=self.batcher_factory(),
+                pipeline_config=self.pipeline_config,
+                shard=str(sid))
+            worker.start()
+            self.workers[wname] = worker
+        return worker
+
     def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
         try:
             provisioner = self.kube.get("Provisioner", name, namespace)
         except NotFound:
             with self._lock:
-                worker = self.workers.pop(name, None)
                 self._hashes.pop(name, None)
+                if self.shards > 0:
+                    # the shard worker outlives any one tenant: detach the
+                    # engine, keep the shard serving its other provisioners
+                    w = self.workers.get(f"shard-{shard_of(name, self.shards)}")
+                    if w is not None:
+                        w.detach(name)
+                    return None
+                worker = self.workers.pop(name, None)
             if worker:
                 worker.stop()
             return None
@@ -380,16 +574,22 @@ class ProvisioningController:
         key = _spec_hash(provisioner)
         with self._lock:
             if self._hashes.get(name) != key:
-                old = self.workers.get(name)
-                if old:
-                    old.stop()
-                worker = ProvisionerWorker(
-                    provisioner, self.kube, self.cloud_provider,
-                    solver_config=self.solver_config,
-                    batcher=self.batcher_factory(),
-                    pipeline_config=self.pipeline_config)
-                worker.start()
-                self.workers[name] = worker
+                if self.shards > 0:
+                    # attach replaces the engine in place; the shard worker,
+                    # its thread, and its batcher (queued pods included)
+                    # survive the spec change
+                    self._shard_worker(name).attach(provisioner)
+                else:
+                    old = self.workers.get(name)
+                    if old:
+                        old.stop()
+                    worker = ProvisionerWorker(
+                        provisioner, self.kube, self.cloud_provider,
+                        solver_config=self.solver_config,
+                        batcher=self.batcher_factory(),
+                        pipeline_config=self.pipeline_config)
+                    worker.start()
+                    self.workers[name] = worker
                 self._hashes[name] = key
         # conditions refresh EVERY reconcile, including the unchanged-spec
         # steady state: solver health moves between spec changes, and a

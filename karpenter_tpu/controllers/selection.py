@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.api import wellknown
@@ -26,6 +27,23 @@ from karpenter_tpu.utils import pod as podutil
 log = logging.getLogger("karpenter.selection")
 
 RELAXATION_TTL_SECONDS = 5 * 60  # preferences.go ExpirationTTL
+
+# requeue jitter spread: factor in [1-J/2, 1+J/2) — wide enough that a
+# mass-shed cohort's retries smear across ~2.5 s at the 5 s base, narrow
+# enough that backoff tiers (5/10/20 s) never overlap
+JITTER_SPREAD = 0.5
+
+
+def requeue_jitter(key) -> float:
+    """Deterministic per-pod jitter factor in [0.75, 1.25): crc32 of the
+    (namespace, name) key mapped onto the spread. Stateless and hash-based
+    so the same pod always lands on the same offset (reproducible under
+    seeded chaos) while DIFFERENT pods spread uniformly — which is what
+    de-synchronizes a mass shed's retry wave. key=None → 1.0 (no jitter)."""
+    if key is None:
+        return 1.0
+    h = zlib.crc32(f"{key[0]}/{key[1]}".encode())
+    return 1.0 - JITTER_SPREAD / 2 + JITTER_SPREAD * (h / 2 ** 32)
 
 
 def is_provisionable(p: Pod) -> bool:
@@ -223,7 +241,7 @@ class SelectionController:
         # controller's lock; iterating it live can see a resize mid-scan
         if any(w.pending(key)
                for w in list(self.provisioning.workers.values())):
-            return self.REQUEUE_SECONDS
+            return self._requeue_seconds(key)
         try:
             pod = self.kube.get("Pod", name, namespace)
         except NotFound:
@@ -237,19 +255,28 @@ class SelectionController:
         err = self._select_provisioner(pod)
         if err is not None:
             log.debug("could not schedule pod %s: %s", name, err)
-        return self._requeue_seconds()
+        return self._requeue_seconds((namespace, name))
 
-    def _requeue_seconds(self) -> float:
+    def _requeue_seconds(self, key=None) -> float:
         """Pressure-aware requeue backoff: at L2+ the shed population's
         5 s retry storm is itself intake load, so back off (the pods are
         Pending either way — a slower retry only delays re-admission, it
-        never loses a pod)."""
+        never loses a pod).
+
+        The backoff is jittered per pod (±25%, deterministic in the pod
+        key): an L2/L3 mass shed stamps thousands of pods with the SAME
+        requeue delay, and without jitter they all re-enter intake on one
+        tick — the retry wave itself re-spikes queue depth and re-trips
+        the ladder (thundering herd). Hash-based rather than random so a
+        given pod's retry cadence is reproducible under seeded chaos."""
         level = int(get_monitor().level())
         if level >= 3:
-            return self.REQUEUE_SECONDS * 4
-        if level >= 2:
-            return self.REQUEUE_SECONDS * 2
-        return self.REQUEUE_SECONDS
+            base = self.REQUEUE_SECONDS * 4
+        elif level >= 2:
+            base = self.REQUEUE_SECONDS * 2
+        else:
+            base = self.REQUEUE_SECONDS
+        return base * requeue_jitter(key)
 
     def _select_provisioner(self, pod: Pod) -> Optional[str]:
         """controller.go:84-111: relax → volume topology → first matching
@@ -259,31 +286,38 @@ class SelectionController:
             self.volume_topology.inject(pod)
         except NotFound as e:
             return f"getting volume topology requirements: {e}"
-        workers = list(self.provisioning.workers.values())
-        if not workers:
+        # targets() snapshots every (provisioner, worker) routing pair in
+        # deterministic order — in the sharded deployment one worker hosts
+        # several provisioners, so routing iterates provisioners, not
+        # workers, and hands the chosen provisioner's name to add() so the
+        # shard window groups the pod under the right engine
+        targets = self.provisioning.targets()
+        if not targets:
             return None
         errs = []
-        chosen = None
-        for worker in workers:
-            # columnar: the compiled bitset engine is cached on the worker's
+        chosen = chosen_worker = None
+        for provisioner, worker in targets:
+            # columnar: the compiled bitset engine is cached on the
             # long-lived constraints object, so the 10k-reconcile flood pays
             # a memoized signature lookup per (provisioner, pod shape)
             # instead of the full scalar requirement walk per reconcile
             err = feasibility.validate_pod_fast(
-                worker.provisioner.spec.constraints, pod)
+                provisioner.spec.constraints, pod)
             if err is None:
-                chosen = worker
+                chosen, chosen_worker = provisioner, worker
                 break
-            errs.append(f"tried provisioner/{worker.provisioner.metadata.name}: {err}")
+            errs.append(f"tried provisioner/{provisioner.metadata.name}: {err}")
         if chosen is None:
             return f"matched 0/{len(errs)} provisioners: " + "; ".join(errs)
-        gate = chosen.add(pod, key=(pod.metadata.namespace, pod.metadata.name))
+        gate = chosen_worker.add(
+            pod, key=(pod.metadata.namespace, pod.metadata.name),
+            provisioner=chosen.metadata.name)
         if gate is None:
             # shed at admission (pressure level or depth bound) — already
             # counted by the batcher; the requeue retries once pressure
             # falls, so a shed is a delay, never a loss
             return (f"shed at intake by provisioner/"
-                    f"{chosen.provisioner.metadata.name} (pressure)")
+                    f"{chosen.metadata.name} (pressure)")
         if self.gate_timeout > 0:
             gate.wait(timeout=self.gate_timeout)
         return None

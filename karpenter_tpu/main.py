@@ -97,7 +97,11 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
             idle_seconds=options.batch_idle_seconds,
             max_seconds=options.batch_max_seconds,
             max_items=options.batch_max_items,
-            max_depth=options.pressure_max_depth))
+            max_depth=options.pressure_max_depth),
+        # horizontal shards (docs/scale.md §1): N long-lived intake/solve
+        # workers with provisioners hashed across them; 0 keeps the
+        # reference's one-worker-per-Provisioner shape
+        shards=options.provisioning_shards)
     manager = Manager(kube)
     manager.register(provisioning)
     # worker pools are clamped to the host's cores (utils/workers.py): the

@@ -17,6 +17,37 @@ Semantics implemented:
 - Field index on pod spec.nodeName (manager.go:39-43) for O(1)
   pods-on-node lookups used by emptiness/termination/metrics.
 - Binding subresource for pods (bind() in provisioner.go:189-195).
+
+Concurrency model (docs/scale.md §2 — the store under the sharded control
+plane):
+
+- **Lock striping by kind.** Objects live in per-kind stripes, each with
+  its own RLock; a stripe's dict IS the by-kind index, so list/scan of a
+  kind touches only that kind's objects (the old single-RLock store paid
+  an O(all-objects) scan per list-by-kind AND serialized every reader
+  behind every writer of any kind).
+- **Lock order.** Multi-stripe operations (``watch(kind=None)`` initial
+  replay; the eviction subresource, which reads PodDisruptionBudgets
+  while deleting a Pod) acquire stripes in sorted stripe-key order, and
+  resolve every stripe object BEFORE acquiring any stripe lock. The
+  stripe-creation guard (``_stripes_guard``) is therefore never acquired
+  while a stripe lock is held — the one rule that makes the hierarchy
+  guard → stripes(sorted) → watcher list acyclic.
+- **Copy-on-write watcher list.** ``watch``/``unwatch`` REPLACE
+  ``_watchers`` under ``_watch_lock``; ``_notify`` iterates a snapshot
+  reference without any lock, so event fan-out never blocks stripe
+  traffic. A watcher registered mid-write observes either the pre- or the
+  post-state of the in-flight object, never a torn one (registration runs
+  under the subject stripe's lock, writes mutate under the same lock).
+- **resourceVersion.** One shared atomic counter (``itertools.count`` —
+  a single CPython bytecode, safe under the GIL): versions stay globally
+  monotonic, but event ORDER across different kinds is not defined — the
+  same contract a real apiserver gives across resource types.
+
+:class:`NaiveKubeCore` preserves the pre-striping layout (one lock, one
+dict, full scan per list-by-kind) as the semantic reference for the
+differential suite (tests/test_kubecore_store.py) and as the honest
+"naive" leg of the store A/B bench (bench.py config_9 / make bench-replay).
 """
 
 from __future__ import annotations
@@ -25,6 +56,7 @@ import copy  # noqa: F401 — external callers may rely on module parity
 import itertools
 import queue
 import threading
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -118,14 +150,40 @@ def _key(obj) -> Key:
     return (obj.kind, obj.metadata.namespace, obj.metadata.name)
 
 
+class _Stripe:
+    """One kind's slice of the store: its lock and its objects. The dict
+    doubles as the by-kind index — membership in the stripe IS kind
+    equality (striped mode), so list-by-kind never filters."""
+
+    __slots__ = ("key", "lock", "objects")
+
+    def __init__(self, key: str):
+        self.key = key
+        self.lock = threading.RLock()
+        self.objects: Dict[Key, object] = {}
+
+
 class KubeCore:
-    """Threadsafe in-memory object store with API-server semantics."""
+    """Threadsafe in-memory object store with API-server semantics.
+
+    Striped by kind (see the module docstring's concurrency model); set
+    the class attribute ``STRIPED = False`` (:class:`NaiveKubeCore`) to
+    collapse every kind into one stripe with full-scan lists — the
+    pre-striping reference layout."""
+
+    STRIPED = True
 
     def __init__(self):
-        self._lock = threading.RLock()
-        self._objects: Dict[Key, object] = {}
+        self._striped = bool(self.STRIPED)
+        # stripe map: created on first touch of a kind, never removed.
+        # _stripes_guard orders stripe creation against the watch(None)
+        # world-snapshot; plain dict reads are the lock-free fast path
+        # (stripes are add-only, and dict get is atomic under the GIL).
+        self._stripes: Dict[str, _Stripe] = {}
+        self._stripes_guard = threading.Lock()
         self._rv = itertools.count(1)
         self._uid = itertools.count(1)
+        self._watch_lock = threading.Lock()
         self._watchers: List[
             Tuple[Optional[str], "queue.Queue[Event]", bool]] = []
         # the spec.nodeName field index (manager.go:39-43): node name → pod
@@ -134,22 +192,73 @@ class KubeCore:
         # reconcile per node and would otherwise scan the world each time.
         # Inner dicts are ordered sets: iteration keeps insertion order so
         # drain/eviction order stays deterministic across runs.
+        # Only ever touched under the Pod stripe's lock.
         self._pods_by_node: Dict[str, Dict[Key, None]] = {}
         # namespace indexes for the eviction subresource: PDB lookup and the
         # healthy-pod count previously scanned EVERY stored object under the
         # global lock per eviction — a drain of a 100-pod node paid 100 full
         # scans while blocking all concurrent API traffic. Namespace
         # membership is fixed at create (it's part of the key), so these only
-        # update on create/delete.
+        # update on create/delete. Pod index under the Pod stripe lock, PDB
+        # index under the PodDisruptionBudget stripe lock.
         self._pods_by_namespace: Dict[str, Dict[Key, None]] = {}
         self._pdbs_by_namespace: Dict[str, Dict[Key, None]] = {}
+
+    # -- stripes -------------------------------------------------------------
+    def _skey(self, kind: str) -> str:
+        return kind if self._striped else ""
+
+    def _stripe(self, kind: str) -> _Stripe:
+        skey = self._skey(kind)
+        s = self._stripes.get(skey)
+        if s is None:
+            with self._stripes_guard:
+                s = self._stripes.setdefault(skey, _Stripe(skey))
+        return s
+
+    @contextmanager
+    def _multi_stripe(self, *kinds: str):
+        """Acquire the stripes for ``kinds`` in sorted stripe-key order
+        (deduped — naive mode maps every kind to the one stripe). All
+        stripe objects are resolved BEFORE any lock is taken, upholding
+        the no-guard-under-stripe-lock rule."""
+        by_key = {}
+        for kind in kinds:
+            s = self._stripe(kind)
+            by_key[s.key] = s
+        ordered = [by_key[k] for k in sorted(by_key)]
+        for s in ordered:
+            s.lock.acquire()
+        try:
+            yield
+        finally:
+            for s in reversed(ordered):
+                s.lock.release()
+
+    @contextmanager
+    def _world(self):
+        """Every existing stripe, locked in sorted order, with stripe
+        creation blocked (guard held) — the watch(kind=None) initial-replay
+        snapshot. A create of a brand-new kind waits on the guard until
+        the watcher is registered, so its ADDED cannot be lost between the
+        replay and the registration."""
+        with self._stripes_guard:
+            ordered = [self._stripes[k] for k in sorted(self._stripes)]
+            for s in ordered:
+                s.lock.acquire()
+            try:
+                yield ordered
+            finally:
+                for s in reversed(ordered):
+                    s.lock.release()
 
     # -- helpers ------------------------------------------------------------
     def _next_rv(self) -> int:
         return next(self._rv)
 
     def _reindex(self, key: Key, old, new) -> None:
-        """Maintain the nodeName and namespace indexes across any mutation."""
+        """Maintain the nodeName and namespace indexes across any mutation.
+        Caller holds the subject kind's stripe lock."""
         kind, ns = key[0], key[1]
         if kind == "PodDisruptionBudget":
             self._ns_index(self._pdbs_by_namespace, ns, key, old, new)
@@ -185,9 +294,10 @@ class KubeCore:
                     del index[ns]
 
     def _notify(self, event_type: str, obj) -> None:
-        # safe with or without self._lock held: _watchers is copy-on-write
-        # (watch/unwatch REPLACE the list under the lock, never mutate it),
-        # so iterating a snapshot reference cannot see a resize
+        # safe with or without any stripe lock held: _watchers is
+        # copy-on-write (watch/unwatch REPLACE the list under _watch_lock,
+        # never mutate it), so iterating a snapshot reference cannot see a
+        # resize
         meta = None
         for kind, q, meta_only in self._watchers:
             if kind is None or kind == obj.kind:
@@ -205,62 +315,88 @@ class KubeCore:
         """Subscribe to events for a kind (None = all). Existing objects are
         replayed as ADDED, matching informer initial-list semantics.
         ``meta_only`` delivers :class:`MetaObj` stubs (kind + name/namespace)
-        instead of deep copies — for subscribers that only enqueue keys."""
+        instead of deep copies — for subscribers that only enqueue keys.
+
+        Registration is atomic with the replay against the subject
+        stripe(s): the watcher holds the stripe lock (or the world snapshot
+        for kind=None) across replay + registration, so a concurrent write
+        lands either in the replay OR as a later event — never lost, never
+        torn."""
         q: "queue.Queue[Event]" = queue.Queue()
-        with self._lock:
-            for obj in self._objects.values():
+
+        def _replay(objects) -> None:
+            for obj in objects:
                 if kind is None or obj.kind == kind:
                     stub = (MetaObj(obj.kind, obj.metadata.name,
                                     obj.metadata.namespace)
                             if meta_only else deep_copy(obj))
                     q.put(Event("ADDED", stub))
-            # copy-on-write (see _notify)
-            self._watchers = self._watchers + [(kind, q, meta_only)]
+
+        if kind is None:
+            with self._world() as stripes:
+                for s in stripes:
+                    _replay(s.objects.values())
+                with self._watch_lock:
+                    self._watchers = self._watchers + [(kind, q, meta_only)]
+        else:
+            s = self._stripe(kind)
+            with s.lock:
+                _replay(s.objects.values())
+                with self._watch_lock:
+                    self._watchers = self._watchers + [(kind, q, meta_only)]
         return q
 
     def unwatch(self, q) -> None:
-        with self._lock:
+        with self._watch_lock:
             self._watchers = [w for w in self._watchers if w[1] is not q]
 
     # -- CRUD ---------------------------------------------------------------
     def create(self, obj):
-        with self._lock:
+        s = self._stripe(obj.kind)
+        with s.lock:
             k = _key(obj)
-            if k in self._objects:
+            if k in s.objects:
                 raise AlreadyExists(f"{k} already exists")
             obj = deep_copy(obj)
             obj.metadata.resource_version = self._next_rv()
             obj.metadata.uid = obj.metadata.uid or f"uid-{next(self._uid)}"
             if obj.metadata.creation_timestamp is None:
                 obj.metadata.creation_timestamp = clock.now()
-            self._objects[k] = obj
+            s.objects[k] = obj
             self._reindex(k, None, obj)
             self._notify("ADDED", obj)
             return deep_copy(obj)
 
     def get(self, kind: str, name: str, namespace: str = "default"):
-        with self._lock:
-            obj = self._objects.get((kind, namespace, name))
+        s = self._stripe(kind)
+        with s.lock:
+            obj = s.objects.get((kind, namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             return deep_copy(obj)
 
     def scan(self, kind: str, fn) -> List:
-        """Apply ``fn`` to every live object of ``kind`` under the store
-        lock, WITHOUT copying, and return the results. The informer-cache
-        read analog (controller-runtime reads list from the shared cache):
-        ``fn`` must treat the object as read-only and must not retain it.
-        Exists because deep-copying a 10k-pod list per poll costs seconds —
-        three orders more than extracting one field from each."""
-        with self._lock:
-            return [fn(obj) for (k, _, _), obj in self._objects.items()
+        """Apply ``fn`` to every live object of ``kind`` under the kind's
+        stripe lock, WITHOUT copying, and return the results. The informer-
+        cache read analog (controller-runtime reads list from the shared
+        cache): ``fn`` must treat the object as read-only and must not
+        retain it. Exists because deep-copying a 10k-pod list per poll
+        costs seconds — three orders more than extracting one field from
+        each. Striped mode iterates ONLY this kind's objects; the naive
+        layout scans the whole store and filters."""
+        s = self._stripe(kind)
+        with s.lock:
+            if self._striped:
+                return [fn(obj) for obj in s.objects.values()]
+            return [fn(obj) for (k, _, _), obj in s.objects.items()
                     if k == kind]
 
     def read(self, kind: str, name: str, namespace: str, fn):
-        """Apply ``fn`` to one live object under the lock (no copy); raises
-        NotFound. Same read-only contract as :meth:`scan`."""
-        with self._lock:
-            obj = self._objects.get((kind, namespace, name))
+        """Apply ``fn`` to one live object under the stripe lock (no copy);
+        raises NotFound. Same read-only contract as :meth:`scan`."""
+        s = self._stripe(kind)
+        with s.lock:
+            obj = s.objects.get((kind, namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             return fn(obj)
@@ -273,22 +409,22 @@ class KubeCore:
         field: Optional[Tuple[str, str]] = None,
     ) -> List:
         """List objects. ``field`` supports the spec.nodeName pod index."""
-        with self._lock:
+        s = self._stripe(kind)
+        with s.lock:
             if field is not None:
                 fname, fval = field
                 if fname != "spec.nodeName":
                     raise ApiError(f"unsupported field selector {fname}")
                 if kind == "Pod":
-                    # indexed path: only this node's pods are touched
-                    candidates = [self._objects[key] for key in
+                    # indexed path: only this node's pods are touched (the
+                    # index holds Pod keys, which live in this stripe)
+                    candidates = [s.objects[key] for key in
                                   self._pods_by_node.get(fval, ())]
                 else:
-                    candidates = [o for (k, _, _), o in self._objects.items()
-                                  if k == kind and
-                                  getattr(o.spec, "node_name", None) == fval]
+                    candidates = [o for o in self._kind_objects(s, kind)
+                                  if getattr(o.spec, "node_name", None) == fval]
             else:
-                candidates = [o for (k, _, _), o in self._objects.items()
-                              if k == kind]
+                candidates = self._kind_objects(s, kind)
             out = []
             for obj in candidates:
                 if namespace is not None and obj.metadata.namespace != namespace:
@@ -298,12 +434,21 @@ class KubeCore:
                 out.append(deep_copy(obj))
             return out
 
+    def _kind_objects(self, s: _Stripe, kind: str) -> List:
+        """All live objects of ``kind`` (caller holds the stripe lock).
+        Striped: the stripe IS the kind. Naive: the O(all-objects) scan
+        the striped layout exists to remove."""
+        if self._striped:
+            return list(s.objects.values())
+        return [o for (k, _, _), o in s.objects.items() if k == kind]
+
     def update(self, obj):
         """Full update with optimistic concurrency; finalizer-empty deleted
         objects are removed."""
-        with self._lock:
+        s = self._stripe(obj.kind)
+        with s.lock:
             k = _key(obj)
-            stored = self._objects.get(k)
+            stored = s.objects.get(k)
             if stored is None:
                 raise NotFound(f"{k} not found")
             if obj.metadata.resource_version != stored.metadata.resource_version:
@@ -315,20 +460,21 @@ class KubeCore:
             obj.metadata.deletion_timestamp = stored.metadata.deletion_timestamp
             obj.metadata.resource_version = self._next_rv()
             if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
-                del self._objects[k]
+                del s.objects[k]
                 self._reindex(k, stored, None)
                 self._notify("DELETED", obj)
                 return deep_copy(obj)
-            self._objects[k] = obj
+            s.objects[k] = obj
             self._reindex(k, stored, obj)
             self._notify("MODIFIED", obj)
             return deep_copy(obj)
 
     def patch(self, kind: str, name: str, namespace: str, fn: Callable[[object], None]):
         """Read-modify-write with retry-free server-side apply semantics:
-        fn mutates the live copy under the store lock."""
-        with self._lock:
-            stored = self._objects.get((kind, namespace, name))
+        fn mutates the live copy under the stripe lock."""
+        s = self._stripe(kind)
+        with s.lock:
+            stored = s.objects.get((kind, namespace, name))
             if stored is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             obj = deep_copy(stored)
@@ -336,11 +482,11 @@ class KubeCore:
             obj.metadata.deletion_timestamp = stored.metadata.deletion_timestamp
             obj.metadata.resource_version = self._next_rv()
             if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
-                del self._objects[(kind, namespace, name)]
+                del s.objects[(kind, namespace, name)]
                 self._reindex((kind, namespace, name), stored, None)
                 self._notify("DELETED", obj)
                 return deep_copy(obj)
-            self._objects[(kind, namespace, name)] = obj
+            s.objects[(kind, namespace, name)] = obj
             self._reindex((kind, namespace, name), stored, obj)
             self._notify("MODIFIED", obj)
             return deep_copy(obj)
@@ -351,39 +497,49 @@ class KubeCore:
         ``precondition_rv``: DeleteOptions.preconditions.resourceVersion —
         the delete conflicts unless the live object still carries exactly
         this resourceVersion (apiserver optimistic-delete semantics)."""
-        with self._lock:
-            k = (kind, namespace, name)
-            stored = self._objects.get(k)
-            if stored is None:
-                raise NotFound(f"{kind} {namespace}/{name} not found")
-            if precondition_rv is not None and \
-                    str(stored.metadata.resource_version) != str(precondition_rv):
-                raise Conflict(
-                    f"{kind} {namespace}/{name}: delete precondition failed "
-                    f"(resourceVersion {stored.metadata.resource_version} "
-                    f"!= {precondition_rv})")
-            if stored.metadata.finalizers:
-                if stored.metadata.deletion_timestamp is None:
-                    # k8s semantics: deletionTimestamp = request time + the
-                    # pod's grace period (a FUTURE time) — termination's
-                    # IsStuckTerminating compares against exactly this
-                    grace = getattr(getattr(stored, "spec", None),
-                                    "termination_grace_period_seconds", 0) or 0
-                    stored.metadata.deletion_timestamp = clock.now() + grace
-                    stored.metadata.resource_version = self._next_rv()
-                    self._notify("MODIFIED", stored)
-                return deep_copy(stored)
-            del self._objects[k]
-            self._reindex(k, stored, None)
-            self._notify("DELETED", stored)
+        s = self._stripe(kind)
+        with s.lock:
+            return self._delete_locked(s, kind, name, namespace,
+                                       precondition_rv)
+
+    def _delete_locked(self, s: _Stripe, kind: str, name: str,
+                       namespace: str, precondition_rv):
+        """Delete body; caller holds ``s``'s lock (the eviction subresource
+        calls this with the Pod + PDB stripes already held, so the
+        PDB-check-then-delete stays one atomic step)."""
+        k = (kind, namespace, name)
+        stored = s.objects.get(k)
+        if stored is None:
+            raise NotFound(f"{kind} {namespace}/{name} not found")
+        if precondition_rv is not None and \
+                str(stored.metadata.resource_version) != str(precondition_rv):
+            raise Conflict(
+                f"{kind} {namespace}/{name}: delete precondition failed "
+                f"(resourceVersion {stored.metadata.resource_version} "
+                f"!= {precondition_rv})")
+        if stored.metadata.finalizers:
+            if stored.metadata.deletion_timestamp is None:
+                # k8s semantics: deletionTimestamp = request time + the
+                # pod's grace period (a FUTURE time) — termination's
+                # IsStuckTerminating compares against exactly this
+                grace = getattr(getattr(stored, "spec", None),
+                                "termination_grace_period_seconds", 0) or 0
+                stored.metadata.deletion_timestamp = clock.now() + grace
+                stored.metadata.resource_version = self._next_rv()
+                self._notify("MODIFIED", stored)
             return deep_copy(stored)
+        del s.objects[k]
+        self._reindex(k, stored, None)
+        self._notify("DELETED", stored)
+        return deep_copy(stored)
 
     # -- subresources -------------------------------------------------------
     def bind_pod(self, pod: Pod, node_name: str) -> None:
         """Binding subresource: sets spec.nodeName exactly once."""
-        with self._lock:
+        s = self._stripe("Pod")
+        with s.lock:
             k = ("Pod", pod.metadata.namespace, pod.metadata.name)
-            stored = self._objects.get(k)
+            stored = s.objects.get(k)
             if stored is None:
                 raise NotFound(f"pod {k} not found")
             if stored.spec.node_name:
@@ -401,10 +557,11 @@ class KubeCore:
         pods are bound and notified exactly as bind_pod would."""
         errs: List[str] = []
         bound: List[object] = []
-        with self._lock:
+        s = self._stripe("Pod")
+        with s.lock:
             for pod in pods:
                 k = ("Pod", pod.metadata.namespace, pod.metadata.name)
-                stored = self._objects.get(k)
+                stored = s.objects.get(k)
                 if stored is None:
                     errs.append(f"pod {k} not found")
                     continue
@@ -451,16 +608,21 @@ class KubeCore:
         both on one PDB is the upstream validation error and 500s.
 
         Both the PDB lookup and the healthy count walk the namespace
-        indexes (``_pdbs_by_namespace`` / ``_pods_by_namespace``) — this
-        runs under the global store lock, and the previous full-store scan
-        made every eviction O(all objects) for the whole API.
-        """
-        with self._lock:
-            pod = self._objects.get(("Pod", namespace, name))
+        indexes (``_pdbs_by_namespace`` / ``_pods_by_namespace``).
+
+        Cross-stripe op: the check-then-delete must be one atomic step or
+        two concurrent evictions could both pass the budget check and
+        jointly breach minAvailable — so the Pod AND PodDisruptionBudget
+        stripes are held together, acquired in sorted stripe-key order
+        (the documented lock order, docs/scale.md §2)."""
+        pod_stripe = self._stripe("Pod")
+        pdb_stripe = self._stripe("PodDisruptionBudget")
+        with self._multi_stripe("Pod", "PodDisruptionBudget"):
+            pod = pod_stripe.objects.get(("Pod", namespace, name))
             if pod is not None:
                 matching = []
                 for pk in self._pdbs_by_namespace.get(namespace, ()):
-                    o = self._objects[pk]
+                    o = pdb_stripe.objects[pk]
                     if o.selector is not None and \
                             o.selector.matches(pod.metadata.labels):
                         matching.append(o)
@@ -481,7 +643,7 @@ class KubeCore:
                     pdb = matching[0]
                     expected = healthy = 0
                     for pk in self._pods_by_namespace.get(namespace, ()):
-                        o = self._objects[pk]
+                        o = pod_stripe.objects[pk]
                         if not pdb.selector.matches(o.metadata.labels):
                             continue
                         expected += 1
@@ -506,11 +668,22 @@ class KubeCore:
                             f"pod {namespace}/{name}: eviction would "
                             f"violate PDB {pdb.metadata.name} "
                             f"({healthy} healthy, {desired} required)")
-            # delete INSIDE the lock (RLock re-entry): releasing between the
+            # delete with both stripes still held: releasing between the
             # PDB check and the delete would let two concurrent evictions
             # both pass the check and jointly breach minAvailable
-            self.delete("Pod", name, namespace)
+            self._delete_locked(pod_stripe, "Pod", name, namespace, None)
 
     # -- convenience indexes -------------------------------------------------
     def pods_on_node(self, node_name: str) -> List[Pod]:
         return self.list("Pod", namespace=None, field=("spec.nodeName", node_name))
+
+
+class NaiveKubeCore(KubeCore):
+    """The pre-striping store layout: every kind in ONE stripe behind one
+    RLock, list/scan-by-kind as an O(all-objects) filter. Identical API
+    semantics — kept as the reference implementation the differential
+    suite (tests/test_kubecore_store.py) compares the striped store
+    against, and as the honest naive leg of the store A/B bench
+    (bench.py config_9)."""
+
+    STRIPED = False

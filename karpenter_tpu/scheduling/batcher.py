@@ -36,7 +36,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from karpenter_tpu.metrics.pressure import PODS_SHED_TOTAL
+from karpenter_tpu.metrics.pressure import INTAKE_QUEUE_DEPTH, PODS_SHED_TOTAL
 from karpenter_tpu.pressure import bands as _bands
 from karpenter_tpu.pressure.bands import BANDS, RANK
 
@@ -79,6 +79,10 @@ class Batcher:
         self.max_items = max_items
         self.max_depth = max_depth
         self._monitor_obj = monitor
+        # shard label for intake metrics ("" = unsharded: emit the legacy
+        # unlabeled series so existing exact-label-tuple lookups hold; the
+        # monitor's aggregate intake_queue_depth stays unlabeled either way)
+        self.shard = ""
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._entries: List[_Entry] = []
@@ -123,7 +127,16 @@ class Batcher:
 
     def _count_shed_locked(self, reason: str, band: str) -> None:
         self.shed[(reason, band)] = self.shed.get((reason, band), 0) + 1
-        PODS_SHED_TOTAL.inc(reason=reason, priority_band=band)
+        if self.shard:
+            PODS_SHED_TOTAL.inc(reason=reason, priority_band=band,
+                                shard=self.shard)
+        else:
+            PODS_SHED_TOTAL.inc(reason=reason, priority_band=band)
+
+    def _note_depth(self, monitor, depth: int) -> None:
+        monitor.note_depth(id(self), depth)
+        if self.shard:
+            INTAKE_QUEUE_DEPTH.set(float(depth), shard=self.shard)
 
     def shed_total(self, band: Optional[str] = None) -> int:
         with self._lock:
@@ -181,7 +194,7 @@ class Batcher:
                 gate = self._gate
                 depth = len(self._entries)
                 self._cv.notify()
-        monitor.note_depth(id(self), depth)
+        self._note_depth(monitor, depth)
         return None if reason is not None else gate
 
     def _displace_locked(self, now: float, monitor) -> None:
@@ -286,7 +299,7 @@ class Batcher:
                     self._first_seen.pop(e.key, None)
             self.consumed_total += len(take)
             depth = len(self._entries)
-        monitor.note_depth(id(self), depth)
+        self._note_depth(monitor, depth)
         window = now - start
         monitor.note_window(window)
         return [e.item for e in take], window

@@ -330,9 +330,13 @@ class SolvePipeline:
     and the ring buffers it reuses are only warm while the instance (and
     the process ring) persists."""
 
-    def __init__(self, config: Optional[PipelineConfig] = None, monitor=None):
+    def __init__(self, config: Optional[PipelineConfig] = None, monitor=None,
+                 shard: str = ""):
         self.config = config or PipelineConfig()
         self._monitor = monitor
+        # per-shard stage labels ("" = legacy unlabeled series, so existing
+        # exact-label-tuple metric lookups keep working unsharded)
+        self._slabels = {"shard": shard} if shard else {}
         self._adaptive = (_AdaptiveDepth(self.config.depth,
                                          self.config.max_depth)
                           if self.config.adaptive else None)
@@ -409,7 +413,8 @@ class SolvePipeline:
                 handle = dispatch(prep)
                 t1 = time.perf_counter()
                 stats = {"marshal_s": t1 - t0}
-                PIPELINE_STAGE_SECONDS.observe(t1 - t0, stage="marshal")
+                PIPELINE_STAGE_SECONDS.observe(t1 - t0, stage="marshal",
+                                               **self._slabels)
                 inflight.append((prep, handle, t1, stats))
             while inflight:
                 self._complete(inflight.popleft(), consume, outs, on_chunk)
@@ -434,8 +439,10 @@ class SolvePipeline:
         t2 = time.perf_counter()
         stats["device_s"] = t1 - t0
         stats["launch_bind_s"] = t2 - t1
-        PIPELINE_STAGE_SECONDS.observe(t1 - t0, stage="device")
-        PIPELINE_STAGE_SECONDS.observe(t2 - t1, stage="launch_bind")
+        PIPELINE_STAGE_SECONDS.observe(t1 - t0, stage="device",
+                                       **self._slabels)
+        PIPELINE_STAGE_SECONDS.observe(t2 - t1, stage="launch_bind",
+                                       **self._slabels)
         if on_chunk is not None:
             on_chunk(prep, stats)
         outs.append(out)

@@ -1,0 +1,506 @@
+"""Differential + concurrency suite for the striped kube store.
+
+The striped, by-kind-indexed KubeCore (runtime/kubecore.py) must be
+semantically IDENTICAL to the pre-striping single-lock layout, which
+survives as :class:`NaiveKubeCore`. Three legs:
+
+1. **Seeded serialized traces** (seeds 1/7/42): a few hundred randomized
+   ops — create/get/read/list/scan/update/patch/delete (with and without
+   preconditions)/bind/bulk-bind/evict with PDBs — applied to both stores
+   in the same order; after EVERY op, op outcome (value or exception
+   type) and full store state must match exactly (the clock is pinned, so
+   even timestamps and resourceVersions compare equal).
+2. **Concurrent interleavings**: bind/evict/create threads race on the
+   striped store; the op set is chosen so the final state is
+   order-independent, and it must equal the naive store's serial result
+   modulo resourceVersion ordering. A PDB leg asserts the atomic
+   check-then-delete bound holds under concurrent evictions, and a mixed
+   cross-stripe leg (evict + watch(None) + new-kind creates) must simply
+   finish — the lock-order deadlock smoke.
+3. **Watch-under-striping semantics**: a watcher registered mid-write
+   sees pre- or post-state, never a torn object; registration never
+   loses an event; ``_watchers`` is copy-on-write.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+import pytest
+
+from karpenter_tpu.api.core import (
+    LabelSelector, Node, ObjectMeta, Pod, PodDisruptionBudget, PodSpec,
+)
+from karpenter_tpu.runtime.kubecore import (
+    ApiError, KubeCore, NaiveKubeCore, MetaObj,
+)
+from karpenter_tpu.utils import clock
+from karpenter_tpu.utils.fastcopy import deep_copy
+
+KINDS = ("Pod", "Node", "PodDisruptionBudget")
+NAMESPACES = ("default", "team-a")
+POD_NAMES = [f"pod-{i}" for i in range(16)]
+NODE_NAMES = [f"node-{i}" for i in range(5)]
+PDB_NAMES = [f"pdb-{i}" for i in range(3)]
+
+
+def _pod(name, ns, labels=None, finalizers=None):
+    return Pod(metadata=ObjectMeta(name=name, namespace=ns,
+                                   labels=dict(labels or {}),
+                                   finalizers=list(finalizers or [])),
+               spec=PodSpec())
+
+
+def _node(name):
+    return Node(metadata=ObjectMeta(name=name, namespace="default"))
+
+
+def _pdb(name, ns, app, min_available=None, max_unavailable=None):
+    return PodDisruptionBudget(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        selector=LabelSelector(match_labels={"app": app}),
+        min_available=min_available, max_unavailable=max_unavailable)
+
+
+def _dump(store):
+    """Full observable state, exactly comparable between layouts (the
+    clock is pinned, so timestamps agree; RVs agree for serialized
+    traces)."""
+    state = {}
+    for kind in KINDS:
+        objs = sorted(store.list(kind),
+                      key=lambda o: (o.metadata.namespace, o.metadata.name))
+        state[kind] = objs
+    return state
+
+
+def _norm_result(value):
+    """Normalize an op's return for comparison: API objects reduce to
+    their identifying fields, lists are compared order-insensitively
+    (list/scan iteration order is a layout artifact, not API contract)."""
+    if isinstance(value, list):
+        return sorted(_norm_result(v) for v in value)
+    if hasattr(value, "metadata"):
+        return (value.kind, value.metadata.namespace, value.metadata.name,
+                value.metadata.resource_version,
+                value.metadata.deletion_timestamp,
+                tuple(sorted(value.metadata.labels.items())))
+    return value
+
+
+def _trace(rng: random.Random, n_ops: int):
+    """A seeded op trace: descriptors only (no store references), so the
+    identical trace applies to both layouts."""
+    ops = []
+    for i in range(n_ops):
+        kind = rng.choice(
+            ["create_pod", "create_pod", "create_node", "create_pdb",
+             "get", "read", "list", "scan", "pods_on_node",
+             "update", "patch", "delete", "delete_precond",
+             "bind", "bulk_bind", "evict"])
+        ns = rng.choice(NAMESPACES)
+        pod = rng.choice(POD_NAMES)
+        if kind == "create_pod":
+            ops.append((kind, pod, ns, f"app-{rng.randrange(3)}",
+                        rng.random() < 0.2))  # 20%: with a finalizer
+        elif kind == "create_node":
+            ops.append((kind, rng.choice(NODE_NAMES)))
+        elif kind == "create_pdb":
+            style = rng.randrange(4)
+            ops.append((kind, rng.choice(PDB_NAMES), ns,
+                        f"app-{rng.randrange(3)}", style))
+        elif kind in ("get", "read", "evict"):
+            ops.append((kind, pod, ns))
+        elif kind == "list":
+            ops.append((kind, rng.choice(KINDS),
+                        rng.choice([None, ns]),
+                        rng.random() < 0.3, rng.randrange(3)))
+        elif kind == "scan":
+            ops.append((kind, rng.choice(KINDS)))
+        elif kind == "pods_on_node":
+            ops.append((kind, rng.choice(NODE_NAMES)))
+        elif kind in ("update", "patch"):
+            ops.append((kind, pod, ns, i, rng.random() < 0.25))  # 25% stale
+        elif kind == "delete":
+            ops.append((kind, pod, ns))
+        elif kind == "delete_precond":
+            ops.append((kind, pod, ns, rng.random() < 0.5))  # 50% mismatch
+        elif kind == "bind":
+            ops.append((kind, pod, ns, rng.choice(NODE_NAMES)))
+        elif kind == "bulk_bind":
+            ops.append((kind, tuple(rng.sample(POD_NAMES, 3)), ns,
+                        rng.choice(NODE_NAMES)))
+    return ops
+
+
+def _apply(store, op):
+    """Execute one descriptor; returns ("ok", normalized) or the raised
+    ApiError subclass name — the differential unit of comparison."""
+    kind = op[0]
+    try:
+        if kind == "create_pod":
+            _, name, ns, app, fin = op
+            return ("ok", _norm_result(store.create(_pod(
+                name, ns, labels={"app": app},
+                finalizers=["test/finalizer"] if fin else []))))
+        if kind == "create_node":
+            return ("ok", _norm_result(store.create(_node(op[1]))))
+        if kind == "create_pdb":
+            _, name, ns, app, style = op
+            kwargs = [{}, {"min_available": 1}, {"max_unavailable": "50%"},
+                      {"min_available": 1, "max_unavailable": 1}][style]
+            return ("ok", _norm_result(store.create(_pdb(name, ns, app,
+                                                         **kwargs))))
+        if kind == "get":
+            return ("ok", _norm_result(store.get("Pod", op[1], op[2])))
+        if kind == "read":
+            return ("ok", store.read("Pod", op[1], op[2],
+                                     lambda p: (p.metadata.name,
+                                                p.spec.node_name or "")))
+        if kind == "list":
+            _, k, ns, use_sel, app_i = op
+            sel = LabelSelector(match_labels={"app": f"app-{app_i}"}) \
+                if use_sel else None
+            return ("ok", _norm_result(store.list(k, namespace=ns,
+                                                  label_selector=sel)))
+        if kind == "scan":
+            return ("ok", sorted(store.scan(
+                op[1], lambda o: (o.metadata.namespace, o.metadata.name))))
+        if kind == "pods_on_node":
+            return ("ok", _norm_result(store.pods_on_node(op[1])))
+        if kind == "update":
+            _, name, ns, i, stale = op
+            obj = store.get("Pod", name, ns)
+            obj.metadata.labels["updated"] = str(i)
+            if stale:
+                obj.metadata.resource_version -= 1
+            return ("ok", _norm_result(store.update(obj)))
+        if kind == "patch":
+            _, name, ns, i, drop_finalizer = op
+
+            def fn(o):
+                o.metadata.labels["patched"] = str(i)
+                if drop_finalizer:
+                    o.metadata.finalizers = []
+            return ("ok", _norm_result(store.patch("Pod", name, ns, fn)))
+        if kind == "delete":
+            return ("ok", _norm_result(store.delete("Pod", op[1], op[2])))
+        if kind == "delete_precond":
+            _, name, ns, mismatch = op
+            rv = "999999" if mismatch else str(store.read(
+                "Pod", name, ns, lambda p: p.metadata.resource_version))
+            return ("ok", _norm_result(store.delete(
+                "Pod", name, ns, precondition_rv=rv)))
+        if kind == "bind":
+            _, name, ns, node = op
+            return ("ok", store.bind_pod(_pod(name, ns), node))
+        if kind == "bulk_bind":
+            _, names, ns, node = op
+            return ("ok", store.bind_pods([_pod(n, ns) for n in names], node))
+        if kind == "evict":
+            return ("ok", store.evict_pod(op[1], op[2]))
+        raise AssertionError(f"unknown op {kind}")
+    except ApiError as e:
+        return ("err", type(e).__name__)
+
+
+@pytest.mark.parametrize("seed", [1, 7, 42])
+def test_differential_serialized_trace(seed):
+    """Striped store == naive store for every op of a seeded trace: same
+    outcome per op, identical full state after each op (RVs, UIDs,
+    timestamps included — both layouts draw from identical sequences)."""
+    clock.DEFAULT.set(1_000_000.0)
+    rng = random.Random(seed)
+    striped, naive = KubeCore(), NaiveKubeCore()
+    for step, op in enumerate(_trace(rng, 400)):
+        got = _apply(striped, op)
+        want = _apply(naive, op)
+        assert got == want, f"seed={seed} step={step} op={op}"
+        assert _dump(striped) == _dump(naive), \
+            f"seed={seed} step={step}: state diverged after {op}"
+    # the trace must have exercised both outcome classes to mean anything
+    assert any(k != "" for k in striped._stripes), "no stripes created"
+    assert len(striped._stripes) > 1, "striping never engaged"
+    assert len(naive._stripes) == 1, "naive layout grew stripes"
+
+
+def _strip_rv(state):
+    for objs in state.values():
+        for o in objs:
+            o.metadata.resource_version = 0
+            o.metadata.uid = ""
+    return state
+
+
+@pytest.mark.parametrize("seed", [1, 7, 42])
+def test_differential_concurrent_bind_evict(seed):
+    """Racing binders/evictors/creators on the striped store converge to
+    the same final state the naive store reaches serially: the op set is
+    disjoint per thread, so the outcome is order-independent and only the
+    RV/UID *ordering* may differ."""
+    clock.DEFAULT.set(1_000_000.0)
+    rng = random.Random(seed)
+    striped, naive = KubeCore(), NaiveKubeCore()
+    base = [(f"race-{i}", "default") for i in range(60)]
+    for name, ns in base:
+        for store in (striped, naive):
+            store.create(_pod(name, ns, labels={"app": "race"}))
+    bind_a = [n for n, _ in base[:20]]
+    bind_b = [n for n, _ in base[20:40]]
+    evict = [n for n, _ in base[40:60]]
+    extra = [f"late-{i}" for i in range(20)]
+    errors = []
+
+    def _run(fn):
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — surfaced by the assert below
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=_run, args=(lambda: [
+            striped.bind_pods([_pod(n, "default") for n in bind_a[i:i + 4]],
+                              "node-a") for i in range(0, 20, 4)],)),
+        threading.Thread(target=_run, args=(lambda: [
+            striped.bind_pod(_pod(n, "default"), "node-b")
+            for n in bind_b],)),
+        threading.Thread(target=_run, args=(lambda: [
+            striped.evict_pod(n, "default") for n in evict],)),
+        threading.Thread(target=_run, args=(lambda: [
+            striped.create(_node(n)) for n in extra],)),
+    ]
+    rng.shuffle(threads)
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+        assert not t.is_alive(), f"seed={seed}: thread deadlocked"
+    assert not errors, f"seed={seed}: {errors}"
+
+    # the same ops serially on the naive reference
+    for i in range(0, 20, 4):
+        naive.bind_pods([_pod(n, "default") for n in bind_a[i:i + 4]],
+                        "node-a")
+    for n in bind_b:
+        naive.bind_pod(_pod(n, "default"), "node-b")
+    for n in evict:
+        naive.evict_pod(n, "default")
+    for n in extra:
+        naive.create(_node(n))
+    assert _strip_rv(_dump(striped)) == _strip_rv(_dump(naive)), \
+        f"seed={seed}: concurrent striped result != serial naive result"
+    # and the node index agrees with the observable state
+    assert sorted(p.metadata.name for p in striped.pods_on_node("node-a")) \
+        == sorted(bind_a)
+    assert sorted(p.metadata.name for p in striped.pods_on_node("node-b")) \
+        == sorted(bind_b)
+
+
+@pytest.mark.parametrize("seed", [1, 7, 42])
+def test_concurrent_evictions_respect_pdb_atomically(seed):
+    """The cross-stripe check-then-delete is atomic: with 10 healthy pods
+    and minAvailable=8, AT MOST 2 of 6 concurrent evictions may succeed —
+    any interleaving that let a third through would mean the PDB check and
+    the delete were not one step."""
+    core = KubeCore()
+    core.create(_pdb("guard", "default", "guarded", min_available=8))
+    names = [f"guarded-{i}" for i in range(10)]
+    for n in names:
+        core.create(_pod(n, "default", labels={"app": "guarded"}))
+        core.bind_pod(_pod(n, "default"), "node-x")
+    rng = random.Random(seed)
+    targets = rng.sample(names, 6)
+    outcomes = []
+    lock = threading.Lock()
+
+    def _evict(name):
+        try:
+            core.evict_pod(name, "default")
+            ok = True
+        except ApiError:
+            ok = False
+        with lock:
+            outcomes.append(ok)
+
+    threads = [threading.Thread(target=_evict, args=(n,)) for n in targets]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+        assert not t.is_alive(), "eviction deadlocked"
+    assert sum(outcomes) == 2, \
+        f"seed={seed}: {sum(outcomes)} evictions passed a minAvailable=8 " \
+        f"budget over 10 pods (exactly 2 may)"
+    healthy = core.scan("Pod", lambda p: bool(p.spec.node_name))
+    assert sum(healthy) == 8
+
+
+def test_cross_stripe_chaos_never_deadlocks():
+    """Lock-order soak: every cross-stripe op class at once — evictions
+    (Pod+PDB stripes), watch(None) world snapshots (guard + all stripes),
+    brand-new-kind creates (guard), scans — all threads must finish."""
+    core = KubeCore()
+    core.create(_pdb("pdb", "default", "app", min_available=0))
+    for i in range(30):
+        core.create(_pod(f"p-{i}", "default", labels={"app": "app"}))
+    stop = threading.Event()
+    errors = []
+
+    def _loop(fn):
+        try:
+            while not stop.is_set():
+                fn()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    i = [0]
+
+    def _new_kind():
+        i[0] += 1
+        core.create(Node(metadata=ObjectMeta(name=f"n-{i[0]}"),
+                         ))
+
+    def _world_watch():
+        q = core.watch(None)
+        core.unwatch(q)
+
+    def _evict():
+        try:
+            core.evict_pod(f"p-{i[0] % 30}", "default")
+        except ApiError:
+            pass
+
+    threads = [threading.Thread(target=_loop, args=(fn,)) for fn in
+               (_new_kind, _world_watch, _evict,
+                lambda: core.scan("Pod", lambda p: p.metadata.name),
+                lambda: core.list("PodDisruptionBudget"))]
+    for t in threads:
+        t.start()
+    stop_timer = threading.Timer(2.0, stop.set)
+    stop_timer.start()
+    for t in threads:
+        t.join(timeout=30.0)
+        assert not t.is_alive(), "cross-stripe op deadlocked"
+    stop_timer.cancel()
+    assert not errors, errors
+
+
+# ---------------------------------------------------------------------------
+# Watch semantics under striping
+# ---------------------------------------------------------------------------
+
+class TestWatchUnderStriping:
+    def test_watchers_list_is_copy_on_write(self):
+        """watch/unwatch REPLACE _watchers; the old list object is never
+        mutated — the invariant that lets _notify iterate lock-free."""
+        core = KubeCore()
+        q1 = core.watch("Pod")
+        snapshot = core._watchers
+        content = list(snapshot)
+        q2 = core.watch("Node")
+        assert core._watchers is not snapshot
+        assert snapshot == content, "registered watcher mutated the old list"
+        core.unwatch(q1)
+        assert core._watchers is not snapshot
+        assert snapshot == content, "unwatch mutated the old list"
+        core.unwatch(q2)
+
+    def test_mid_write_watcher_sees_pre_or_post_never_torn(self):
+        """A writer flips a pod between two internally consistent label
+        states; watchers registered mid-flight must replay one of the two
+        states, never a mix (registration + replay run under the same
+        stripe lock as the write)."""
+        core = KubeCore()
+        core.create(_pod("flip", "default", labels={"v": "a", "check": "a"}))
+        stop = threading.Event()
+
+        def _writer():
+            v = "b"
+            while not stop.is_set():
+                def fn(o, v=v):
+                    o.metadata.labels["v"] = v
+                    o.metadata.labels["check"] = v
+                core.patch("Pod", "flip", "default", fn)
+                v = "a" if v == "b" else "b"
+
+        t = threading.Thread(target=_writer)
+        t.start()
+        try:
+            for _ in range(200):
+                q = core.watch("Pod")
+                seen = 0
+                while True:
+                    try:
+                        ev = q.get_nowait()
+                    except Exception:
+                        break
+                    labels = ev.obj.metadata.labels
+                    assert labels["v"] == labels["check"], \
+                        f"torn object observed: {labels}"
+                    seen += 1
+                    if seen >= 5:
+                        break
+                core.unwatch(q)
+        finally:
+            stop.set()
+            t.join(timeout=10.0)
+        assert not t.is_alive()
+
+    def test_registration_never_loses_an_object(self):
+        """Every object lands in the replay XOR as a later ADDED — a
+        watcher registered mid-create-storm misses nothing and sees no
+        duplicates."""
+        core = KubeCore()
+        total = 300
+        started = threading.Event()
+
+        def _creator():
+            started.set()
+            for i in range(total):
+                core.create(_pod(f"storm-{i}", "default"))
+
+        t = threading.Thread(target=_creator)
+        t.start()
+        started.wait()
+        q = core.watch("Pod", meta_only=True)
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+        seen = []
+        while True:
+            try:
+                seen.append(q.get_nowait().obj.metadata.name)
+            except Exception:
+                break
+        assert len(seen) == len(set(seen)), "duplicate watch delivery"
+        assert set(seen) == {f"storm-{i}" for i in range(total)}, \
+            f"lost {total - len(seen)} objects across registration"
+        core.unwatch(q)
+
+    def test_world_watch_replays_every_kind_and_meta_only_stubs(self):
+        core = KubeCore()
+        core.create(_pod("p", "default"))
+        core.create(_node("n"))
+        q = core.watch(None, meta_only=True)
+        replay = [q.get_nowait() for _ in range(2)]
+        assert {e.obj.kind for e in replay} == {"Pod", "Node"}
+        assert all(isinstance(e.obj, MetaObj) for e in replay)
+        # post-registration events for a brand-new kind still arrive
+        core.create(_pdb("pdb", "default", "x"))
+        ev = q.get(timeout=2.0)
+        assert ev.type == "ADDED" and ev.obj.kind == "PodDisruptionBudget"
+        core.unwatch(q)
+
+    def test_full_copy_watch_events_are_isolated_copies(self):
+        """Non-meta watches deliver deep copies: mutating a delivered
+        event object must not reach the store (deep_copy fidelity via
+        the COW notify path)."""
+        core = KubeCore()
+        q = core.watch("Pod")
+        core.create(_pod("iso", "default", labels={"k": "v"}))
+        ev = q.get(timeout=2.0)
+        ev.obj.metadata.labels["k"] = "mutated"
+        assert core.read("Pod", "iso", "default",
+                         lambda p: p.metadata.labels["k"]) == "v"
+        assert deep_copy(ev.obj).metadata.labels["k"] == "mutated"
+        core.unwatch(q)

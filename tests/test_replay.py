@@ -1,0 +1,159 @@
+"""Cluster-in-a-box replay harness tests (karpenter_tpu/replay.py).
+
+Fast legs run a shrunken replay (thousands of pods, 2 shards, chaos on)
+and a small store A/B — the full million-pod run is ``make bench-replay``
+(bench.py config_9). The ``slow`` leg is ``make replay-smoke``: 10k pods
+in under a minute with chaos + pressure active.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from karpenter_tpu.replay import (
+    ReplayConfig, diurnal_weights, run_replay, store_ab, tenant_catalog,
+    tenant_provisioner, tenant_zone,
+)
+from tools.replay_verdict import verdict
+
+import random
+
+
+TINY = ReplayConfig(
+    pods_total=1_500, shards=2, tenants=2, seed=7, bound_cohort=60,
+    churn_pods=120, max_depth=400, ticks=6, tick_sleep_s=0.05,
+    burst_ticks=2, chaos=True, settle_s=45.0, flood_pool=64)
+
+
+class TestDiurnalWeights:
+    def test_seeded_and_bursty(self):
+        rng = random.Random(42)
+        w1 = diurnal_weights(12, 3, random.Random(42))
+        w2 = diurnal_weights(12, 3, random.Random(42))
+        assert w1 == w2, "diurnal curve must be deterministic per seed"
+        assert len(w1) == 12 and all(w > 0 for w in w1)
+        # burst ticks carry 3x weight: the top ticks must clearly dominate
+        assert max(w1) > 2.0 * (sum(w1) / len(w1))
+        assert diurnal_weights(12, 3, rng) != diurnal_weights(12, 0,
+                                                              random.Random(1))
+
+    def test_tenant_fixtures(self):
+        catalog = tenant_catalog(3)
+        zones = {o.zone for it in catalog for o in it.offerings}
+        assert zones == {"replay-zone-1", "replay-zone-2", "replay-zone-3"}
+        for t in range(3):
+            prov = tenant_provisioner(t)
+            req = prov.spec.constraints.requirements.requirement(
+                "topology.kubernetes.io/zone")
+            assert req == {tenant_zone(t)}, \
+                "tenant must be pinned to exactly its own zone"
+
+
+class TestTinyReplay:
+    def test_completes_with_zero_critical_sheds(self):
+        report = run_replay(TINY)
+        assert report["completed"], report
+        assert report["system_critical_shed"] == 0
+        assert report["cohort_unbound"] == 0
+        assert report["workers_healthy"]
+        assert report["recovery_to_l0_s"] is not None
+        # churn rounding is the only permitted offer shortfall
+        assert report["offered_total"] >= 0.99 * TINY.pods_total
+        assert set(report["offered"]) >= {"default", "low", "besteffort"}
+        # every cohort band got a latency quantile block
+        for band, q in report["pending_to_bound_s"].items():
+            if q is not None:
+                assert q["p99"] >= q["p50"] >= 0.0
+        assert report["store_ops"], "store op latency probes missing"
+        # the verdict tool must accept the harness's own output shape
+        line = {"replay": report, "store_ab": None}
+        v = verdict(line)
+        assert "PASS" in v and "FAIL" not in v, v
+
+    def test_report_is_json_serializable(self):
+        # SLO reports are redirected into BENCH files verbatim
+        report = run_replay(ReplayConfig(
+            pods_total=400, shards=1, tenants=1, seed=1, bound_cohort=20,
+            churn_pods=40, max_depth=200, ticks=4, tick_sleep_s=0.05,
+            burst_ticks=1, chaos=False, settle_s=30.0, flood_pool=32))
+        text = json.dumps(report)
+        assert json.loads(text)["completed"]
+        assert json.loads(text)["chaos_fired"] is None  # chaos disabled
+
+
+class TestStoreAB:
+    def test_small_ab_counts_and_speedup(self):
+        ab = store_ab(objects=3_000, minority=300, iters=8)
+        assert ab["objects"] == 3_000
+        assert ab["minority_kind_objects"] == 300
+        assert ab["iters"] == 8
+        # even at 3k objects the indexed scan must beat the full filter scan
+        assert ab["scan_speedup"] > 1.0
+        assert ab["list_speedup"] > 0.0
+        assert ab["striped"]["scan_p50_ms"] < ab["naive"]["scan_p50_ms"]
+
+
+class TestVerdictCli:
+    def test_pipe_passthrough_and_pass(self):
+        line = json.dumps({
+            "replay": {
+                "config": {"pods_total": 1000, "shards": 2},
+                "offered_total": 995, "completed": True,
+                "system_critical_shed": 0, "recovery_to_l0_s": 1.5,
+                "peak_level": 2, "pending_to_bound_s": {
+                    "default": {"p50": 0.1, "p99": 0.7, "max": 1.0, "n": 10}},
+            },
+            "store_ab": {"scan_speedup": 33.0, "objects": 100_000},
+        })
+        proc = subprocess.run(
+            [sys.executable, "tools/replay_verdict.py"], input=line + "\n",
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0
+        assert proc.stdout.strip() == line, "stdout must pass through unchanged"
+        assert "PASS" in proc.stderr
+
+    def test_critical_shed_fails_the_gate(self):
+        line = {"replay": {
+            "config": {"pods_total": 100, "shards": 1}, "offered_total": 100,
+            "completed": True, "system_critical_shed": 3,
+            "recovery_to_l0_s": 0.5, "peak_level": 3,
+            "pending_to_bound_s": {}}, "store_ab": {"scan_speedup": 10.0}}
+        v = verdict(line)
+        assert "FAIL" in v and "system-critical" in v
+
+    def test_slow_store_fails_the_gate(self):
+        line = {"replay": {
+            "config": {"pods_total": 100, "shards": 1}, "offered_total": 100,
+            "completed": True, "system_critical_shed": 0,
+            "recovery_to_l0_s": 0.5, "peak_level": 1,
+            "pending_to_bound_s": {}},
+            "store_ab": {"scan_speedup": 2.0, "objects": 100_000}}
+        v = verdict(line)
+        assert "FAIL" in v and "speedup" in v
+
+
+@pytest.mark.slow
+class TestReplaySmoke:
+    def test_10k_smoke_under_60s(self):
+        """``make replay-smoke``: 10k pods / 2 shards with chaos + pressure,
+        wall-clocked — the fast proof that the full 1M run is sane."""
+        cfg = ReplayConfig(
+            pods_total=10_000, shards=2, tenants=4, seed=42,
+            bound_cohort=200, churn_pods=500, max_depth=2_000, ticks=8,
+            tick_sleep_s=0.1, burst_ticks=2, chaos=True, settle_s=60.0,
+            flood_pool=256)
+        t0 = time.monotonic()
+        report = run_replay(cfg)
+        wall = time.monotonic() - t0
+        print(f"\nreplay-smoke: {report['offered_total']} pods in {wall:.1f}s "
+              f"peak=L{report['peak_level']} "
+              f"recovery={report['recovery_to_l0_s']}s")
+        assert report["completed"], report
+        assert report["system_critical_shed"] == 0
+        assert report["offered_total"] >= 0.99 * cfg.pods_total
+        assert wall < 60.0, f"smoke took {wall:.1f}s (budget 60s)"

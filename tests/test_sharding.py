@@ -1,0 +1,229 @@
+"""Sharded control plane: shard routing, worker lifecycle, per-shard
+metric labels, and the jittered requeue backoff (docs/scale.md §1).
+
+``ProvisioningController(shards=N)`` replaces one-worker-per-Provisioner
+with N long-lived shard workers keyed by ``crc32(name) % N``; tenants
+attach/detach ENGINES while the worker (thread, batcher, queued pods)
+survives. The legacy ``shards=0`` shape must be byte-for-byte preserved.
+"""
+
+from __future__ import annotations
+
+import random
+import string
+import zlib
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake.provider import FakeCloudProvider, instance_types
+from karpenter_tpu.controllers.provisioning import (
+    ProvisioningController, shard_of,
+)
+from karpenter_tpu.controllers.selection import (
+    JITTER_SPREAD, SelectionController, requeue_jitter,
+)
+from karpenter_tpu.metrics.pressure import INTAKE_QUEUE_DEPTH, PODS_SHED_TOTAL
+from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.scheduling.batcher import Batcher
+
+from tests.expectations import (
+    expect_provisioned, expect_scheduled, make_provisioner, unschedulable_pod,
+)
+
+
+@pytest.fixture()
+def sharded_env():
+    kube = KubeCore()
+    provider = FakeCloudProvider(catalog=instance_types(10))
+    provisioning = ProvisioningController(
+        kube, provider, shards=2,
+        batcher_factory=lambda: Batcher(idle_seconds=0.05, max_seconds=2.0))
+    selection = SelectionController(kube, provisioning, gate_timeout=30.0)
+    yield kube, provider, provisioning, selection
+    for w in provisioning.workers.values():
+        w.stop()
+
+
+def _reconcile_cr(kube, provisioning, name):
+    p = make_provisioner(name=name)
+    kube.create(p)
+    provisioning.reconcile(name)
+    return p
+
+
+class TestShardOf:
+    def test_stable_and_in_range(self):
+        rng = random.Random(42)
+        for _ in range(200):
+            name = "".join(rng.choices(string.ascii_lowercase + "-", k=12))
+            for shards in (1, 2, 4, 7):
+                s = shard_of(name, shards)
+                assert 0 <= s < shards
+                assert s == shard_of(name, shards), "unstable assignment"
+                assert s == zlib.crc32(name.encode()) % shards
+
+    def test_spreads_tenants(self):
+        # 64 tenants over 4 shards: every shard gets someone (a pathological
+        # hash would silently serialize the whole control plane)
+        counts = [0] * 4
+        for i in range(64):
+            counts[shard_of(f"tenant-{i}", 4)] += 1
+        assert all(c > 0 for c in counts), counts
+
+
+class TestShardedController:
+    def test_engines_route_by_hash_and_workers_are_shared(self, sharded_env):
+        kube, _, provisioning, _ = sharded_env
+        names = [f"tenant-{i}" for i in range(6)]
+        for n in names:
+            _reconcile_cr(kube, provisioning, n)
+        assert set(provisioning.workers) <= {"shard-0", "shard-1"}
+        hosted = {}
+        for wname, worker in provisioning.workers.items():
+            sid = wname.split("-", 1)[1]
+            assert worker.shard == sid
+            assert worker.batcher.shard == sid  # metric label plumbed through
+            for eng in worker.engines():
+                assert eng.shard == sid
+                hosted[eng.provisioner.metadata.name] = int(sid)
+        assert hosted == {n: shard_of(n, 2) for n in names}
+        # targets() exposes every (provisioner, worker) routing pair
+        pairs = provisioning.targets()
+        assert sorted(p.metadata.name for p, _ in pairs) == sorted(names)
+        for prov, worker in pairs:
+            assert worker is provisioning.workers[
+                f"shard-{shard_of(prov.metadata.name, 2)}"]
+
+    def test_cr_delete_detaches_engine_but_worker_survives(self, sharded_env):
+        kube, _, provisioning, _ = sharded_env
+        names = [f"tenant-{i}" for i in range(4)]
+        for n in names:
+            _reconcile_cr(kube, provisioning, n)
+        victim = names[0]
+        sid = shard_of(victim, 2)
+        worker = provisioning.workers[f"shard-{sid}"]
+        before = {e.provisioner.metadata.name for e in worker.engines()}
+        assert victim in before
+        kube.delete("Provisioner", victim, "default")
+        assert provisioning.reconcile(victim) is None
+        after = {e.provisioner.metadata.name for e in worker.engines()}
+        assert after == before - {victim}
+        assert f"shard-{sid}" in provisioning.workers, "shard worker torn down"
+        assert worker._thread is not None and worker._thread.is_alive()
+        assert victim not in {p.metadata.name for p, _ in provisioning.targets()}
+
+    def test_spec_change_replaces_engine_in_place(self, sharded_env):
+        kube, _, provisioning, _ = sharded_env
+        _reconcile_cr(kube, provisioning, "tenant-0")
+        worker = provisioning.workers[f"shard-{shard_of('tenant-0', 2)}"]
+        old_engine = worker.engines()[0]
+        old_batcher = worker.batcher
+
+        def bump(p):
+            p.spec.constraints.labels["generation"] = "2"
+        kube.patch("Provisioner", "tenant-0", "default", bump)
+        provisioning.reconcile("tenant-0")
+        new_engine = worker.engines()[0]
+        assert new_engine is not old_engine, "spec change did not re-attach"
+        assert worker.batcher is old_batcher, "intake queue was not preserved"
+
+    def test_end_to_end_bind_through_shard_workers(self, sharded_env):
+        kube, provider, provisioning, selection = sharded_env
+        _reconcile_cr(kube, provisioning, "default")
+        pods = [unschedulable_pod() for _ in range(5)]
+        expect_provisioned(kube, selection, provisioning, pods)
+        for pod in pods:
+            expect_scheduled(kube, pod)
+        assert len(provider.created) >= 1
+
+    def test_legacy_unsharded_shape_preserved(self):
+        kube = KubeCore()
+        provider = FakeCloudProvider(catalog=instance_types(4))
+        provisioning = ProvisioningController(
+            kube, provider,
+            batcher_factory=lambda: Batcher(idle_seconds=0.05, max_seconds=2.0))
+        try:
+            for n in ("alpha", "beta"):
+                _reconcile_cr(kube, provisioning, n)
+            assert set(provisioning.workers) == {"alpha", "beta"}
+            for name, worker in provisioning.workers.items():
+                assert worker.shard == ""
+                assert worker.batcher.shard == ""  # legacy unlabeled series
+                assert [e.provisioner.metadata.name
+                        for e in worker.engines()] == [name]
+            kube.delete("Provisioner", "alpha", "default")
+            provisioning.reconcile("alpha")
+            assert set(provisioning.workers) == {"beta"}, \
+                "legacy shape must tear the worker down with its CR"
+        finally:
+            for w in provisioning.workers.values():
+                w.stop()
+
+
+class TestPerShardMetrics:
+    def test_shed_counter_carries_shard_label(self):
+        b = Batcher(idle_seconds=0.05, max_seconds=0.5, max_depth=1)
+        b.shard = "97"  # unique value: the registry is process-global
+        assert b.add("first", band="default") is not None
+        assert b.add("second", band="default") is None  # depth-bound shed
+        lv = (("priority_band", "default"), ("reason", "depth-bound"),
+              ("shard", "97"))
+        assert PODS_SHED_TOTAL.collect().get(lv) == 1.0
+        assert b.shed_total() == 1
+        assert b.shed[("depth-bound", "default")] == 1
+
+    def test_depth_gauge_emits_per_shard_series(self):
+        b = Batcher(idle_seconds=0.05, max_seconds=0.5, max_depth=10)
+        b.shard = "98"
+        b.add("x")
+        b.add("y")
+        assert INTAKE_QUEUE_DEPTH.collect().get((("shard", "98"),)) == 2.0
+
+    def test_unsharded_batcher_emits_legacy_unlabeled_shed(self):
+        before = PODS_SHED_TOTAL.collect().get(
+            (("priority_band", "default"), ("reason", "depth-bound")), 0.0)
+        b = Batcher(idle_seconds=0.05, max_seconds=0.5, max_depth=1)
+        b.add("first")
+        b.add("second")
+        after = PODS_SHED_TOTAL.collect().get(
+            (("priority_band", "default"), ("reason", "depth-bound")), 0.0)
+        assert after == before + 1.0
+
+
+class TestRequeueJitter:
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_bounds_determinism_and_spread(self, seed):
+        rng = random.Random(seed)
+        keys = [("ns-%d" % rng.randrange(10),
+                 "pod-" + "".join(rng.choices(string.hexdigits, k=8)))
+                for _ in range(200)]
+        lo, hi = 1.0 - JITTER_SPREAD / 2, 1.0 + JITTER_SPREAD / 2
+        values = [requeue_jitter(k) for k in keys]
+        assert all(lo <= v < hi for v in values), \
+            f"seed={seed}: jitter escaped [{lo}, {hi})"
+        assert values == [requeue_jitter(k) for k in keys], \
+            "jitter is not deterministic in the key"
+        # the point of the jitter is de-synchronization: a mass-shed cohort
+        # must NOT collapse onto a handful of retry instants
+        assert max(values) - min(values) > JITTER_SPREAD / 2, \
+            f"seed={seed}: cohort spread too narrow ({min(values)}..{max(values)})"
+        assert len(set(values)) > 150, "jitter collides too often"
+
+    def test_none_key_is_identity(self):
+        assert requeue_jitter(None) == 1.0
+
+    def test_requeue_seconds_applies_jitter(self):
+        kube = KubeCore()
+        provider = FakeCloudProvider(catalog=instance_types(2))
+        provisioning = ProvisioningController(kube, provider, shards=2)
+        selection = SelectionController(kube, provisioning)
+        try:
+            key = ("default", "some-pod")
+            base = selection._requeue_seconds(None)
+            assert base == selection.REQUEUE_SECONDS  # L0, no jitter for None
+            jittered = selection._requeue_seconds(key)
+            assert jittered == pytest.approx(base * requeue_jitter(key))
+            assert jittered != base  # this key's hash is not exactly 1.0
+        finally:
+            for w in provisioning.workers.values():
+                w.stop()

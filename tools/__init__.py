@@ -1,0 +1,2 @@
+"""Operator-facing CLI tools (verdict filters, bench history, replay
+driver). A package so tests can import the verdict logic directly."""

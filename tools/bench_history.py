@@ -1,0 +1,132 @@
+"""Render the BENCH_r*.json trajectory as one table (``make bench-history``).
+
+Each round of work leaves one BENCH_rNN.json (the bench's single metric
+line, possibly pretty-printed); some rounds also leave named variants
+(BENCH_r04_builder.json, BENCH_r04_quiet.json, ...). This tool folds them
+all into one chronological table so a reader can see how the headline and
+the per-config extras moved across rounds without opening ten files:
+
+    round  variant  metric                                   value unit  dev  configs
+    r01    -        p99_solve_latency_ms_50k_pods_x_400_types 41.2 ms    1    1,4
+    ...
+
+Rows are sorted by round then variant; unparseable files are reported on
+stderr and skipped, never fatal.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+_NAME = re.compile(r"BENCH_(r\d+)(?:_([A-Za-z0-9-]+))?\.json$")
+
+
+def _config_ids(extra: dict) -> str:
+    """Compressed list of the config slots present (and not skipped/errored):
+    'config_7_control_plane_10k_pods' → '7'."""
+    ids = []
+    for key, val in extra.items():
+        m = re.match(r"config_(\d+)", key)
+        if not m or not isinstance(val, dict):
+            continue
+        if "skipped" in val:
+            continue
+        ids.append(m.group(1) + ("!" if "error" in val else ""))
+    return ",".join(sorted(ids, key=lambda s: int(s.rstrip("!")))) or "-"
+
+
+def _from_tail(tail: str):
+    """Best-effort recovery of the bench JSON line from a captured stdout
+    tail: parse from the LAST '{"metric"' occurrence (the line is emitted
+    last, so its suffix is always present; only a truncated head loses it)."""
+    idx = tail.rfind('{"metric"')
+    if idx < 0:
+        return None
+    for end in (None, tail.find("\n", idx)):
+        chunk = tail[idx:end] if end and end > 0 else tail[idx:]
+        try:
+            line = json.loads(chunk.strip())
+            if isinstance(line, dict) and "metric" in line:
+                return line
+        except ValueError:
+            continue
+    return None
+
+
+def load_rows(root: str) -> list:
+    rows, bad = [], []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = _NAME.search(os.path.basename(path))
+        if not m:
+            continue
+        rnd, variant = m.group(1), m.group(2) or "-"
+        try:
+            with open(path) as f:
+                line = json.load(f)
+        except (OSError, ValueError) as e:
+            bad.append(f"{os.path.basename(path)}: {e}")
+            continue
+        if (isinstance(line, dict) and "metric" not in line
+                and isinstance(line.get("line"), dict)):
+            line = line["line"]  # {"cmd", "rc", "note", "line": {...}} wrapper
+        if isinstance(line, dict) and "metric" not in line and "tail" in line:
+            # early-round driver capture: {"n", "cmd", "rc", "tail"} with
+            # the bench line embedded in (and possibly truncated at the
+            # front of) the tail — recover it when its start survived
+            inner = _from_tail(line.get("tail", ""))
+            if inner is None:
+                rows.append({
+                    "round": rnd, "variant": variant,
+                    "metric": f"(tail truncated, rc={line.get('rc')})",
+                    "value": None, "unit": "", "device_count": None,
+                    "backend": "?", "degraded": None, "configs": "-"})
+                continue
+            line = inner
+        extra = line.get("extra", {}) if isinstance(line, dict) else {}
+        rows.append({
+            "round": rnd,
+            "variant": variant,
+            "metric": line.get("metric", "?"),
+            "value": line.get("value"),
+            "unit": line.get("unit", ""),
+            "device_count": extra.get("device_count"),
+            "backend": extra.get("backend", "?"),
+            "degraded": extra.get("degraded"),
+            "configs": _config_ids(extra),
+        })
+    for b in bad:
+        print(f"bench-history: skipped {b}", file=sys.stderr)
+    rows.sort(key=lambda r: (r["round"], r["variant"]))
+    return rows
+
+
+def render(rows: list) -> str:
+    headers = ["round", "variant", "metric", "value", "unit",
+               "device_count", "backend", "degraded", "configs"]
+    table = [headers] + [
+        ["" if r[h] is None else str(r[h]) for h in headers] for r in rows]
+    widths = [max(len(row[i]) for row in table) for i in range(len(headers))]
+    lines = []
+    for n, row in enumerate(table):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip())
+        if n == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    root = (argv or sys.argv[1:] or ["."])[0]
+    rows = load_rows(root)
+    if not rows:
+        print(f"bench-history: no BENCH_r*.json under {root}", file=sys.stderr)
+        return 1
+    print(render(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""Cluster-in-a-box replay CLI (docs/scale.md §3).
+
+Runs karpenter_tpu.replay against the in-process control plane and prints
+ONE JSON line: ``{"replay": <SLO report>, "store_ab": <A/B or null>}`` —
+pipe through ``tools/replay_verdict.py`` for the pass/fail gate line:
+
+    JAX_PLATFORMS=cpu python tools/replay.py --pods 10000 --shards 2 \
+        | python tools/replay_verdict.py
+
+``make bench-replay`` runs the full million-pod shape through bench.py's
+supervisor instead (config_9), which adds backend probing and the
+BENCH-line format; this CLI is the dev-loop entry for custom shapes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "replay", description="traffic replay against the sharded control plane")
+    p.add_argument("--pods", type=int, default=1_000_000,
+                   help="total offered pods (flood + cohort + churn)")
+    p.add_argument("--shards", type=int, default=4)
+    p.add_argument("--tenants", type=int, default=8)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--cohort", type=int, default=2_000,
+                   help="pods driven through the full create→bind path")
+    p.add_argument("--churn", type=int, default=2_000,
+                   help="short-lived pods created then deleted a tick later")
+    p.add_argument("--max-depth", type=int, default=20_000,
+                   help="per-shard batcher depth bound")
+    p.add_argument("--ticks", type=int, default=24)
+    p.add_argument("--settle", type=float, default=180.0)
+    p.add_argument("--no-chaos", action="store_true",
+                   help="skip the seeded FaultPlan + ChaosKube wrapper")
+    p.add_argument("--no-store-ab", action="store_true",
+                   help="skip the 100k-object store list-by-kind A/B leg")
+    p.add_argument("--store-objects", type=int, default=100_000)
+    p.add_argument("--store-minority", type=int, default=2_000)
+    args = p.parse_args(argv)
+
+    from karpenter_tpu.replay import ReplayConfig, run_replay, store_ab
+
+    cfg = ReplayConfig(
+        pods_total=args.pods, shards=args.shards, tenants=args.tenants,
+        seed=args.seed, bound_cohort=args.cohort, churn_pods=args.churn,
+        max_depth=args.max_depth, ticks=args.ticks, settle_s=args.settle,
+        chaos=not args.no_chaos)
+    report = run_replay(cfg)
+    ab = None
+    if not args.no_store_ab:
+        ab = store_ab(objects=args.store_objects,
+                      minority=args.store_minority)
+    print(json.dumps({"replay": report, "store_ab": ab}))
+    return 0 if report.get("completed") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
