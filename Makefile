@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-replay bench-history replay-smoke native dryrun lint chart chaos-soak chaos-overload clean help
+.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-replay bench-replay-smoke bench-history replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-overload clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -31,12 +31,21 @@ bench-consolidate: ## Batched what-if consolidation window (config_5); prints ve
 	python bench.py --only config_5 \
 		| python tools/consolidate_verdict.py
 
-bench-replay: ## Million-pod replay across 4 shards + 100k-object store A/B (config_9); verdict on stderr
+bench-replay: ## Million-pod replay across 4 shards + 100k-object store A/B (config_9); verdict + traceview table on stderr
 	python bench.py --only config_9 \
-		| python tools/replay_verdict.py
+		| python tools/replay_verdict.py \
+		| python tools/traceview.py --bench
+
+bench-replay-smoke: ## bench-replay at 10k pods / 2 shards (KARPENTER_REPLAY_SMOKE=1); same verdict + traceview chain
+	KARPENTER_REPLAY_SMOKE=1 python bench.py --only config_9 \
+		| python tools/replay_verdict.py \
+		| python tools/traceview.py --bench
 
 replay-smoke: ## 10k-pod 2-shard replay smoke (<60s) with chaos + pressure active
 	JAX_PLATFORMS=cpu python -m pytest tests/test_replay.py -q -s -m slow
+
+metrics-lint: ## Every registered metric must carry help text and appear in the docs metric tables
+	python tools/metrics_lint.py
 
 bench-history: ## Render the BENCH_r*.json trajectory as one table
 	python tools/bench_history.py

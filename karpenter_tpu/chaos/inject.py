@@ -140,6 +140,10 @@ class FaultPlan:
         if kind is not None:
             log.info("chaos: injecting %s at %s/%s call #%d",
                      kind, boundary, op, idx)
+            from karpenter_tpu.obs import flight
+
+            flight.trip("chaos-fault", kind=kind, boundary=boundary,
+                        op=op, index=idx, seed=self.seed)
         return kind
 
     # -- introspection (for soak assertions) --------------------------------

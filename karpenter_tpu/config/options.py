@@ -75,6 +75,16 @@ class Options:
     pressure_dwell_seconds: float = 5.0     # hysteresis dwell per rung
     pressure_split_items: int = 4096        # L1+ max pods per solve chunk
     pressure_aging_seconds: float = 60.0    # one band promotion per step
+    # observability (karpenter_tpu/obs/, docs/observability.md): span tracer
+    # off by default — enabled it costs ~µs/span, disabled it is a no-op
+    trace_enabled: bool = False
+    # write a Chrome-trace-event dump here on shutdown ("" disables)
+    trace_dump: str = ""
+    # wrap device-solve spans in jax.profiler.TraceAnnotation so an XLA
+    # profile capture (KARPENTER_PROFILE_PORT) correlates to window spans
+    trace_jax: bool = False
+    # flight recorder dump directory ("" keeps the ring in memory only)
+    flight_dir: str = ""
     # AWS provider (options.go:45-49)
     aws_node_name_convention: str = "ip-name"  # ip-name | resource-name
     aws_eni_limited_pod_density: bool = True
@@ -226,6 +236,23 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                    default=_env("pressure-aging-seconds",
                                 defaults.pressure_aging_seconds),
                    help="queued/shed pods gain one priority band per step")
+    p.add_argument("--trace-enabled", action=argparse.BooleanOptionalAction,
+                   default=_env("trace-enabled", defaults.trace_enabled),
+                   help="span tracer (obs/trace.py): per-window spans with "
+                        "stage children; disabled mode is a no-op")
+    p.add_argument("--trace-dump",
+                   default=_env("trace-dump", defaults.trace_dump),
+                   help="write a Chrome-trace-event JSON dump here on "
+                        "shutdown (empty disables)")
+    p.add_argument("--trace-jax", action=argparse.BooleanOptionalAction,
+                   default=_env("trace-jax", defaults.trace_jax),
+                   help="annotate device-solve spans into the XLA profiler "
+                        "timeline (jax.profiler.TraceAnnotation)")
+    p.add_argument("--flight-dir",
+                   default=_env("flight-dir", defaults.flight_dir),
+                   help="flight recorder dump directory for watchdog/"
+                        "breaker/pressure-L3/chaos trips (empty = in-memory "
+                        "ring only)")
     p.add_argument("--aws-node-name-convention",
                    choices=["ip-name", "resource-name"],
                    default=_env("aws-node-name-convention",

@@ -41,6 +41,7 @@ from karpenter_tpu.metrics.consolidation import (
 from karpenter_tpu.models.consolidate import (
     fleet_prices, node_bin, reschedulable_pods)
 from karpenter_tpu.models.cost import CostConfig
+from karpenter_tpu.obs import trace as obtrace
 from karpenter_tpu.ops.whatif import encode_window
 from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
 from karpenter_tpu.solver.whatif import (
@@ -160,7 +161,14 @@ class ConsolidationController:
             return None
         if provisioner.metadata.deletion_timestamp is not None:
             return None
+        wid = obtrace.new_window_id()
+        with obtrace.window_span("consolidate", window_id=wid,
+                                 provisioner=name):
+            return self._window(provisioner, name, wid)
 
+    def _window(self, provisioner, name: str, wid: str) -> Optional[float]:
+        """One consolidation window (the traced reconcile body)."""
+        t_gather = time.perf_counter()
         fleet: List[Node] = []
         pods_by_node: Dict[str, List[Pod]] = {}
         for node in self.kube.list("Node"):
@@ -217,33 +225,39 @@ class ConsolidationController:
             savings.append(prices.get(node.metadata.name, 0.0))
 
         CONSOLIDATION_WINDOW_CANDIDATES.set(float(len(cand_idx)))
+        obtrace.add_span("gather", t_gather, time.perf_counter(),
+                         fleet=len(fleet), candidates=len(cand_idx))
         if len(cand_idx) == 0 or len(bins) < 2:
             CONSOLIDATION_WINDOW_RECLAIMED.set(0.0)
             return self.REQUEUE_SECONDS
 
         t0 = time.perf_counter()
-        enc = encode_window(bins, cand_idx, cand_movable)
+        with obtrace.span("encode", candidates=len(cand_idx),
+                          bins=len(bins)):
+            enc = encode_window(bins, cand_idx, cand_movable)
         feasible, _, executor = dispatch_window(enc, self.whatif_config).fetch()
         solve_s = time.perf_counter() - t0
         CONSOLIDATION_SOLVE_SECONDS.observe(solve_s)
         CONSOLIDATION_CANDIDATES_TOTAL.inc(float(len(cand_idx)))
 
-        plan = plan_window(enc, feasible, savings,
-                           max_drains=self.max_actions_per_pass,
-                           incremental_targets=[i for _, i
-                                                in sorted(inc_targets)])
+        with obtrace.span("plan"):
+            plan = plan_window(enc, feasible, savings,
+                               max_drains=self.max_actions_per_pass,
+                               incremental_targets=[i for _, i
+                                                    in sorted(inc_targets)])
         CONSOLIDATION_WINDOW_RECLAIMED.set(plan.reclaimed_per_hour)
         if plan.actions:
             log.info(
                 "consolidation window: %d candidates → %d feasible → "
-                "%d drains reclaiming $%.4f/h (%s, %.3fs)",
+                "%d drains reclaiming $%.4f/h (%s, %.3fs) window_id=%s",
                 plan.evaluated, plan.feasible, len(plan.actions),
-                plan.reclaimed_per_hour, executor, solve_s)
+                plan.reclaimed_per_hour, executor, solve_s, wid)
         for action in plan.actions:
             node = fleet[action.bin]
             log.info("consolidating node %s (%d pods fit on surviving "
-                     "capacity; reclaims $%.4f/h)", node.metadata.name,
-                     len(enc.cand_pods[action.cand]), action.saving)
+                     "capacity; reclaims $%.4f/h) window_id=%s",
+                     node.metadata.name,
+                     len(enc.cand_pods[action.cand]), action.saving, wid)
             try:
                 self.kube.delete("Node", node.metadata.name,
                                  node.metadata.namespace)

@@ -47,6 +47,7 @@ from karpenter_tpu.cloudprovider.spi import CloudProvider, InstanceType
 from karpenter_tpu import pressure
 from karpenter_tpu.metrics.pressure import WINDOW_SPLITS_TOTAL
 from karpenter_tpu.metrics.registry import HISTOGRAMS
+from karpenter_tpu.obs import trace as obtrace
 from karpenter_tpu.runtime.kubecore import (
     AlreadyExists, ApiError, KubeCore, NotFound,
 )
@@ -156,6 +157,10 @@ class ProvisionerWorker:
         # their signatures stay engine-free. Only the worker thread writes
         # it during a pass; direct test calls see the default engine.
         self._current: Optional[ProvisionerEngine] = None
+        # the id of the window this worker is serving: the trace id of the
+        # window span AND the window_id= key on every window-scoped log
+        # line (present even with tracing disabled, so logs always join)
+        self._window_id: str = "-"
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         if provisioner is not None:
@@ -248,41 +253,57 @@ class ProvisionerWorker:
 
     # -- the hot loop (provisioner.go:84-120) --------------------------------
     def provision(self) -> Optional[SolveResult]:
+        t_wait0 = time.perf_counter()
         items, window = self.batcher.wait()
+        t_wait1 = time.perf_counter()
         try:
             if not items or self._stop.is_set():
                 return None
-            log.info("batched %d pods in %.2fs", len(items), window)
-            # dedupe within the batch: the non-blocking selection path can
-            # requeue a still-pending pod into the same window (selection.py
-            # concurrency note); packing it twice would double-count it.
-            # Then group by engine, PRESERVING the window's priority order
-            # within each group (dict insertion order) — a critical pod
-            # still lands in its engine's first chunk.
-            seen = set()
-            groups: Dict[Optional[str], List[Pod]] = {}
-            for item in items:
-                pname, p = item
-                key = (p.metadata.namespace, p.metadata.name)
-                if key in seen:
-                    continue
-                seen.add(key)
-                groups.setdefault(pname, []).append(p)
-            last_result = None
-            for pname, pods in groups.items():
-                eng = (self._engines.get(pname) if pname is not None
-                       else self._default_engine())
-                if eng is None:
-                    # provisioner deleted while its pods sat in the window:
-                    # the pods stay Pending and the selection requeue
-                    # re-routes them to a surviving provisioner
-                    log.info("dropping %d pod(s) for detached provisioner "
-                             "%s", len(pods), pname)
-                    continue
-                result = self._provision_group(eng, pods)
-                if result is not None:
-                    last_result = result
-            return last_result
+            wid = self._window_id = obtrace.new_window_id()
+            shard = self.shard or "0"
+            monitor = self.batcher._monitor()
+            with obtrace.window_span("provision", window_id=wid,
+                                     shard=shard,
+                                     pressure_level=int(monitor.level()),
+                                     pods=len(items)):
+                # the intake wait predates the window span; record it
+                # retroactively as its first child
+                obtrace.add_span("intake", t_wait0, t_wait1,
+                                 shard=shard, window_s=round(window, 4))
+                log.info("batched %d pods in %.2fs window_id=%s shard=%s",
+                         len(items), window, wid, shard)
+                # dedupe within the batch: the non-blocking selection path
+                # can requeue a still-pending pod into the same window
+                # (selection.py concurrency note); packing it twice would
+                # double-count it. Then group by engine, PRESERVING the
+                # window's priority order within each group (dict insertion
+                # order) — a critical pod still lands in its engine's first
+                # chunk.
+                seen = set()
+                groups: Dict[Optional[str], List[Pod]] = {}
+                for item in items:
+                    pname, p = item
+                    key = (p.metadata.namespace, p.metadata.name)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    groups.setdefault(pname, []).append(p)
+                last_result = None
+                for pname, pods in groups.items():
+                    eng = (self._engines.get(pname) if pname is not None
+                           else self._default_engine())
+                    if eng is None:
+                        # provisioner deleted while its pods sat in the
+                        # window: the pods stay Pending and the selection
+                        # requeue re-routes them to a surviving provisioner
+                        log.info("dropping %d pod(s) for detached "
+                                 "provisioner %s window_id=%s shard=%s",
+                                 len(pods), pname, wid, shard)
+                        continue
+                    result = self._provision_group(eng, pods)
+                    if result is not None:
+                        last_result = result
+                return last_result
         finally:
             self.batcher.flush()
 
@@ -305,8 +326,9 @@ class ProvisionerWorker:
             else:
                 WINDOW_SPLITS_TOTAL.inc(amount=float(len(chunks) - 1))
             log.info("pressure L%d: split %d-pod window into %d "
-                     "chunks of <=%d", int(monitor.level()), len(pods),
-                     len(chunks), split)
+                     "chunks of <=%d window_id=%s shard=%s",
+                     int(monitor.level()), len(pods), len(chunks), split,
+                     self._window_id, self.shard or "0")
         else:
             # L0: bound chunks to the pipeline's unit size so depth>1
             # has work to overlap. The SAME boundaries apply at depth 1
@@ -334,6 +356,15 @@ class ProvisionerWorker:
                 on_chunk=self._observe_chunk)
         finally:
             self._current = None
+            # tag the window span with the pipeline's measured overlap so
+            # traceview's overlap column comes from the same ledger as
+            # solver_overlap_seconds_total
+            cur = obtrace.current_context()
+            lw = eng.pipeline.last_window
+            if cur is not None and lw:
+                cur.tag(wall_s=round(lw.get("wall_s", 0.0), 6),
+                        overlap_s=round(lw.get("overlap_s", 0.0), 6),
+                        depth=lw.get("depth"))
         last_result = None
         for result in results:
             if result is not None:
@@ -348,7 +379,10 @@ class ProvisionerWorker:
         eng = self._engine()
         with HISTOGRAMS.time("scheduling_duration_seconds",
                              provisioner=eng.provisioner.metadata.name):
-            schedules = eng.scheduler.solve(eng.provisioner, pods)
+            with obtrace.span("feasibility",
+                              provisioner=eng.provisioner.metadata.name,
+                              pods=len(pods)):
+                schedules = eng.scheduler.solve(eng.provisioner, pods)
             problems = [
                 Problem(
                     constraints=s.constraints,
@@ -440,8 +474,20 @@ class ProvisionerWorker:
         """Create the node object (finalizer + not-ready taint) and bind pods
         (provisioner.go:159-198)."""
         provisioner = self._engine().provisioner
-        with HISTOGRAMS.time("bind_duration_seconds",
-                             provisioner=provisioner.metadata.name):
+        t_bind = time.perf_counter()
+        try:
+            return self._bind_traced(node, pods, provisioner)
+        finally:
+            # the window trace id rides as the exemplar, joining this
+            # histogram's tail back to one concrete window trace
+            HISTOGRAMS.histogram("bind_duration_seconds").observe(
+                time.perf_counter() - t_bind,
+                exemplar=obtrace.current_trace_id(),
+                provisioner=provisioner.metadata.name)
+
+    def _bind_traced(self, node: Node, pods: List[Pod],
+                     provisioner: Provisioner) -> Optional[str]:
+        with obtrace.span("bind", node=node.metadata.name, pods=len(pods)):
             node.metadata.namespace = ""
             node.metadata.finalizers.append(wellknown.TERMINATION_FINALIZER)
             node.metadata.labels.setdefault(
@@ -473,8 +519,9 @@ class ProvisionerWorker:
                     if "already bound" not in e and "already exists" not in e]
             for e in errs:
                 log.error("failed to bind to %s: %s", node.metadata.name, e)
-            log.info("bound %d pod(s) to node %s",
-                     len(pods) - len(errs), node.metadata.name)
+            log.info("bound %d pod(s) to node %s window_id=%s shard=%s",
+                     len(pods) - len(errs), node.metadata.name,
+                     self._window_id, self.shard or "0")
             # propagate instead of swallowing: the joined error surfaces
             # through CloudProvider.create → _launch → the provision loop's
             # error log, and the unbound pods remain provisionable so the

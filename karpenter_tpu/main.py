@@ -129,6 +129,28 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
     return manager
 
 
+def debug_vars() -> dict:
+    """The /debug/vars payload: one JSON snapshot of every internal ledger
+    an operator would otherwise need a debugger for — metric series (with
+    histogram exemplar trace ids), pressure signals, solver breaker state,
+    device-ring counters, tracer and flight-recorder state."""
+    import json  # noqa: F401 — callers json.dumps this; keep deps obvious
+
+    from karpenter_tpu.obs import flight, trace
+    from karpenter_tpu.solver import pipeline as _pipeline
+    from karpenter_tpu.solver.solve import solver_health
+
+    ring = _pipeline._RING  # peek: never allocate device memory from a GET
+    return {
+        "metrics": registry.DEFAULT.snapshot(),
+        "pressure": pressure.get_monitor().signals(),
+        "solver": solver_health(),
+        "ring": ring.counters() if ring is not None else None,
+        "trace": trace.state(),
+        "flight": flight.state(),
+    }
+
+
 class _Handler(BaseHTTPRequestHandler):
     manager: Optional[Manager] = None
 
@@ -137,6 +159,12 @@ class _Handler(BaseHTTPRequestHandler):
             body = registry.DEFAULT.expose().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
+        elif self.path == "/debug/vars":
+            import json
+
+            body = json.dumps(debug_vars(), indent=2, default=str).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
         elif self.path in ("/healthz", "/readyz"):
             ok = self.manager is None or self.manager.healthz()
             level = int(pressure.get_monitor().level())
@@ -184,6 +212,14 @@ def main(argv=None) -> int:
                                         burst=options.kube_client_burst)
     else:
         kube = KubeCore()
+    # observability wiring before any controller runs: the tracer and
+    # flight recorder must see the first window (docs/observability.md)
+    from karpenter_tpu.obs import flight, trace
+
+    if options.trace_enabled:
+        trace.enable(jax_annotations=options.trace_jax)
+    if options.flight_dir:
+        flight.configure(dir=options.flight_dir)
     manager = build_manager(kube, options)
     server = serve_observability(manager, options.metrics_port)
     # opt-in XLA device tracing (KARPENTER_PROFILE_PORT, SURVEY.md §5.1);
@@ -242,6 +278,12 @@ def main(argv=None) -> int:
         if elector is not None:
             elector.stop()
         server.shutdown()
+        if options.trace_dump:
+            try:
+                trace.dump_chrome(options.trace_dump)
+                log.info("trace dump written to %s", options.trace_dump)
+            except Exception as e:  # noqa: BLE001 — debug knob, never fatal
+                log.warning("trace dump failed: %s", e)
     # SIGTERM (rollout) is a clean exit; stopping WITHOUT a signal means
     # lost leadership → nonzero so the orchestrator restarts this replica
     # and it re-campaigns

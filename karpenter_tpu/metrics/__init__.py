@@ -1,0 +1,1 @@
+from karpenter_tpu.metrics import core  # noqa: F401  (attaches help text)

@@ -71,9 +71,14 @@ class Histogram:
         self._counts: Dict[LabelValues, List[int]] = {}
         self._sums: Dict[LabelValues, float] = {}
         self._totals: Dict[LabelValues, int] = {}
+        # exemplar per series: the trace id of one recent observation so a
+        # histogram quantile can be joined back to a concrete window trace
+        # (surfaced via /debug/vars, never in the Prometheus text format)
+        self._exemplars: Dict[LabelValues, Dict[str, object]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels) -> None:
         lv = _lv(labels)
         with self._lock:
             counts = self._counts.setdefault(lv, [0] * len(self.buckets))
@@ -82,6 +87,12 @@ class Histogram:
                     counts[i] += 1
             self._sums[lv] = self._sums.get(lv, 0.0) + value
             self._totals[lv] = self._totals.get(lv, 0) + 1
+            if exemplar is not None:
+                self._exemplars[lv] = {"trace_id": exemplar, "value": value}
+
+    def collect_exemplars(self) -> Dict[LabelValues, Dict[str, object]]:
+        with self._lock:
+            return dict(self._exemplars)
 
     @contextmanager
     def time(self, **labels):
@@ -103,20 +114,26 @@ class Registry:
         self._lock = threading.Lock()
 
     def gauge(self, name: str, help_: str = "") -> Gauge:
-        return self._get_or_create(name, lambda: Gauge(name, help_))
+        return self._get_or_create(name, lambda: Gauge(name, help_), help_)
 
     def counter(self, name: str, help_: str = "") -> Counter:
-        return self._get_or_create(name, lambda: Counter(name, help_))
+        return self._get_or_create(name, lambda: Counter(name, help_), help_)
 
     def histogram(self, name: str, help_: str = "",
                   buckets: Optional[List[float]] = None) -> Histogram:
-        return self._get_or_create(name, lambda: Histogram(name, help_, buckets))
+        return self._get_or_create(
+            name, lambda: Histogram(name, help_, buckets), help_)
 
-    def _get_or_create(self, name: str, factory):
+    def _get_or_create(self, name: str, factory, help_: str = ""):
         with self._lock:
-            if name not in self._metrics:
-                self._metrics[name] = factory()
-            return self._metrics[name]
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = self._metrics[name] = factory()
+            elif help_ and not metric.help:
+                # help attachment is order-independent: whichever call
+                # site carries the help text wins, whenever it runs
+                metric.help = help_
+            return metric
 
     @contextmanager
     def time(self, name: str, **labels):
@@ -130,6 +147,8 @@ class Registry:
             metrics = dict(self._metrics)
         for name, metric in sorted(metrics.items()):
             full = f"{NAMESPACE}_{name}"
+            if metric.help:
+                lines.append(f"# HELP {full} {metric.help}")
             if isinstance(metric, Histogram):
                 lines.append(f"# TYPE {full} histogram")
                 for lv, (counts, sum_, total) in metric.collect().items():
@@ -147,6 +166,37 @@ class Registry:
                 for lv, v in metric.collect().items():
                     lines.append(f"{full}{{{_fmt(lv)}}} {v}")
         return "\n".join(lines) + "\n"
+
+    def registered(self) -> Dict[str, object]:
+        """Name -> metric object view (tools/metrics_lint.py)."""
+        with self._lock:
+            return dict(self._metrics)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """JSON-serializable dump of every registered series — the
+        /debug/vars payload. Histograms report count/sum per series plus
+        the stored exemplar trace id when one was attached."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            metrics = dict(self._metrics)
+        for name, metric in sorted(metrics.items()):
+            if isinstance(metric, Histogram):
+                series = {}
+                exemplars = metric.collect_exemplars()
+                for lv, (_, sum_, total) in metric.collect().items():
+                    entry: Dict[str, object] = {"count": total, "sum": sum_}
+                    ex = exemplars.get(lv)
+                    if ex is not None:
+                        entry["exemplar"] = ex
+                    series[_fmt(lv)] = entry
+                out[name] = {"type": "histogram", "help": metric.help,
+                             "series": series}
+            else:
+                kind = "counter" if isinstance(metric, Counter) else "gauge"
+                out[name] = {"type": kind, "help": metric.help,
+                             "series": {_fmt(lv): v
+                                        for lv, v in metric.collect().items()}}
+        return out
 
 
 def _fmt_labels(lv: LabelValues) -> List[Tuple[str, str]]:

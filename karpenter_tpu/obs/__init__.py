@@ -1,0 +1,8 @@
+"""Observability layer: span tracing (`obs.trace`) + black-box flight
+recorder (`obs.flight`).
+
+Kept import-light: nothing here may import jax, controllers, or the
+solver — the hot path imports *us* on every window.
+"""
+
+from karpenter_tpu.obs import flight, trace  # noqa: F401

@@ -261,8 +261,15 @@ class PressureMonitor:
             self._last_eval = now
             if target > self._level:
                 log.warning("pressure rising: L%d -> L%d", self._level, target)
+                prev = self._level
                 self._level = target
                 self._below_since = None
+                if target >= PressureLevel.L3 > prev:
+                    # brownout entry: snapshot what the system was doing
+                    from karpenter_tpu.obs import flight
+
+                    flight.trip("pressure-l3", from_level=int(prev),
+                                intake_depth=sum(self._depths.values()))
             elif target < self._level:
                 if self._below_since is None:
                     self._below_since = now

@@ -37,6 +37,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from karpenter_tpu.metrics.pressure import INTAKE_QUEUE_DEPTH, PODS_SHED_TOTAL
+from karpenter_tpu.obs import trace
 from karpenter_tpu.pressure import bands as _bands
 from karpenter_tpu.pressure.bands import BANDS, RANK
 
@@ -302,4 +303,9 @@ class Batcher:
         self._note_depth(monitor, depth)
         window = now - start
         monitor.note_window(window)
+        # instant event only (the caller owns the window span and records
+        # the intake child retroactively): a trace shows each window close
+        # with what the batcher knew — size, leftover depth, pressure rung
+        trace.event("window-close", items=len(take), depth_left=depth,
+                    window_s=round(window, 4), pressure_level=level)
         return [e.item for e in take], window

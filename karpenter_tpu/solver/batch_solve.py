@@ -50,6 +50,7 @@ from karpenter_tpu.solver.solve import (
     SolveResult, SolverConfig, materialize, resolved_device_max_shapes,
     solve_with_packables,
 )
+from karpenter_tpu.obs import trace as obtrace
 from karpenter_tpu.utils.gcguard import gc_deferred
 from karpenter_tpu.utils.profiling import trace
 
@@ -188,6 +189,10 @@ class BatchHandle:
         self._batch_idx = batch_idx
         self._run = run
         self._results: Optional[List[SolveResult]] = None
+        # the dispatching window's span context rides on the handle so the
+        # fetch half — wherever (whichever thread) it runs — re-enters the
+        # same trace (obs/trace.py)
+        self._trace_ctx = obtrace.current_context()
 
     @property
     def in_flight(self) -> bool:
@@ -198,8 +203,10 @@ class BatchHandle:
         if self._results is not None:
             return self._results
         hedge.note_fetching(self)
-        with gc_deferred():
-            self._results = self._fetch()
+        with obtrace.use_context(self._trace_ctx), \
+                obtrace.span("fetch", batched=len(self._batch_idx)):
+            with gc_deferred():
+                self._results = self._fetch()
         return self._results
 
     def _fetch(self) -> List[SolveResult]:

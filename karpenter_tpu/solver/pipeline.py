@@ -62,6 +62,7 @@ from karpenter_tpu.metrics.pipeline import (
     PIPELINE_STAGE_SECONDS, SOLVER_DEVICE_BYTES_IN_USE,
     SOLVER_OVERLAP_SECONDS_TOTAL,
 )
+from karpenter_tpu.obs import trace
 from karpenter_tpu.solver import hedge
 
 log = logging.getLogger("karpenter.solver.pipeline")
@@ -179,10 +180,12 @@ class DeviceRing:
             new = _refill_jit(sharding, old.ndim)(old, host_array)
             self.refills += 1
             PIPELINE_RING_REFILLS_TOTAL.inc()
+            trace.event("ring-refill", buffer=name)
         else:
             new = jax.device_put(host_array, sharding)
             self.allocations += 1
             PIPELINE_RING_ALLOCATIONS_TOTAL.inc()
+            trace.event("ring-alloc", buffer=name)
         slot.arrays[name] = new
         return new
 
@@ -410,11 +413,14 @@ class SolvePipeline:
                                    on_chunk)
                 t0 = time.perf_counter()
                 prep = prepare(chunk)
+                tp = time.perf_counter()
                 handle = dispatch(prep)
                 t1 = time.perf_counter()
                 stats = {"marshal_s": t1 - t0}
                 PIPELINE_STAGE_SECONDS.observe(t1 - t0, stage="marshal",
                                                **self._slabels)
+                trace.add_span("marshal", t0, tp, **self._slabels)
+                trace.add_span("dispatch", tp, t1, **self._slabels)
                 inflight.append((prep, handle, t1, stats))
             while inflight:
                 self._complete(inflight.popleft(), consume, outs, on_chunk)
@@ -443,6 +449,12 @@ class SolvePipeline:
                                        **self._slabels)
         PIPELINE_STAGE_SECONDS.observe(t2 - t1, stage="launch_bind",
                                        **self._slabels)
+        # retroactive spans: the device-solve interval spans dispatch → the
+        # fetch materialize (its in-flight head IS the measured overlap)
+        trace.add_span("device_solve", t_disp, t1,
+                       inflight_s=round(stats["inflight_s"], 6),
+                       **self._slabels)
+        trace.add_span("launch_bind", t1, t2, **self._slabels)
         if on_chunk is not None:
             on_chunk(prep, stats)
         outs.append(out)

@@ -25,6 +25,7 @@ from karpenter_tpu.solver import host_ffd
 from karpenter_tpu.solver.adapter import (
     build_packables_cached, marshal_pods_interned,
 )
+from karpenter_tpu.obs import flight
 from karpenter_tpu.utils.gcguard import gc_deferred
 from karpenter_tpu.utils.profiling import trace
 
@@ -106,6 +107,8 @@ class _DeviceWatchdog:
                 _set_breaker_gauge(1)
             log.error("device solve watchdog tripped by fault injection — "
                       "circuit open for %.0fs", breaker_s)
+            flight.trip("watchdog-trip", reason="injected",
+                        breaker_s=breaker_s)
             raise TimeoutError("injected device watchdog trip")
 
         started = threading.Event()
@@ -140,6 +143,8 @@ class _DeviceWatchdog:
                     "device solve never started within %.0fs (worker "
                     "occupied) — circuit open for %.0fs (host executors "
                     "answer meanwhile)", timeout_s, breaker_s)
+                flight.trip("watchdog-trip", reason="queue-expired",
+                            timeout_s=timeout_s, breaker_s=breaker_s)
                 raise TimeoutError("device solve watchdog expired in queue")
             late_start = True
         # the run budget is what the queue left of timeout_s, floored at
@@ -163,6 +168,8 @@ class _DeviceWatchdog:
                 "device solve exceeded %.0fs — transport presumed hung; "
                 "circuit open for %.0fs (host executors answer meanwhile)",
                 timeout_s, breaker_s)
+            flight.trip("watchdog-trip", reason="run-expired",
+                        timeout_s=timeout_s, breaker_s=breaker_s)
             raise TimeoutError("device solve watchdog expired")
         with self._lock:
             self._open_until = 0.0  # success closes the breaker

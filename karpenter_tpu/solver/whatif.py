@@ -26,6 +26,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from karpenter_tpu.obs import trace as obtrace
 from karpenter_tpu.ops.whatif import (
     WhatIfEncoding, host_whatif, verify_and_commit)
 from karpenter_tpu.solver import solve as solve_module
@@ -84,6 +85,7 @@ class WhatIfHandle:
     _slot: Optional[object] = None   # DeviceRing slot to release on fetch
     _ring: Optional[object] = None
     _result: Optional[Tuple[np.ndarray, np.ndarray, str]] = None
+    _trace_ctx: Optional[object] = None  # dispatching window's span context
     dispatch_seconds: float = 0.0
 
     def fetch(self) -> Tuple[np.ndarray, np.ndarray, str]:
@@ -92,6 +94,12 @@ class WhatIfHandle:
         engine never stalls a reconcile on a sick transport."""
         if self._result is not None:
             return self._result
+        with obtrace.use_context(self._trace_ctx), \
+                obtrace.span("fetch", candidates=self.enc.n):
+            self._result = self._fetch()
+        return self._result
+
+    def _fetch(self) -> Tuple[np.ndarray, np.ndarray, str]:
         feas = slots = None
         executor = "host-whatif"
         if self._out is not None:
@@ -128,8 +136,7 @@ class WhatIfHandle:
         if feas is None:
             feas, slots = host_whatif(self.enc)
         record_executor(executor, count=max(self.enc.n, 1))
-        self._result = (feas, slots, executor)
-        return self._result
+        return (feas, slots, executor)
 
 
 def dispatch_window(enc: WhatIfEncoding,
@@ -139,7 +146,8 @@ def dispatch_window(enc: WhatIfEncoding,
     the padded bucket signature, so steady-state windows refill pinned
     device memory in place instead of allocating."""
     config = config or WhatIfConfig()
-    handle = WhatIfHandle(enc=enc, config=config)
+    handle = WhatIfHandle(enc=enc, config=config,
+                          _trace_ctx=obtrace.current_context())
     if (not config.use_device or not enc.device_ready
             or enc.cells < config.device_min_cells
             or solve_module._WATCHDOG.tripped()):
@@ -172,6 +180,8 @@ def dispatch_window(enc: WhatIfEncoding,
         log.exception("device what-if dispatch failed; host mirror fallback")
         handle._out = handle._slot = handle._ring = None
     handle.dispatch_seconds = time.perf_counter() - t0
+    obtrace.add_span("dispatch", t0, time.perf_counter(),
+                     candidates=enc.n)
     return handle
 
 

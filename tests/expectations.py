@@ -126,3 +126,31 @@ def eventually(fn, timeout: float = 10.0, interval: float = 0.05):
             last_err = e
             time.sleep(interval)
     raise last_err or AssertionError("eventually timed out")
+
+
+def host_loaded(note: str = "") -> bool:
+    """The shared loadavg guard for timing/throughput assertions: True
+    when the 1-minute loadavg meets or exceeds the core count, i.e. this
+    process does NOT have the machine to itself and wall-clock floors
+    are noise. Callers keep their correctness assertions unconditional
+    and gate only the timing ones:
+
+        if host_loaded("wire rate floor"):
+            ...skip/print...
+        else:
+            assert rate > 8
+
+    Prints a uniform diagnostic (visible with ``pytest -s``) so a
+    skipped floor is auditable in CI logs."""
+    import os
+
+    try:
+        load = os.getloadavg()[0]
+    except OSError:  # platform without getloadavg
+        return False
+    cpus = os.cpu_count() or 1
+    if load >= cpus:
+        tag = f" — skipping: {note}" if note else ""
+        print(f"\nhost loaded (loadavg {load:.1f} >= {cpus} cpus){tag}")
+        return True
+    return False

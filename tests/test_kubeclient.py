@@ -447,15 +447,9 @@ class TestControlPlaneOverTheWire:
             # timing floor only holds when this process has the machine to
             # itself: on a loaded CI host (1-min loadavg >= cores) the
             # convergence assertion above still ran, but the rate is noise.
-            try:
-                loaded = os.getloadavg()[0] >= (os.cpu_count() or 1)
-            except OSError:
-                loaded = False
-            if loaded:
-                print(f"wire throughput: host loaded "
-                      f"(loadavg {os.getloadavg()[0]:.1f}, "
-                      f"{os.cpu_count()} cpus) — skipping the rate floor")
-            else:
+            from tests.expectations import host_loaded
+
+            if not host_loaded("wire rate floor"):
                 assert rate > 8, (
                     f"wire control plane too slow: {rate:.0f} pods/s")
         finally:

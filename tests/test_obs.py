@@ -1,0 +1,494 @@
+"""Observability layer (karpenter_tpu/obs/, ISSUE 9).
+
+Covers: span parenting + cross-thread context carry, the disabled-mode
+no-allocation guarantee, the flight recorder's trigger ring and tagged
+dumps, span propagation across a pipeline fetch that trips the watchdog
+mid-flight (the chaos leg — the dump names the poisoned window and no
+problem is lost or duplicated), registry concurrency, /metrics help
+rendering, /debug/vars, and the metrics lint.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import sys
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.metrics.registry import DEFAULT, Registry
+from karpenter_tpu.obs import flight, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    trace.disable()
+    trace.reset()
+    flight.reset()
+    yield
+    trace.disable()
+    trace.reset()
+    flight.reset()
+    flight.configure(dir="", min_interval_s=5.0)
+
+
+class TestTracerCore:
+    def test_window_span_parents_children(self):
+        trace.enable()
+        with trace.window_span("provision", window_id="w-test-1",
+                               shard="3", pressure_level=1) as w:
+            with trace.span("feasibility", pods=10):
+                pass
+        spans = trace.snapshot()
+        names = {s["name"]: s for s in spans}
+        assert set(names) == {"provision", "feasibility"}
+        child, root = names["feasibility"], names["provision"]
+        assert root["trace_id"] == "w-test-1", \
+            "window id IS the trace id (logs join on it)"
+        assert child["trace_id"] == "w-test-1"
+        assert child["parent_id"] == root["span_id"]
+        assert root["tags"] == {"shard": "3", "pressure_level": 1}
+
+    def test_context_carries_across_threads(self):
+        """The dispatch/fetch split: a context captured at dispatch must
+        reparent spans recorded by another thread entirely."""
+        trace.enable()
+        captured = {}
+        with trace.window_span("provision", window_id="w-carry") as w:
+            captured["ctx"] = trace.current_context()
+        assert captured["ctx"] is w
+
+        def fetch_side():
+            with trace.use_context(captured["ctx"]):
+                with trace.span("fetch"):
+                    pass
+
+        t = threading.Thread(target=fetch_side)
+        t.start()
+        t.join()
+        fetch = [s for s in trace.snapshot() if s["name"] == "fetch"]
+        assert len(fetch) == 1
+        assert fetch[0]["trace_id"] == "w-carry"
+        assert fetch[0]["parent_id"] == w.span_id
+
+    def test_disabled_is_noop_singleton(self):
+        assert not trace.enabled()
+        s1 = trace.span("anything", k=1)
+        s2 = trace.window_span("provision")
+        assert s1 is s2, "disabled mode must hand back one shared no-op"
+        with s1 as inner:
+            assert inner.trace_id is None
+        trace.add_span("retro", 0.0, 1.0)
+        trace.event("instant")
+        assert trace.snapshot() == []
+        assert trace.current_context() is None
+
+    def test_window_ids_unique_even_disabled(self):
+        ids = {trace.new_window_id() for _ in range(100)}
+        assert len(ids) == 100
+        assert all(i.startswith("w-") for i in ids)
+
+    def test_chrome_events_shapes(self):
+        trace.enable()
+        with trace.window_span("provision", window_id="w-chrome"):
+            trace.event("ring-refill", buffer="pods")
+        evs = trace.chrome_events()
+        by_name = {e["name"]: e for e in evs}
+        assert by_name["provision"]["ph"] == "X"
+        assert by_name["provision"]["dur"] >= 0
+        assert by_name["ring-refill"]["ph"] == "i"
+        assert by_name["ring-refill"]["args"]["trace_id"] == "w-chrome"
+        assert by_name["ring-refill"]["args"]["buffer"] == "pods"
+
+    def test_dump_chrome_roundtrip(self, tmp_path):
+        trace.enable()
+        with trace.window_span("provision", window_id="w-dump"):
+            with trace.span("marshal"):
+                pass
+        path = trace.dump_chrome(str(tmp_path / "trace.json"))
+        payload = json.loads(open(path).read())
+        assert len(payload["traceEvents"]) == 2
+        assert payload["otherData"]["spans"]["enabled"] is True
+
+    def test_jax_annotations_mode_records_normally(self):
+        """--trace-jax: spans also enter jax.profiler.TraceAnnotation;
+        recording must be unaffected (and never crash if jax is odd)."""
+        trace.enable(jax_annotations=True)
+        with trace.window_span("provision", window_id="w-jax"):
+            with trace.span("device_solve"):
+                pass
+        names = {s["name"] for s in trace.snapshot()}
+        assert names == {"provision", "device_solve"}
+        assert trace.state()["jax_annotations"] is True
+
+    def test_measure_overhead_restores_state(self):
+        out = trace.measure_overhead(n=2_000)
+        assert out["disabled_ns_per_span"] < out["enabled_ns_per_span"]
+        assert not trace.enabled(), "measure must restore prior state"
+        assert trace.snapshot() == [], "probe spans must be dropped"
+
+
+class TestDisabledModeAllocations:
+    def test_no_steady_state_allocations(self):
+        """The ISSUE 9 acceptance bound: disabled tracing must not grow
+        the heap per call — span() hands back a preallocated singleton."""
+        assert not trace.enabled()
+        # warm any lazy interning (method wrappers, thread-local slot)
+        for _ in range(200):
+            with trace.span("steady"):
+                pass
+            trace.event("steady")
+        gc.collect()
+        before = sys.getallocatedblocks()
+        for _ in range(10_000):
+            with trace.span("steady"):
+                pass
+            trace.event("steady")
+        after = sys.getallocatedblocks()
+        # unrelated interpreter activity wiggles a handful of blocks; a
+        # per-call allocation would show up as >= 10k
+        assert after - before < 100, (
+            f"disabled tracer allocated {after - before} blocks / 10k spans")
+
+
+class TestFlightRecorder:
+    def test_trip_without_dir_stays_in_memory(self):
+        path = flight.trip("watchdog-trip", reason="test")
+        assert path is None
+        recent = flight.recent()
+        assert recent[-1]["trigger"] == "watchdog-trip"
+        assert recent[-1]["tags"]["reason"] == "test"
+        st = flight.state()
+        assert st["trips"] == 1 and st["dumps_written"] == 0
+
+    def test_trip_with_dir_writes_tagged_dump(self, tmp_path):
+        trace.enable()
+        flight.configure(dir=str(tmp_path), min_interval_s=0.0)
+        with trace.window_span("provision", window_id="w-flight"):
+            with trace.span("fetch"):
+                pass
+            path = flight.trip("pressure-l3", from_level=2)
+        assert path is not None and "pressure-l3" in path
+        payload = json.loads(open(path).read())
+        assert payload["trigger"] == "pressure-l3"
+        assert payload["tags"]["from_level"] == 2
+        assert payload["tags"]["trace_id"] == "w-flight", \
+            "the active window's trace id must ride along automatically"
+        # the ring snapshot carries the spans finished so far
+        assert any(e.get("name") == "fetch" for e in payload["events"])
+
+    def test_rate_limit_suppresses_dump_not_record(self, tmp_path):
+        flight.configure(dir=str(tmp_path), min_interval_s=60.0)
+        first = flight.trip("chaos-fault", kind="a")
+        second = flight.trip("chaos-fault", kind="b")
+        assert first is not None and second is None
+        assert len(flight.recent()) == 2, \
+            "rate limiting must only skip the file write"
+
+
+class TestWatchdogTripSpanPropagation:
+    """The chaos leg: a pipeline fetch that trips the watchdog mid-flight
+    must (a) surface the poisoned window's trace id in the flight dump
+    and (b) lose/duplicate nothing — fallback answers stay complete."""
+
+    @pytest.fixture()
+    def fresh_watchdog(self, monkeypatch):
+        from karpenter_tpu.solver import solve as solve_mod
+        from karpenter_tpu.solver.solve import _DeviceWatchdog
+
+        wd = _DeviceWatchdog()
+        monkeypatch.setattr(solve_mod, "_WATCHDOG", wd)
+        return wd
+
+    def _problems(self, n_problems=3, pods_each=30):
+        from karpenter_tpu.cloudprovider.fake.provider import instance_types
+        from karpenter_tpu.controllers.provisioning import universe_constraints
+        from karpenter_tpu.solver.batch_solve import Problem
+        from tests.expectations import unschedulable_pod
+
+        catalog = instance_types(6)
+        constraints = universe_constraints(catalog)
+        return [
+            Problem(constraints=constraints,
+                    pods=[unschedulable_pod(requests={"cpu": "500m"})
+                          for _ in range(pods_each)],
+                    instance_types=catalog)
+            for _ in range(n_problems)
+        ]
+
+    def test_fetch_trip_dump_names_poisoned_window(self, fresh_watchdog,
+                                                   monkeypatch, tmp_path):
+        from karpenter_tpu.solver import batch_solve as bs
+        from karpenter_tpu.solver.batch_solve import dispatch_batch, solve_batch
+        from karpenter_tpu.solver.solve import SolverConfig
+
+        problems = self._problems()
+        want = solve_batch(problems, config=SolverConfig(use_device=False))
+
+        trace.enable()
+        flight.configure(dir=str(tmp_path), min_interval_s=0.0)
+
+        # hang at the fetch seam (the materialize), exactly where a sick
+        # transport stalls — dispatch itself stays healthy
+        monkeypatch.setattr(bs, "_finish_device_batch",
+                            lambda *a, **kw: time.sleep(10.0))
+        wid = trace.new_window_id()
+        cfg = SolverConfig(device_min_pods=1, device_timeout_s=0.1,
+                           device_breaker_seconds=30.0, use_native=False)
+        with trace.window_span("provision", window_id=wid):
+            handle = dispatch_batch(problems, cfg)
+
+        # fetch on a DIFFERENT thread with no active span: the handle's
+        # captured context is the only way the trip can know its window
+        out = {}
+
+        def fetch_side():
+            out["results"] = handle.fetch()
+
+        t = threading.Thread(target=fetch_side)
+        t.start()
+        t.join(timeout=30.0)
+        assert not t.is_alive(), "fetch stalled behind the hung device call"
+        assert fresh_watchdog.tripped()
+
+        # (b) nothing lost, nothing duplicated: every problem answered
+        # once, node-for-node equal to the host baseline
+        got = out["results"]
+        assert len(got) == len(problems)
+        assert [r.node_count for r in got] == [r.node_count for r in want]
+
+        # (a) the flight dump is tagged with the trigger AND the poisoned
+        # window's trace id, carried dispatch -> cross-thread fetch
+        trips = [r for r in flight.recent()
+                 if r["trigger"] == "watchdog-trip"]
+        assert len(trips) == 1, "exactly one trip, no duplicates"
+        assert trips[0]["tags"]["trace_id"] == wid
+        assert trips[0]["tags"]["reason"] == "run-expired"
+        dumps = flight.recent_dumps()
+        assert len(dumps) == 1
+        payload = json.loads(open(dumps[0]).read())
+        assert payload["trigger"] == "watchdog-trip"
+        assert payload["tags"]["trace_id"] == wid
+        # the fetch span itself is in the buffered spans under the window
+        fetch_spans = [s for s in trace.snapshot()
+                       if s["name"] == "fetch" and s["trace_id"] == wid]
+        assert len(fetch_spans) == 1
+
+    def test_seeded_chaos_trip_is_tagged(self, fresh_watchdog, tmp_path):
+        """A chaos-injected watchdog trip (FaultPlan, seeded) must write a
+        dump tagged with both the chaos fault and the watchdog trigger."""
+        from karpenter_tpu.chaos import inject
+        from karpenter_tpu.solver.batch_solve import solve_batch
+        from karpenter_tpu.solver.solve import SolverConfig
+
+        problems = self._problems()
+        want = solve_batch(problems, config=SolverConfig(use_device=False))
+
+        trace.enable()
+        flight.configure(dir=str(tmp_path), min_interval_s=0.0)
+        plan = inject.FaultPlan(11, [
+            inject.FaultSpec("device", "solve", "watchdog-trip", 1)],
+            window=1)
+        inject.install(plan)
+        wid = trace.new_window_id()
+        try:
+            with trace.window_span("provision", window_id=wid):
+                got = solve_batch(problems, config=SolverConfig(
+                    device_min_pods=1, device_timeout_s=5.0,
+                    device_breaker_seconds=0.2, use_native=False))
+        finally:
+            inject.uninstall()
+        assert plan.fired_counts() == {
+            ("device", "solve", "watchdog-trip"): 1}
+        assert [r.node_count for r in got] == [r.node_count for r in want]
+        triggers = [r["trigger"] for r in flight.recent()]
+        assert "chaos-fault" in triggers
+        wd_trips = [r for r in flight.recent()
+                    if r["trigger"] == "watchdog-trip"]
+        assert len(wd_trips) == 1
+        assert wd_trips[0]["tags"]["reason"] == "injected"
+        assert wd_trips[0]["tags"]["trace_id"] == wid
+
+
+class TestRegistryConcurrency:
+    def test_parallel_inc_and_observe_exact(self):
+        """Shard workers hammer one registry concurrently; totals must be
+        exact (no lost updates under the GIL's preemption points)."""
+        reg = Registry()
+        counter = reg.counter("obs_smoke_total", "concurrency smoke")
+        hist = reg.histogram("obs_smoke_seconds", "concurrency smoke")
+        workers, per = 8, 2_000
+        start = threading.Barrier(workers)
+
+        def worker(i):
+            start.wait()
+            for k in range(per):
+                counter.inc(shard=str(i % 2))
+                hist.observe(0.01 * (k % 7), exemplar=f"w-{i}-{k}",
+                             shard=str(i % 2))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = sum(counter.collect().values())
+        assert total == workers * per
+        hist_total = sum(tot for _, _, tot in hist.collect().values())
+        assert hist_total == workers * per
+        # each series kept exactly one (latest-wins) exemplar
+        for lv, ex in hist.collect_exemplars().items():
+            assert ex["trace_id"].startswith("w-")
+
+    def test_histogram_exemplar_surfaces_in_snapshot_not_text(self):
+        reg = Registry()
+        hist = reg.histogram("obs_exemplar_seconds", "exemplar smoke")
+        hist.observe(0.2, exemplar="w-ex-1", provisioner="default")
+        text = reg.expose()
+        assert "w-ex-1" not in text, \
+            "exemplars must stay out of the Prometheus text format"
+        snap = reg.snapshot()
+        series = snap["obs_exemplar_seconds"]["series"]
+        (entry,) = series.values()
+        assert entry["count"] == 1
+        assert entry["exemplar"]["trace_id"] == "w-ex-1"
+
+
+class TestMetricsEndpointAndLint:
+    def test_every_registered_series_renders_with_help(self):
+        from tools.metrics_lint import REGISTERING_MODULES
+        import importlib
+
+        for mod in REGISTERING_MODULES:
+            importlib.import_module(mod)
+        exposed = DEFAULT.expose()
+        registered = DEFAULT.registered()
+        assert registered, "no metrics registered?"
+        for name, metric in sorted(registered.items()):
+            assert metric.help, f"{name} lacks help text"
+            assert f"# HELP karpenter_{name} {metric.help}" in exposed, \
+                f"{name} renders without its HELP line"
+
+    def test_metrics_lint_passes(self):
+        from tools.metrics_lint import lint
+
+        assert lint() == []
+
+    def test_lint_import_list_matches_registration_sites(self):
+        """Keep tools/metrics_lint.py's module list honest: every file
+        registering a metric at import time must be on it."""
+        import os
+        import re
+
+        from tools.metrics_lint import REGISTERING_MODULES
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pat = re.compile(r"\.(?:gauge|counter|histogram)\(")
+        found = set()
+        pkg = os.path.join(root, "karpenter_tpu")
+        for dirpath, _, files in os.walk(pkg):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, root)
+                mod = rel[:-3].replace(os.sep, ".")
+                if mod == "karpenter_tpu.metrics.registry":
+                    continue  # defines the registry, registers nothing
+                with open(path) as f:
+                    if pat.search(f.read()):
+                        found.add(mod)
+        missing = found - set(REGISTERING_MODULES)
+        assert not missing, (
+            f"metric registration sites missing from metrics_lint: {missing}")
+
+
+class TestTraceview:
+    def test_analyze_critical_path_and_overlap(self):
+        """Synthetic window: intake 0-100ms, device_solve 100-300ms,
+        launch_bind 200-400ms — 100ms of genuine overlap, and the
+        sweep-line charges device_solve only its un-hidden 100ms."""
+        from tools.traceview import analyze
+
+        def x(name, t0_ms, t1_ms, **args):
+            return {"name": name, "ph": "X", "ts": t0_ms * 1000.0,
+                    "dur": (t1_ms - t0_ms) * 1000.0, "pid": 1, "tid": 1,
+                    "args": {"trace_id": "w-tv", **args}}
+
+        events = [
+            x("provision", 0, 400, shard="0"),
+            x("intake", 0, 100, parent_id=1),
+            x("device_solve", 100, 300, parent_id=1),
+            x("launch_bind", 200, 400, parent_id=1),
+        ]
+        (r,) = analyze(events)
+        assert r["window"] == "w-tv" and r["kind"] == "provision"
+        assert r["wall_s"] == pytest.approx(0.4)
+        assert r["overlap_s"] == pytest.approx(0.1)
+        assert r["coverage"] == pytest.approx(1.0)
+        assert r["stages"]["device_solve"] == pytest.approx(0.2)
+        crit = r["critical_path"]
+        # launch_bind starts later, so it owns 200-400; device_solve only
+        # its exclusive 100-200 slice
+        assert crit["device_solve"] == pytest.approx(0.1)
+        assert crit["launch_bind"] == pytest.approx(0.2)
+        assert crit["intake"] == pytest.approx(0.1)
+        assert sum(crit.values()) == pytest.approx(0.4), \
+            "exclusive times must tile the covered window"
+
+    def test_real_dump_roundtrips_through_traceview(self, tmp_path):
+        from tools.traceview import analyze
+
+        trace.enable()
+        wid = trace.new_window_id()
+        with trace.window_span("provision", window_id=wid, shard="1"):
+            with trace.span("intake"):
+                time.sleep(0.002)
+            with trace.span("device_solve"):
+                time.sleep(0.002)
+        path = trace.dump_chrome(str(tmp_path / "t.json"))
+        events = json.loads(open(path).read())["traceEvents"]
+        (r,) = analyze(events)
+        assert r["window"] == wid
+        assert set(r["stages"]) == {"intake", "device_solve"}
+        assert r["overlap_s"] == pytest.approx(0.0, abs=1e-6)
+        assert 0 < r["coverage"] <= 1.0
+
+
+class TestDebugVars:
+    def test_payload_shape_and_serializable(self):
+        from karpenter_tpu.main import debug_vars
+
+        payload = debug_vars()
+        assert set(payload) >= {"metrics", "pressure", "solver", "ring",
+                                "trace", "flight"}
+        json.dumps(payload, default=str)
+        assert payload["trace"]["enabled"] in (True, False)
+        assert "trips" in payload["flight"]
+
+    def test_http_endpoints(self):
+        """GET /metrics and /debug/vars off the real handler."""
+        import urllib.request
+        from http.server import ThreadingHTTPServer
+
+        from karpenter_tpu import main as main_mod
+
+        handler = type("H", (main_mod._Handler,), {"manager": None})
+        server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        port = server.server_address[1]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                text = r.read().decode()
+            assert "# HELP karpenter_" in text
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/vars", timeout=10) as r:
+                payload = json.loads(r.read().decode())
+            assert "metrics" in payload and "flight" in payload
+        finally:
+            server.shutdown()

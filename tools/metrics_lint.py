@@ -1,0 +1,86 @@
+"""Metrics lint: every registered series must be documented.
+
+``make metrics-lint`` (tier-1 tooling) fails if any metric registered in
+the process-wide registry
+
+- lacks help text (renders without a ``# HELP`` line on /metrics), or
+- is absent from the docs metric tables (``karpenter_<name>`` must
+  appear somewhere under docs/ — the canonical tables live in
+  docs/observability.md).
+
+The import list below is the closed set of modules that register
+metrics; a new registration site must be added here or its metrics
+escape the lint (the test in tests/test_obs.py greps for call sites to
+keep the list honest).
+"""
+
+from __future__ import annotations
+
+import glob
+import importlib
+import os
+import sys
+
+# Runnable as `python tools/metrics_lint.py`: sys.path[0] is tools/, so
+# the package root must be added before the karpenter_tpu imports.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# Every module with a top-level metric registration (grep for
+# DEFAULT/HISTOGRAMS .gauge(/.counter(/.histogram( to regenerate).
+REGISTERING_MODULES = [
+    "karpenter_tpu.metrics.core",
+    "karpenter_tpu.metrics.consolidation",
+    "karpenter_tpu.metrics.pipeline",
+    "karpenter_tpu.metrics.pressure",
+    "karpenter_tpu.metrics.filter",
+    "karpenter_tpu.solver.solve",
+    "karpenter_tpu.solver.hedge",
+    "karpenter_tpu.controllers.provisioning",
+    "karpenter_tpu.controllers.metrics_controllers",
+    "karpenter_tpu.controllers.gc",
+]
+
+
+def lint(docs_glob: str = os.path.join(_ROOT, "docs", "*.md")) -> list:
+    for mod in REGISTERING_MODULES:
+        importlib.import_module(mod)
+    from karpenter_tpu.metrics.registry import DEFAULT, NAMESPACE
+
+    docs_text = ""
+    for path in sorted(glob.glob(docs_glob)):
+        with open(path) as f:
+            docs_text += f.read()
+    problems = []
+    registered = DEFAULT.registered()
+    if not registered:
+        return ["no metrics registered — import list is broken"]
+    for name, metric in sorted(registered.items()):
+        if not getattr(metric, "help", ""):
+            problems.append(f"{name}: no help text (add it to the "
+                            "registration site or metrics/core.py)")
+        if f"{NAMESPACE}_{name}" not in docs_text:
+            problems.append(f"{name}: {NAMESPACE}_{name} missing from the "
+                            "docs metric tables (docs/observability.md)")
+    return problems
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    problems = lint()
+    if problems:
+        for p in problems:
+            print(f"metrics-lint: {p}", file=sys.stderr)
+        print(f"metrics-lint: FAIL ({len(problems)} problem(s))",
+              file=sys.stderr)
+        return 1
+    from karpenter_tpu.metrics.registry import DEFAULT
+
+    print(f"metrics-lint: OK ({len(DEFAULT.registered())} metrics, "
+          "all helped + documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,9 +41,12 @@ def verdict(line: dict) -> str:
     ring = ab.get("ring_pipelined", {})
     ring_note = (f"ring={ring.get('allocations', '?')} allocs/"
                  f"{ring.get('refills', '?')} refills")
+    tax = (cfg.get("trace_overhead") or {}).get("est_tax_pct")
+    tax_note = f" trace_tax={tax}%" if tax is not None else ""
     head = (f"pipeline A/B: {speedup}x (depth {ab.get('depth_pipelined')} "
             f"vs {ab.get('depth_serial')}) devices={devices} "
-            f"nodes_equal={nodes_equal} fallbacks={fallbacks} {ring_note}")
+            f"nodes_equal={nodes_equal} fallbacks={fallbacks} "
+            f"{ring_note}{tax_note}")
     if devices is None or devices < GATE_DEVICES:
         return (f"{head} — GATE N/A (needs device_count >= {GATE_DEVICES}; "
                 "rerun with --devices 2)")

@@ -1,0 +1,208 @@
+"""Trace analysis: per-window critical path from a Chrome-trace dump.
+
+Two modes:
+
+- ``python tools/traceview.py TRACE.json`` — read a dump written by
+  ``karpenter_tpu.obs.trace.dump_chrome`` and print, per window
+  (trace id): wall seconds, per-stage totals and % of wall, the
+  critical path (stages in start order with exclusive seconds), and
+  measured overlap seconds (sum of stage durations minus their union —
+  the pipelining win the stage spans actually observed).
+- ``... | python tools/traceview.py --bench`` — bench/verdict chaining:
+  stdin JSON passes through UNCHANGED on stdout (same contract as
+  tools/*_verdict.py), the dump path is located under a ``trace_dump``
+  key anywhere in the bench line, and the table goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# Window-root span names emitted by the controllers/bench.
+WINDOW_KINDS = ("provision", "consolidate", "replay")
+
+
+def _spans(events: List[dict]) -> List[dict]:
+    return [e for e in events if e.get("ph") == "X" and "dur" in e]
+
+
+def _union_seconds(ivals: List[Tuple[float, float]]) -> float:
+    """Total length of the union of [t0, t1) intervals (µs in, s out)."""
+    total = 0.0
+    end = None
+    for t0, t1 in sorted(ivals):
+        if end is None or t0 > end:
+            total += t1 - t0
+            end = t1
+        elif t1 > end:
+            total += t1 - end
+            end = t1
+    return total / 1e6
+
+
+def _exclusive_seconds(stages: List[dict]) -> Dict[str, float]:
+    """Sweep-line: at every instant covered by >=1 stage span, charge the
+    latest-starting active span. The result is each stage's share of the
+    critical path (the wall time it alone accounts for)."""
+    points: List[Tuple[float, int, dict]] = []
+    for s in stages:
+        points.append((s["ts"], 1, s))
+        points.append((s["ts"] + s["dur"], 0, s))
+    points.sort(key=lambda p: (p[0], p[1]))
+    active: List[dict] = []
+    excl: Dict[str, float] = {}
+    prev = None
+    for t, kind, s in points:
+        if active and prev is not None and t > prev:
+            top = max(active, key=lambda a: a["ts"])
+            excl[top["name"]] = excl.get(top["name"], 0.0) + (t - prev) / 1e6
+        if kind == 1:
+            active.append(s)
+        else:
+            active.remove(s)
+        prev = t
+    return excl
+
+
+def analyze(events: List[dict]) -> List[Dict[str, Any]]:
+    """One report dict per window trace found in the event list."""
+    by_trace: Dict[str, List[dict]] = {}
+    for e in _spans(events):
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(e)
+    reports = []
+    for tid, evs in sorted(by_trace.items()):
+        roots = [e for e in evs
+                 if not (e.get("args") or {}).get("parent_id")
+                 and e["name"] in WINDOW_KINDS]
+        root = max(roots, key=lambda e: e["dur"]) if roots else None
+        stages = [e for e in evs if e is not root]
+        if root is None and not stages:
+            continue
+        # wall = the trace's full extent, root included: retroactive
+        # children (the intake wait is timed BEFORE its window span
+        # opens) extend the window beyond the root span's own duration
+        wall = (max(e["ts"] + e["dur"] for e in evs)
+                - min(e["ts"] for e in evs)) / 1e6
+        totals: Dict[str, float] = {}
+        order: Dict[str, float] = {}
+        for e in sorted(stages, key=lambda e: e["ts"]):
+            totals[e["name"]] = totals.get(e["name"], 0.0) + e["dur"] / 1e6
+            order.setdefault(e["name"], e["ts"])
+        union = _union_seconds([(e["ts"], e["ts"] + e["dur"])
+                                for e in stages])
+        overlap = max(0.0, sum(totals.values()) - union)
+        reports.append({
+            "window": tid,
+            "kind": root["name"] if root else "(rootless)",
+            "tags": dict((root.get("args") or {})) if root else {},
+            "wall_s": wall,
+            "stages": totals,
+            "first_ts": {k: v for k, v in order.items()},
+            "critical_path": _exclusive_seconds(stages),
+            "overlap_s": overlap,
+            "coverage": (union / wall) if wall else 0.0,
+        })
+    return reports
+
+
+def render(reports: List[Dict[str, Any]], out=sys.stdout) -> None:
+    if not reports:
+        print("traceview: no window traces in dump", file=out)
+        return
+    print(f"traceview: {len(reports)} window(s)", file=out)
+    for r in reports:
+        tags = r["tags"]
+        extra = "".join(
+            f" {k}={tags[k]}" for k in ("shard", "pressure_level", "pods",
+                                        "depth", "overlap_s")
+            if k in tags)
+        print(f"\nwindow {r['window']} ({r['kind']}) "
+              f"wall={r['wall_s']:.4f}s overlap={r['overlap_s']:.4f}s "
+              f"coverage={r['coverage']:.1%}{extra}", file=out)
+        print(f"  {'stage':<16}{'total_s':>10}{'% wall':>9}{'critical_s':>12}",
+              file=out)
+        wall = r["wall_s"] or 1.0
+        crit = r["critical_path"]
+        for name in sorted(r["stages"], key=lambda n: r["first_ts"][n]):
+            tot = r["stages"][name]
+            print(f"  {name:<16}{tot:>10.4f}{tot / wall:>8.1%}"
+                  f"{crit.get(name, 0.0):>12.4f}", file=out)
+        path = " -> ".join(
+            f"{n}({crit[n]:.3f}s)"
+            for n in sorted(crit, key=lambda n: r["first_ts"].get(n, 0.0)))
+        print(f"  critical path: {path}", file=out)
+
+
+def _find_key(obj: Any, key: str) -> Optional[Any]:
+    if isinstance(obj, dict):
+        if key in obj:
+            return obj[key]
+        for v in obj.values():
+            hit = _find_key(v, key)
+            if hit is not None:
+                return hit
+    elif isinstance(obj, list):
+        for v in obj:
+            hit = _find_key(v, key)
+            if hit is not None:
+                return hit
+    return None
+
+
+def _bench_mode() -> int:
+    """Verdict-chain filter: JSON stdin -> stdout unchanged, table ->
+    stderr from the dump named by the line's ``trace_dump`` key."""
+    dump_path = None
+    for raw in sys.stdin:
+        sys.stdout.write(raw)
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            line = json.loads(raw)
+        except ValueError:
+            continue
+        hit = _find_key(line, "trace_dump")
+        if hit:
+            dump_path = hit
+    sys.stdout.flush()
+    if not dump_path:
+        print("traceview: no trace_dump in bench output — NO TABLE",
+              file=sys.stderr)
+        return 1
+    try:
+        with open(dump_path) as f:
+            events = json.load(f).get("traceEvents", [])
+    except OSError as e:
+        print(f"traceview: cannot read {dump_path}: {e}", file=sys.stderr)
+        return 1
+    reports = analyze(events)
+    render(reports, out=sys.stderr)
+    return 0 if reports else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        "traceview", description="per-window critical path from a trace dump")
+    p.add_argument("dump", nargs="?", help="Chrome-trace JSON path")
+    p.add_argument("--bench", action="store_true",
+                   help="stdin-passthrough mode for bench verdict chains")
+    args = p.parse_args(argv)
+    if args.bench:
+        return _bench_mode()
+    if not args.dump:
+        p.error("a dump path is required outside --bench mode")
+    with open(args.dump) as f:
+        events = json.load(f).get("traceEvents", [])
+    reports = analyze(events)
+    render(reports)
+    return 0 if reports else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
