@@ -779,6 +779,124 @@ def config_9_million_pod_replay():
     }
 
 
+def config_10_marshal_delta():
+    """Round-10 gate: the incremental window encode (docs/solver.md §14).
+    A steady-state window stream (20k pods, ~10% object churn per window)
+    is marshalled + encoded through the exact production entry points
+    (marshal_pods_interned → build_packables_versioned → encode) twice per
+    window: DELTA (warm arena + versioned catalog cache — the round-10
+    steady state) and COLD (arena, catalog cache and per-pod handles
+    cleared first — the pre-round-10 cost). Each window's two encodings
+    are compared bit-for-bit; the last window also solves end-to-end both
+    ways (node count + bound-set parity), and a donate-leg repeat solve
+    proves the steady-state ring ships zero fresh catalog transfers.
+    `make bench-marshal` gates via tools/marshal_verdict.py."""
+    import random as _random
+
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.metrics.marshal import MARSHAL_DELTA_FRACTION
+    from karpenter_tpu.ops import encode as enc_mod
+    from karpenter_tpu.solver import adapter
+    from karpenter_tpu.solver.pipeline import get_ring
+    from karpenter_tpu.solver.solve import SolverConfig, solve
+
+    catalog = make_catalog(100)
+    constraints = universe_constraints(catalog)
+    n, windows, churn = 20_000, 12, 0.10
+    rng = _random.Random(42)
+
+    # deterministic window stream: each window replaces ~10% of pod
+    # OBJECTS (fresh handles, same shape population — kube churn), so
+    # ~90% of pods carry their arena row handle into the next window
+    pop = list(make_pods(n, MIXED_SHAPES))
+    streams = []
+    for _ in range(windows + 1):
+        k = int(n * churn)
+        fresh = make_pods(k, MIXED_SHAPES)
+        for j, idx in enumerate(rng.sample(range(n), k)):
+            pop[idx] = fresh[j]
+        streams.append(list(pop))
+
+    def marshal_encode(win):
+        vecs, required, sids = adapter.marshal_pods_interned(win)
+        packables, _st, ver = adapter.build_packables_versioned(
+            catalog, constraints, win, [], required=required)
+        return enc_mod.encode(vecs, list(range(len(win))), packables,
+                              pad=False, sids=sids, catalog_version=ver)
+
+    def clear_all(win):
+        # the pre-round-10 state: no arena rows, no per-pod handles, no
+        # cached catalog tensors (the packables cache predates round 10
+        # and stays warm in both legs)
+        for p in win:
+            p.__dict__.pop("_marshal", None)
+            p.__dict__.pop("_arena_row", None)
+        enc_mod.reset_marshal_arena()
+        enc_mod.clear_catalog_encoding_cache()
+
+    def enc_key(e):
+        return (e.shapes.tobytes(), e.counts.tobytes(), e.totals.tobytes(),
+                e.reserved0.tobytes(), e.valid.tobytes(), e.last_valid,
+                e.num_shapes, e.num_types, e.shape_pods, e.scales,
+                e.pods_unit)
+
+    marshal_encode(streams[0])  # warm the arena + caches (untimed)
+    cold_times, delta_times, parity = [], [], True
+    for win in streams[1:]:
+        t0 = time.perf_counter()
+        e_delta = marshal_encode(win)       # arena warm from prior window
+        delta_times.append(time.perf_counter() - t0)
+        frac = MARSHAL_DELTA_FRACTION.collect().get((), None)
+        clear_all(win)
+        t0 = time.perf_counter()
+        e_cold = marshal_encode(win)        # repopulates for next delta
+        cold_times.append(time.perf_counter() - t0)
+        parity = parity and enc_key(e_delta) == enc_key(e_cold)
+
+    # end-to-end solve parity on the final window, delta vs cold
+    def bound_key(win, result):
+        pos = {id(p): i for i, p in enumerate(win)}
+        return (result.node_count, sorted(
+            (tuple(it.name for it in p.instance_type_options),
+             p.node_quantity,
+             sorted(tuple(sorted(pos[id(pod)] for pod in node))
+                    for node in p.pods))
+            for p in result.packings))
+
+    final = streams[-1]
+    k_delta = bound_key(final, solve(constraints, final, catalog))
+    clear_all(final)
+    k_cold = bound_key(final, solve(constraints, final, catalog))
+
+    # steady-state device leg: an identical repeat solve through the solo
+    # donate ring must allocate nothing fresh — catalog buffers answer by
+    # token (reuses), only the donated counts buffer refills
+    small = final[:400]
+    dcfg = SolverConfig(device_min_pods=1, device_donate=True)
+    solve(constraints, small, catalog, config=dcfg)  # populate the ring
+    c0 = get_ring().counters()
+    solve(constraints, small, catalog, config=dcfg)
+    c1 = get_ring().counters()
+    steady = {k: c1[k] - c0.get(k, 0) for k in c1}
+
+    st_cold = _stats(cold_times)
+    st_delta = _stats(delta_times)
+    speedup = round(st_cold["p50_ms"] / (st_delta["p50_ms"] or 1e-9), 2)
+    return {
+        "pods": n, "windows": windows, "churn": churn,
+        "cold_p50_ms": st_cold["p50_ms"], "cold_p99_ms": st_cold["p99_ms"],
+        "delta_p50_ms": st_delta["p50_ms"], "delta_p99_ms": st_delta["p99_ms"],
+        "speedup": speedup,
+        "delta_fraction": frac,
+        "encode_parity": bool(parity),
+        "solve_parity": bool(k_delta == k_cold),
+        "node_count": k_delta[0],
+        "steady_ring": steady,
+        "fresh_catalog_transfers": steady.get("allocations", -1),
+        "arena": enc_mod.marshal_arena().stats(),
+    }
+
+
 def jax_devices_first():
     import jax
 
@@ -1135,7 +1253,10 @@ def _only_set():
 
 
 def _selected(key: str, only) -> bool:
-    return only is None or any(key == o or key.startswith(o) for o in only)
+    # prefix match on a full name segment: `config_1` must not also select
+    # config_10_marshal_delta
+    return only is None or any(key == o or key.startswith(o + "_")
+                               for o in only)
 
 
 def run_all(degraded: bool, probe_note: str = ""):
@@ -1164,6 +1285,7 @@ def run_all(degraded: bool, probe_note: str = ""):
         ("config_7_control_plane_10k_pods", config_7_control_plane),
         ("config_8_large_catalog_type_spmd", config_8_large_catalog_type_spmd),
         ("config_9_million_pod_replay", config_9_million_pod_replay),
+        ("config_10_marshal_delta", config_10_marshal_delta),
     ):
         if not _selected(key, only):
             continue

@@ -35,6 +35,13 @@ Round-8 additions (device ring + adaptive depth):
   donation-aliased refills of existing ring buffers (the steady-state
   path: same device memory, new chunk data).
 
+Round-10 addition (persistent device catalog):
+
+- ``karpenter_pipeline_ring_reuses_total``     counter — fills skipped
+  entirely because the slot already holds the SAME content (token match:
+  the versioned catalog encoding or identical bytes). Zero host→device
+  transfer — the steady-state catalog path.
+
 ``pipeline_depth`` now reports the ADAPTIVE effective depth: the
 per-window overlap measurement steps it 1↔2↔3 (solver/pipeline.py
 _AdaptiveDepth), and pressure L1+ still collapses it to 1.
@@ -72,3 +79,7 @@ PIPELINE_RING_REFILLS_TOTAL = DEFAULT.counter(
     "pipeline_ring_refills_total",
     "In-place donation-aliased refills of existing ring buffers "
     "(steady-state chunk intake: zero fresh device allocation)")
+PIPELINE_RING_REUSES_TOTAL = DEFAULT.counter(
+    "pipeline_ring_reuses_total",
+    "Ring fills skipped because the slot already holds the same content "
+    "(catalog token match: zero host-to-device transfer)")

@@ -84,6 +84,7 @@ def solve_ffd_device(
     pallas_max_shapes: int = 8192,  # pallas-validated bucket ceiling
     hedge: bool = True,  # tail-mitigating second fetch (solver/hedge.py)
     compact: bool = True,  # active-shape compaction at chunk boundaries
+    donate: bool = False,  # solo DeviceRing: refill/reuse device buffers
 ) -> Optional[HostSolveResult]:
     """Solve on device; None when the problem is not device-encodable
     (caller falls back to the host oracle). Pods may arrive unsorted; the
@@ -106,7 +107,19 @@ def solve_ffd_device(
     bucket (ops/compact.py), so a solve that starts at the 8192+ bucket
     runs its later chunks on the small-S kernel. Provably a no-op for the
     packing result (docs/solver.md, "shape compaction & re-bucketing");
-    disable only to compare against the straight-line chunk loop."""
+    disable only to compare against the straight-line chunk loop.
+
+    ``donate``: route the problem tensors through the process DeviceRing
+    (solver/pipeline.py) — the batched path's contract extended to solo
+    solves. Steady-state windows REFILL the previous solve's device
+    buffers in place (donation-aliased DUS: a stale read of the consumed
+    buffer raises, never returns garbage), and buffers whose content
+    token matches — the catalog tensors via the encoder's versioned
+    catalog token, shapes via a byte digest — skip the host→device
+    transfer entirely. The solo kernels don't donate their inputs, so
+    hedged duplicate dispatches stay safe; a loser reading a buffer the
+    next chunk's refill consumed raises into the hedger, which swallows
+    loser errors by contract."""
     import jax
 
     from karpenter_tpu.ops.encode import pad_encoding
@@ -187,130 +200,209 @@ def solve_ffd_device(
 
     S, L = enc.shapes.shape[0], chunk_iters
     T_pad = enc.totals.shape[0]
-    # one host→device transfer for the whole problem (tunnel-latency bound)
-    dev = jax.device_put(device_args(enc))
-    (shapes_d, counts_d, dropped_d, totals, reserved0, valid, last_valid,
-     pods_unit) = dev
+    args = device_args(enc)
+    ring = slot = _ring_sh = None
+    if donate and kernel in ("xla", "pallas"):
+        # type-spmd stays off-ring: its tensors live under a mesh sharding
+        # the single-device refill pjit can't alias
+        try:
+            from jax.sharding import SingleDeviceSharding
 
-    # the fast-forward bound depends only on (shapes, totals, reserved0,
-    # valid) — all chunk-invariant — so it is computed ONCE per solve and
-    # passed into every chunk (sliced through compactions below); the
-    # type-spmd kernel computes its own sharded bound per chunk instead
-    # (one local reduce + pmax, no replicated extra input)
-    takes_maxfit = kernel in ("xla", "pallas")
-    maxfit_d = None
-    maxfit_full = np.zeros(S, np.int32)
-    if takes_maxfit:
-        from karpenter_tpu.ops.pack import compute_maxfit
+            from karpenter_tpu.solver.pipeline import DeviceRing, get_ring
 
-        maxfit_d = jax.jit(compute_maxfit)(shapes_d, totals, reserved0,
-                                           valid)
-        maxfit_full = np.asarray(maxfit_d)
+            _ring_sh = SingleDeviceSharding(jax.devices()[0])
+            _names = ("shapes", "counts", "dropped", "totals", "reserved0",
+                      "valid", "last_valid", "pods_unit")
+            ring = get_ring()
+            slot = ring.acquire(DeviceRing.signature(
+                {f"solo_{n}": a for n, a in zip(_names, args)}))
+        except Exception:
+            ring = slot = None
+    if slot is not None:
+        import hashlib
 
-    def fetch_chunk(shapes_now, counts_now, dropped_now, maxfit_now, S_now):
-        # the per-chunk dispatch+fetch, optionally hedged: tunnel jitter
-        # puts occasional >200 ms spikes on an otherwise ~72 ms RTT-bound
-        # leg; the hedger re-issues the (deterministic) chunk when a fetch
-        # overruns its own recent wall time and takes whichever lands first
-        hedge_key = (kernel, S_now, T_pad, chunk_iters, use_cost)
+        cat = enc.catalog_token
+        tok = (lambda field: ("solo", field, cat)) if cat is not None \
+            else (lambda field: None)
+        shapes_tok = ("bytes", hashlib.blake2b(
+            np.ascontiguousarray(args[0]).tobytes(), digest_size=16).digest())
+        fill = lambda name, arr, token=None: ring.fill(  # noqa: E731
+            slot, name, arr, _ring_sh, token=token)
+        shapes_d = fill("solo_shapes", args[0], shapes_tok)
+        counts_d = fill("solo_counts", args[1])
+        # the device dropped buffer is an INPUT (solo kernels don't mutate
+        # it) and is zeros at every chunk start — always token-reusable
+        dropped_d = fill("solo_dropped", args[2], ("zeros", args[2].shape))
+        totals = fill("solo_totals", args[3], tok("totals"))
+        reserved0 = fill("solo_reserved0", args[4], tok("reserved0"))
+        valid = fill("solo_valid", args[5], tok("valid"))
+        last_valid = fill("solo_last_valid", args[6], tok("last_valid"))
+        pods_unit = fill("solo_pods_unit", args[7], tok("pods_unit"))
+    else:
+        # one host→device transfer for the whole problem (tunnel-latency
+        # bound)
+        (shapes_d, counts_d, dropped_d, totals, reserved0, valid,
+         last_valid, pods_unit) = jax.device_put(args)
 
-        def dispatch():
-            kw = {"maxfit": maxfit_now} if takes_maxfit else {}
-            return np.asarray(_chunk(
-                shapes_now, counts_now, dropped_now, totals, reserved0,
-                valid, last_valid, pods_unit, num_iters=chunk_iters, **kw))
+    try:
+        # the fast-forward bound depends only on (shapes, totals, reserved0,
+        # valid) — all chunk-invariant — so it is computed ONCE per solve and
+        # passed into every chunk (sliced through compactions below); the
+        # type-spmd kernel computes its own sharded bound per chunk instead
+        # (one local reduce + pmax, no replicated extra input)
+        takes_maxfit = kernel in ("xla", "pallas")
+        maxfit_d = None
+        maxfit_full = np.zeros(S, np.int32)
+        if takes_maxfit:
+            from karpenter_tpu.ops.pack import compute_maxfit
 
-        if not hedge:
-            return dispatch()
-        from karpenter_tpu.solver.hedge import FETCHER
+            maxfit_d = jax.jit(compute_maxfit)(shapes_d, totals, reserved0,
+                                               valid)
+            maxfit_full = np.asarray(maxfit_d)
 
-        return FETCHER.fetch(hedge_key, dispatch)
+        def fetch_chunk(shapes_now, counts_now, dropped_now, maxfit_now,
+                        S_now):
+            # the per-chunk dispatch+fetch, optionally hedged: tunnel jitter
+            # puts occasional >200 ms spikes on an otherwise ~72 ms RTT-bound
+            # leg; the hedger re-issues the (deterministic) chunk when a fetch
+            # overruns its own recent wall time and takes whichever lands
+            # first
+            hedge_key = (kernel, S_now, T_pad, chunk_iters, use_cost)
 
-    records = []  # (chosen, qty, packed-vector | sparse [(shape, n), ...])
-    if not compact and S * L >= _PIPELINE_ELEMS:
-        # High-cardinality regime with compaction disabled: the (L, S)
-        # record buffer is megabytes and the tunnel moves ~45 MB/s, so the
-        # fetch — not the kernel — bounds the wall time. Pipeline: keep
-        # the counts/dropped carry DEVICE-RESIDENT (sliced from the flat
-        # buffer, no host round-trip between chunks), speculatively
-        # dispatch chunk n+1, and overlap its compute with chunk n's async
-        # copy-out. A speculatively dispatched chunk after `done` is a
-        # no-op (the kernel's while loop exits immediately) and is never
-        # fetched. With compaction ON (the default) this path is skipped:
-        # shrinking S at each boundary cuts both the kernel and the fetch
-        # for every later chunk, which beats overlapping full-size ones.
-        # Hedging does not apply here — these fetches are bandwidth-bound,
-        # not jitter-bound (solver/hedge.py MAX_HEDGEABLE_WALL_S).
-        kw = {"maxfit": maxfit_d} if takes_maxfit else {}
-        buf = _chunk(shapes_d, counts_d, dropped_d, totals, reserved0,
-                     valid, last_valid, pods_unit, num_iters=chunk_iters,
-                     **kw)
-        dropped_h = None
+            def dispatch():
+                kw = {"maxfit": maxfit_now} if takes_maxfit else {}
+                return np.asarray(_chunk(
+                    shapes_now, counts_now, dropped_now, totals, reserved0,
+                    valid, last_valid, pods_unit, num_iters=chunk_iters,
+                    **kw))
+
+            if not hedge:
+                return dispatch()
+            from karpenter_tpu.solver.hedge import FETCHER
+
+            return FETCHER.fetch(hedge_key, dispatch)
+
+        records = []  # (chosen, qty, packed-vec | sparse [(shape, n), ...])
+        if not compact and S * L >= _PIPELINE_ELEMS:
+            # High-cardinality regime with compaction disabled: the (L, S)
+            # record buffer is megabytes and the tunnel moves ~45 MB/s, so
+            # the fetch — not the kernel — bounds the wall time. Pipeline:
+            # keep the counts/dropped carry DEVICE-RESIDENT (sliced from the
+            # flat buffer, no host round-trip between chunks), speculatively
+            # dispatch chunk n+1, and overlap its compute with chunk n's
+            # async copy-out. A speculatively dispatched chunk after `done`
+            # is a no-op (the kernel's while loop exits immediately) and is
+            # never fetched. With compaction ON (the default) this path is
+            # skipped: shrinking S at each boundary cuts both the kernel and
+            # the fetch for every later chunk, which beats overlapping
+            # full-size ones. Hedging does not apply here — these fetches
+            # are bandwidth-bound, not jitter-bound (solver/hedge.py
+            # MAX_HEDGEABLE_WALL_S).
+            kw = {"maxfit": maxfit_d} if takes_maxfit else {}
+            buf = _chunk(shapes_d, counts_d, dropped_d, totals, reserved0,
+                         valid, last_valid, pods_unit,
+                         num_iters=chunk_iters, **kw)
+            dropped_h = None
+            for _ in range(MAX_CHUNKS):
+                try:
+                    buf.copy_to_host_async()
+                except Exception:
+                    pass  # fetch below still works, just unoverlapped
+                next_buf = _chunk(
+                    shapes_d, buf[:S], buf[S:2 * S], totals, reserved0,
+                    valid, last_valid, pods_unit, num_iters=chunk_iters,
+                    **kw)
+                counts_h, dropped_h, done, chosen_h, q_h, packed_h = \
+                    unpack_flat(np.asarray(buf), S, L)
+                for i in range(L):
+                    if q_h[i] > 0:
+                        records.append(
+                            (int(chosen_h[i]), int(q_h[i]), packed_h[i]))
+                if done:
+                    break
+                buf = next_buf
+            else:
+                return None  # did not converge — impossible by construction
+            return _decode(enc, records, dropped_h, packables,
+                           max_instance_types)
+
+        # Chunk loop with active-shape compaction at the boundaries
+        # (ops/compact.py): FFD consumes shapes in descending order, so the
+        # alive set shrinks front-to-back; once it fits a smaller
+        # power-of-two bucket, the remaining chunks run the small-S kernel.
+        # ``perm`` maps compacted rows back to original shape indices;
+        # ``dropped`` is passed to the kernel as zeros each chunk and the
+        # per-chunk delta is scattered into the original index space
+        # host-side.
+        from karpenter_tpu.ops.compact import (
+            compact_alive, scatter_dropped, sparse_record,
+        )
+
+        shapes_full = np.asarray(enc.shapes)
+        dropped_full = np.zeros(S, np.int64)
+        perm = None
+        S_cur = S
         for _ in range(MAX_CHUNKS):
-            try:
-                buf.copy_to_host_async()
-            except Exception:
-                pass  # fetch below still works, just unoverlapped
-            next_buf = _chunk(
-                shapes_d, buf[:S], buf[S:2 * S], totals, reserved0, valid,
-                last_valid, pods_unit, num_iters=chunk_iters, **kw)
+            # one device→host fetch per chunk; typical solves need one chunk
             counts_h, dropped_h, done, chosen_h, q_h, packed_h = unpack_flat(
-                np.asarray(buf), S, L)
+                fetch_chunk(shapes_d, counts_d, dropped_d, maxfit_d, S_cur),
+                S_cur, L)
             for i in range(L):
                 if q_h[i] > 0:
-                    records.append(
-                        (int(chosen_h[i]), int(q_h[i]), packed_h[i]))
+                    rec = (packed_h[i] if perm is None
+                           else sparse_record(packed_h[i], perm))
+                    records.append((int(chosen_h[i]), int(q_h[i]), rec))
+            scatter_dropped(dropped_full, dropped_h, perm)
             if done:
                 break
-            buf = next_buf
+            c = (compact_alive(counts_h, perm, shapes_full, maxfit_full)
+                 if compact else None)
+            if c is not None:
+                perm, S_cur = c.perm, c.num_shapes
+                if slot is not None:
+                    # re-bucket: smaller arrays — fill() sees the mismatch
+                    # and makes COUNTED fresh allocations (compaction is an
+                    # event, not the steady state the zero-alloc gate
+                    # measures); maxfit joins the same ledger
+                    shapes_d = ring.fill(slot, "solo_shapes", c.shapes,
+                                         _ring_sh)
+                    counts_d = ring.fill(slot, "solo_counts", c.counts,
+                                         _ring_sh)
+                    dropped_d = ring.fill(slot, "solo_dropped",
+                                          np.zeros(S_cur, np.int32),
+                                          _ring_sh,
+                                          token=("zeros", (S_cur,)))
+                    if takes_maxfit:
+                        maxfit_d = jax.device_put(c.maxfit)
+                        ring.note_allocation(1)
+                    else:
+                        maxfit_d = None
+                else:
+                    shapes_d, counts_d, dropped_d = jax.device_put(
+                        (c.shapes, c.counts, np.zeros(S_cur, np.int32)))
+                    maxfit_d = (jax.device_put(c.maxfit) if takes_maxfit
+                                else None)
+            elif slot is not None:
+                # non-compact resume: the counts row refills the previous
+                # chunk's buffer in place (donating DUS — a stale read of
+                # the consumed buffer raises); the zeros row token-matches
+                # and ships nothing
+                counts_d = ring.fill(slot, "solo_counts", counts_h,
+                                     _ring_sh)
+                dropped_d = ring.fill(slot, "solo_dropped",
+                                      np.zeros_like(counts_h), _ring_sh,
+                                      token=("zeros", counts_h.shape))
+            else:
+                counts_d, dropped_d = jax.device_put(
+                    (counts_h, np.zeros_like(counts_h)))
         else:
             return None  # did not converge — impossible by construction
-        return _decode(enc, records, dropped_h, packables,
+
+        return _decode(enc, records, dropped_full, packables,
                        max_instance_types)
-
-    # Chunk loop with active-shape compaction at the boundaries
-    # (ops/compact.py): FFD consumes shapes in descending order, so the
-    # alive set shrinks front-to-back; once it fits a smaller power-of-two
-    # bucket, the remaining chunks run the small-S kernel. ``perm`` maps
-    # compacted rows back to original shape indices; ``dropped`` is passed
-    # to the kernel as zeros each chunk and the per-chunk delta is
-    # scattered into the original index space host-side.
-    from karpenter_tpu.ops.compact import (
-        compact_alive, scatter_dropped, sparse_record,
-    )
-
-    shapes_full = np.asarray(enc.shapes)
-    dropped_full = np.zeros(S, np.int64)
-    perm = None
-    S_cur = S
-    for _ in range(MAX_CHUNKS):
-        # one device→host fetch per chunk; typical solves need one chunk
-        counts_h, dropped_h, done, chosen_h, q_h, packed_h = unpack_flat(
-            fetch_chunk(shapes_d, counts_d, dropped_d, maxfit_d, S_cur),
-            S_cur, L)
-        for i in range(L):
-            if q_h[i] > 0:
-                rec = (packed_h[i] if perm is None
-                       else sparse_record(packed_h[i], perm))
-                records.append((int(chosen_h[i]), int(q_h[i]), rec))
-        scatter_dropped(dropped_full, dropped_h, perm)
-        if done:
-            break
-        c = (compact_alive(counts_h, perm, shapes_full, maxfit_full)
-             if compact else None)
-        if c is not None:
-            perm, S_cur = c.perm, c.num_shapes
-            shapes_d, counts_d, dropped_d = jax.device_put(
-                (c.shapes, c.counts, np.zeros(S_cur, np.int32)))
-            maxfit_d = (jax.device_put(c.maxfit) if takes_maxfit else None)
-        else:
-            counts_d, dropped_d = jax.device_put(
-                (counts_h, np.zeros_like(counts_h)))
-    else:
-        return None  # did not converge — impossible by construction
-
-    return _decode(enc, records, dropped_full, packables,
-                   max_instance_types)
+    finally:
+        if slot is not None:
+            ring.release(slot)
 
 
 def solve_ffd_numpy(

@@ -14,11 +14,18 @@ oracle — exactness is never traded for speed (the ±1 node-count target).
 from __future__ import annotations
 
 import math
+import os
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from karpenter_tpu.metrics.marshal import (
+    CATALOG_ENCODING_REBUILDS_TOTAL, MARSHAL_DELTA_FRACTION,
+    MARSHAL_ROW_CACHE_EVICTIONS_TOTAL, MARSHAL_ROW_CACHE_HITS_TOTAL,
+    MARSHAL_ROW_CACHE_MISSES_TOTAL,
+)
 from karpenter_tpu.solver.host_ffd import NUM_RESOURCES, Packable, R_PODS, Vec
 
 INT32_LIMIT = 2**31 - 1
@@ -58,6 +65,11 @@ class EncodedProblem:
     shape_pods: List[List[int]]   # pod ids per shape, pack order
     scales: Tuple[int, ...]   # per-resource divisor (nano → device units)
     pods_unit: int = 1        # one pod in device units (10**9 / scales[R_PODS])
+    # content identity of the catalog-side tensors (totals/reserved0/valid):
+    # set when the encoding came through the versioned catalog cache, so the
+    # device ring can skip re-uploading bytes it already holds. None =
+    # unversioned (every fill ships).
+    catalog_token: Optional[tuple] = None
 
 
 def _gcd_scale(columns: List[List[int]]) -> Optional[Tuple[int, ...]]:
@@ -100,12 +112,214 @@ def _dedupe_interned(sids: np.ndarray, gen: int, pod_ids: Sequence[int]):
     return ([uniq_vecs[i] for i in order], counts_ord.tolist(), groups)
 
 
+# -- delta-marshal row arena -------------------------------------------------
+#
+# The window marshal's steady state: consecutive replay windows share almost
+# all of their pods, so re-deriving (interned shape id, special mask) per pod
+# per window is pure rework. The arena pins each distinct marshal row —
+# (sid, special) — in numpy columns; a pod caches its row index (plus the
+# arena generation it was minted in) on its __dict__, and a window's sid
+# tensor is ONE numpy gather over the cached rows. Only new or churned
+# signatures pay the Python encode.
+#
+# Invalidation is generational, never in place: the arena generation bumps
+# whenever (a) the adapter's shape intern table rebinds (cached sids would
+# dangle), (b) the feasibility vocab rebinds (the columnar topology/schedule
+# columns derived alongside the marshal must not outlive their vocab), or
+# (c) the row capacity overflows. A generation bump voids every cached
+# per-pod row atomically (the mismatch makes them misses), so a stale row
+# can never be gathered — the chaos suite in tests/test_marshal_delta.py
+# forces mid-window bumps and pins bit-for-bit equality with the cold path.
+
+
+def _arena_max_from_env() -> int:
+    raw = os.environ.get("KARPENTER_MARSHAL_ARENA_MAX", "")
+    if not raw.strip():
+        return 1 << 20
+    try:
+        return max(1, int(raw.strip()))
+    except ValueError:
+        import logging
+
+        logging.getLogger("karpenter.ops.encode").warning(
+            "KARPENTER_MARSHAL_ARENA_MAX=%r is not an integer; using "
+            "default %d", raw, 1 << 20)
+        return 1 << 20
+
+
+class MarshalArena:
+    """Pinned, signature-keyed marshal rows (see module block comment)."""
+
+    def __init__(self, cap: Optional[int] = None):
+        self.cap = cap if cap is not None else _arena_max_from_env()
+        self.generation = 0
+        self._lock = threading.Lock()
+        size = min(4096, self.cap)
+        self._sids = np.empty(max(size, 1), np.int64)
+        self._special = np.empty(max(size, 1), np.int64)
+        self._rows: dict = {}          # (sid, special) -> row index
+        self._n = 0
+        self._adapter_gen: Optional[int] = None
+        self._vocab_gen: Optional[int] = None
+
+    def _reset_locked(self, adapter_gen, vocab_gen) -> None:
+        if self._n:
+            MARSHAL_ROW_CACHE_EVICTIONS_TOTAL.inc(amount=float(self._n))
+        self._rows.clear()
+        self._n = 0
+        self.generation += 1
+        self._adapter_gen = adapter_gen
+        self._vocab_gen = vocab_gen
+
+    def begin_window(self, adapter_gen: int) -> int:
+        """Validate against the live intern generations (adapter shape table
+        + feasibility vocab); a mismatch resets the arena. Returns the arena
+        generation cached pod rows must carry to count as hits."""
+        from karpenter_tpu.ops import feasibility
+
+        vocab_gen = feasibility.intern_table_stats()[1]
+        with self._lock:
+            if (self._adapter_gen != adapter_gen
+                    or self._vocab_gen != vocab_gen):
+                self._reset_locked(adapter_gen, vocab_gen)
+            return self.generation
+
+    def assign(self, sid: int, special: int,
+               adapter_gen: int) -> Tuple[int, int]:
+        """Row index for (sid, special), minting one on first sight.
+        Returns (row, generation) — the generation may have advanced past
+        the caller's ``begin_window`` (capacity rollover, or the adapter
+        table rebound mid-window); the caller must then restart its gather,
+        because every previously collected row index is void."""
+        with self._lock:
+            if adapter_gen != self._adapter_gen:
+                self._reset_locked(adapter_gen, self._vocab_gen)
+            row = self._rows.get((sid, special))
+            if row is None:
+                if self._n >= self.cap:
+                    self._reset_locked(self._adapter_gen, self._vocab_gen)
+                n = self._n
+                if n >= self._sids.shape[0]:
+                    grown = min(max(self._sids.shape[0] * 2, 1024), self.cap)
+                    self._sids = np.resize(self._sids, grown)
+                    self._special = np.resize(self._special, grown)
+                self._sids[n] = sid
+                self._special[n] = special
+                self._rows[(sid, special)] = n
+                self._n = n + 1
+                row = n
+            return row, self.generation
+
+    def gather(self, rows: np.ndarray,
+               generation: int) -> Optional[Tuple[np.ndarray, int, int]]:
+        """(sid array, OR of special masks, adapter generation) for a
+        window's row indices — the single-gather assembly of the window's
+        pod tensor inputs. None when the arena generation moved past the
+        caller's (concurrent reset): every collected row index is void and
+        the caller must restart its window."""
+        with self._lock:
+            if generation != self.generation:
+                return None
+            sids = self._sids[rows]
+            if rows.size:
+                special = int(np.bitwise_or.reduce(self._special[rows]))
+            else:
+                special = 0
+            return sids, special, self._adapter_gen
+
+    def note_window(self, hits: int, misses: int) -> None:
+        if hits:
+            MARSHAL_ROW_CACHE_HITS_TOTAL.inc(amount=float(hits))
+        if misses:
+            MARSHAL_ROW_CACHE_MISSES_TOTAL.inc(amount=float(misses))
+        total = hits + misses
+        if total:
+            MARSHAL_DELTA_FRACTION.set(misses / total)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"rows": self._n, "generation": self.generation}
+
+
+_ARENA: Optional[MarshalArena] = None
+_ARENA_LOCK = threading.Lock()
+
+
+def marshal_arena() -> MarshalArena:
+    """The process-wide arena (marshal rows are process-wide state, exactly
+    like the shape intern table they index into)."""
+    global _ARENA
+    with _ARENA_LOCK:
+        if _ARENA is None:
+            _ARENA = MarshalArena()
+        return _ARENA
+
+
+def reset_marshal_arena() -> None:
+    """Drop the process arena (tests; a fresh arena re-counts from zero)."""
+    global _ARENA
+    with _ARENA_LOCK:
+        _ARENA = None
+
+
+# -- versioned catalog encoding cache ----------------------------------------
+#
+# The catalog-side device tensors (totals/reserved0/valid) are a pure
+# function of (packables-cache version, GCD scales, padded T): the version
+# identifies the exact packable list — catalog tokens + constraints-derived
+# allowed sets + daemon vectors + required resources, i.e. catalog token +
+# constraints fingerprint (adapter.build_packables_versioned) — and the
+# scales couple the catalog columns to the pod columns of the SAME window.
+# Steady-state windows repeat the key, so they reuse the shared read-only
+# arrays AND inherit a content token the device ring uses to skip the
+# host→device upload entirely (pipeline.DeviceRing.fill token match).
+
+_CATALOG_ENC_LOCK = threading.Lock()
+_CATALOG_ENC_CACHE: dict = {}
+_CATALOG_ENC_CAP = 32
+
+
+def _catalog_encoding(catalog_version: int, scales: Tuple[int, ...],
+                      packables: Sequence[Packable], TB: int):
+    """(totals, reserved0, valid, token) at padded size ``TB`` — shared
+    read-only arrays, rebuilt (and counted) only on a fresh key."""
+    T = len(packables)
+    key = (catalog_version, scales, TB)
+    with _CATALOG_ENC_LOCK:
+        hit = _CATALOG_ENC_CACHE.get(key)
+    if hit is not None:
+        return hit
+    totals = np.zeros((TB, NUM_RESOURCES), np.int32)
+    reserved0 = np.zeros((TB, NUM_RESOURCES), np.int32)
+    valid = np.zeros((TB,), bool)
+    for t, p in enumerate(packables):
+        totals[t] = [v // g for v, g in zip(p.total, scales)]
+        reserved0[t] = [v // g for v, g in zip(p.reserved, scales)]
+        valid[t] = True
+    for arr in (totals, reserved0, valid):
+        arr.setflags(write=False)
+    entry = (totals, reserved0, valid, ("cat", catalog_version, scales, TB))
+    CATALOG_ENCODING_REBUILDS_TOTAL.inc()
+    with _CATALOG_ENC_LOCK:
+        if len(_CATALOG_ENC_CACHE) >= _CATALOG_ENC_CAP:
+            _CATALOG_ENC_CACHE.pop(next(iter(_CATALOG_ENC_CACHE)))
+        _CATALOG_ENC_CACHE[key] = entry
+    return entry
+
+
+def clear_catalog_encoding_cache() -> None:
+    """Tests: force the next window to rebuild (and count) fresh."""
+    with _CATALOG_ENC_LOCK:
+        _CATALOG_ENC_CACHE.clear()
+
+
 def encode(
     pod_vecs: Sequence[Vec],
     pod_ids: Sequence[int],
     packables: Sequence[Packable],
     pad: bool = True,
     sids: Optional[Tuple[np.ndarray, int]] = None,
+    catalog_version: Optional[int] = None,
 ) -> Optional[EncodedProblem]:
     """Returns None when the problem can't be encoded exactly (host fallback).
 
@@ -184,19 +398,25 @@ def encode(
     for s in range(S):
         shapes[s] = [v // g for v, g in zip(shape_vecs[s], scales)]
         counts_a[s] = counts[s]
-    totals = np.zeros((TB, NUM_RESOURCES), np.int32)
-    reserved0 = np.zeros((TB, NUM_RESOURCES), np.int32)
-    valid = np.zeros((TB,), bool)
-    for t, p in enumerate(packables):
-        totals[t] = [v // g for v, g in zip(p.total, scales)]
-        reserved0[t] = [v // g for v, g in zip(p.reserved, scales)]
-        valid[t] = True
+    token: Optional[tuple] = None
+    if catalog_version is not None:
+        totals, reserved0, valid, token = _catalog_encoding(
+            catalog_version, scales, packables, TB)
+    else:
+        totals = np.zeros((TB, NUM_RESOURCES), np.int32)
+        reserved0 = np.zeros((TB, NUM_RESOURCES), np.int32)
+        valid = np.zeros((TB,), bool)
+        for t, p in enumerate(packables):
+            totals[t] = [v // g for v, g in zip(p.total, scales)]
+            reserved0[t] = [v // g for v, g in zip(p.reserved, scales)]
+            valid[t] = True
 
     return EncodedProblem(
         shapes=shapes, counts=counts_a, totals=totals, reserved0=reserved0,
         valid=valid, last_valid=T - 1, num_shapes=S, num_types=T,
         shape_pods=shape_pods, scales=scales,
         pods_unit=10**9 // scales[R_PODS],
+        catalog_token=token,
     )
 
 
@@ -226,4 +446,8 @@ def pad_encoding(enc: EncodedProblem) -> Optional[EncodedProblem]:
         valid=valid, last_valid=enc.last_valid, num_shapes=S, num_types=T,
         shape_pods=enc.shape_pods, scales=enc.scales,
         pods_unit=enc.pods_unit,
+        # the padded catalog content is a pure function of the exact content
+        # plus the bucket, so the identity extends rather than resets
+        catalog_token=(enc.catalog_token + ("pad", TB)
+                       if enc.catalog_token is not None else None),
     )

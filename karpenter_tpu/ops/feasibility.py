@@ -439,6 +439,87 @@ def constraints_key_parts(c: Constraints) -> tuple:
     return (reqs, taints, labels)
 
 
+def topology_allowed(cc: CompiledConstraints, sig, key: str):
+    """Columnar twin of the topology-spread allowed-domain query
+    (scheduling/topology.py):
+
+        constraints.requirements.add(*pod_requirements(pod).items)
+                   .requirement(key)
+
+    for any pod whose ``pod_signature`` is ``sig``. Returns the same
+    ``Optional[frozenset]``: None = unconstrained, a set = allowed domains.
+
+    The combined requirement list is the constraint rows (compiled into
+    ``cc.filters``) plus the pod rows (already normalized in the
+    signature); ``requirement()`` evaluates all In rows first, then all
+    NotIn rows, so list order beyond that split is irrelevant and the two
+    sides compose as set algebra:
+
+    - Constraint side has an In row (``kf.in_mask is not None``): the
+      result is a subset of the constraint's In set, which is fully
+      interned — exact mask algebra, pod values outside the vocab can
+      only shrink the intersection and drop out anyway. Surviving bits
+      decode back to strings through the key's vocab (under the intern
+      lock: the dict may be growing concurrently).
+    - Constraint side has only NotIn rows, or no rows at all: pod In
+      values the constraint never interned are legitimate members of the
+      result, so the pod side runs in string space and the constraint
+      NotIn mask is decoded to strings before subtraction. The Go quirk
+      carries over: any NotIn row with no In row anywhere collapses to
+      the empty set, never to "unconstrained" (requirements.go:189-194).
+    """
+    rows, _tols, _gpus = sig
+    pod_in: List[tuple] = []
+    pod_notin: List[tuple] = []
+    for k, op, vals in rows:
+        if k != key:
+            continue
+        if op == IN:
+            pod_in.append(vals)
+        elif op == NOT_IN:
+            pod_notin.append(vals)
+        # presence ops assert key existence only; requirement() skips them
+    kf = cc.filters.get(key)
+    if kf is not None and kf.in_mask is not None:
+        r = kf.in_mask
+        notin = kf.notin_mask
+        vocab = kf.vocab
+        for vals in pod_in:
+            m = 0
+            for v in vals:
+                b = vocab.get(v)
+                if b is not None:
+                    m |= b
+            r &= m
+        for vals in pod_notin:
+            for v in vals:
+                b = vocab.get(v)
+                if b is not None:
+                    notin |= b
+        r &= ~notin
+        out = set()
+        with _INTERN_LOCK:
+            for v, b in vocab.items():
+                if r & b:
+                    out.add(v)
+        return frozenset(out)
+    # string space: constraint contributes at most a NotIn mask
+    result: Optional[set] = None
+    for vals in pod_in:
+        s = set(vals)
+        result = s if result is None else (result & s)
+    if kf is not None and kf.has_notin:
+        notin_vals = set()
+        with _INTERN_LOCK:
+            for v, b in kf.vocab.items():
+                if kf.notin_mask & b:
+                    notin_vals.add(v)
+        result = (result or set()) - notin_vals
+    for vals in pod_notin:
+        result = (result or set()) - set(vals)
+    return frozenset(result) if result is not None else None
+
+
 def validate_pod_fast(constraints: Constraints, pod: Pod) -> Optional[str]:
     """Engine-accelerated ``constraints.validate_pod(pod)`` — identical
     verdicts and error strings, scalar on any fallback condition."""

@@ -69,6 +69,7 @@ class Scheduler:
         engine = feasibility.compile_constraints(constraints)
         schedules: Dict[tuple, Schedule] = {}
         skipped = 0
+        topo_skipped = 0
         samples: List[str] = []
         for pod in pods:
             if engine is not None:
@@ -80,6 +81,9 @@ class Scheduler:
                     key = _constraints_key(tightened, res.gpu_limits_for(pod))
             if err is not None:
                 skipped += 1
+                if pod.__dict__.get("_topology_unsat"):
+                    # topology.inject found no satisfiable spread domain
+                    topo_skipped += 1
                 if len(samples) < 5:
                     samples.append(f"{pod.metadata.namespace}/"
                                    f"{pod.metadata.name}: {err}")
@@ -90,8 +94,10 @@ class Scheduler:
                     constraints=tightened, pods=[])
             schedule.pods.append(pod)
         if skipped:
-            log.info("unable to schedule %d/%d pod(s) in window: %s",
-                     skipped, len(pods), "; ".join(samples))
+            log.info("unable to schedule %d/%d pod(s) in window "
+                     "(reason=topology: %d, other: %d): %s",
+                     skipped, len(pods), topo_skipped,
+                     skipped - topo_skipped, "; ".join(samples))
         FILTER_BATCH_SECONDS.observe(time.perf_counter() - t0,
                                      stage="schedule")
         return list(schedules.values())

@@ -9,6 +9,7 @@ constraint grouping, keeping the solver oblivious to topology.
 from __future__ import annotations
 
 import math
+import os
 import secrets
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -19,8 +20,12 @@ from karpenter_tpu.api.core import (
     NodeSelectorRequirement, Pod, TopologySpreadConstraint,
 )
 from karpenter_tpu.api.requirements import pod_requirements
+from karpenter_tpu.metrics.filter import FILTER_FALLBACK_TOTAL
+from karpenter_tpu.ops import feasibility
 from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
 from karpenter_tpu.utils import pod as podutil
+
+_UNSET = object()  # cache sentinel: None is a real value (unconstrained)
 
 
 @dataclass
@@ -81,18 +86,70 @@ class Topology:
         self.kube = kube
 
     def inject(self, constraints: Constraints, pods: List[Pod]) -> None:
+        """Columnar: the allowed-domain set for each pod is computed once
+        per pod *signature* through the compiled bitset engine
+        (feasibility.topology_allowed) instead of once per pod through the
+        scalar requirement algebra — a 50k-pod window with a handful of
+        distinct pod shapes pays a handful of set evaluations per group.
+
+        Exactness contract (same self-heal as validate_pod_fast): whenever
+        the columnar set yields no satisfiable domain (next_domain would
+        return ""), the scalar algebra recomputes the set once per
+        signature; a disagreement is counted as
+        karpenter_filter_fallback_total{reason="topology-mismatch"} and the
+        scalar answer wins, so a divergence can never strand a spreadable
+        pod. Signature-less pods (unsupported operators) and compile
+        failures take the scalar path outright, and
+        KARPENTER_TOPOLOGY_COLUMNAR=0 disables the columnar path entirely.
+
+        Pods that still end up with no satisfiable domain are marked
+        (``_topology_unsat``) so the scheduler's window summary can bucket
+        them under reason=topology."""
         groups = self._get_topology_groups(pods)
+        columnar = os.environ.get(
+            "KARPENTER_TOPOLOGY_COLUMNAR", "").strip() != "0"
+        for group in groups:
+            for pod in group.pods:
+                pod.__dict__.pop("_topology_unsat", None)
         for group in groups:
             self._compute_current_topology(constraints, group)
+            key = group.constraint.topology_key
+            # hostname groups appended an In row above: the fingerprint
+            # length moved, so this recompiles rather than serving stale
+            cc = feasibility.compile_constraints(constraints) if columnar else None
+            allowed_cache: Dict[tuple, Optional[frozenset]] = {}
             for pod in group.pods:
-                allowed = constraints.requirements.add(
-                    *pod_requirements(pod).items
-                ).requirement(group.constraint.topology_key)
+                sig = feasibility.pod_signature(pod) if cc is not None else None
+                if sig is None:
+                    allowed = self._scalar_allowed(constraints, pod, key)
+                else:
+                    allowed = allowed_cache.get(sig, _UNSET)
+                    if allowed is _UNSET:
+                        allowed = feasibility.topology_allowed(cc, sig, key)
+                        allowed_cache[sig] = allowed
                 domain = group.next_domain(allowed)
+                if domain == "" and sig is not None:
+                    # self-heal: "" never mutates the spread counts, so a
+                    # scalar recheck + retry is side-effect free
+                    scalar = self._scalar_allowed(constraints, pod, key)
+                    if scalar != allowed:
+                        FILTER_FALLBACK_TOTAL.inc(reason="topology-mismatch")
+                        allowed_cache[sig] = scalar
+                        domain = group.next_domain(scalar)
+                if domain == "":
+                    pod.__dict__["_topology_unsat"] = True
                 pod.spec.node_selector = {
                     **pod.spec.node_selector,
-                    group.constraint.topology_key: domain,
+                    key: domain,
                 }
+
+    @staticmethod
+    def _scalar_allowed(constraints: Constraints, pod: Pod,
+                        key: str) -> Optional[frozenset]:
+        """The original per-pod scalar algebra — the oracle the columnar
+        path self-heals against."""
+        return constraints.requirements.add(
+            *pod_requirements(pod).items).requirement(key)
 
     def _get_topology_groups(self, pods: List[Pod]) -> List[TopologyGroup]:
         groups: Dict[tuple, TopologyGroup] = {}

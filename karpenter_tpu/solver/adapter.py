@@ -185,14 +185,42 @@ def marshal_pods(pods: Sequence[Pod]) -> Tuple[List[Vec], frozenset]:
     pods cost ~2× the attribute-gather time (measured ~40 ms/solve), which
     is real money against the 200 ms budget."""
     vecs, required, _ = marshal_pods_interned(pods)
-    return vecs, required
+    # materialize: this wrapper's contract is a plain vector list
+    return list(vecs), required
 
 
-def marshal_pods_interned(pods: Sequence[Pod]):
-    """marshal_pods + the interned shape ids — the encoder's vectorized
-    dedupe input. One pass, same cache. The third element is
-    ``(int64 array, generation)`` or None when the batch spans an intern
-    table reset (consumers fall back to the dict dedupe)."""
+class _LazyVecs:
+    """Sequence facade over a pod batch's vectors, materialized on first
+    element access. The arena-backed marshal path hands the encoder interned
+    shape ids; the encoder's vectorized dedupe never touches the vector
+    list, so in the steady state the 50k-tuple list is never built — only
+    the dict-fallback path (intern rollover mid-flight) pays for it."""
+
+    __slots__ = ("_pods", "_vecs")
+
+    def __init__(self, pods: Sequence[Pod]):
+        self._pods = pods
+        self._vecs: Optional[List[Vec]] = None
+
+    def _materialize(self) -> List[Vec]:
+        if self._vecs is None:
+            m = _marshal
+            self._vecs = [m(p)[0] for p in self._pods]
+        return self._vecs
+
+    def __len__(self) -> int:
+        return len(self._pods)
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+
+def _marshal_pods_interned_scan(pods: Sequence[Pod]):
+    """The always-correct per-pod scan (pre-arena path, and the arena's
+    fallback): marshal every pod through its cached attribute."""
     import numpy as np
 
     m = _marshal
@@ -216,6 +244,64 @@ def marshal_pods_interned(pods: Sequence[Pod]):
     sids = (None if mixed or gen_seen < 0
             else (np.array(sid_list, dtype=np.int64), gen_seen))
     return vecs, required, sids
+
+
+def marshal_pods_interned(pods: Sequence[Pod]):
+    """marshal_pods + the interned shape ids — the encoder's vectorized
+    dedupe input. The third element is ``(int64 array, generation)`` or None
+    when the batch spans an intern table reset (consumers fall back to the
+    dict dedupe).
+
+    Backed by the delta-marshal row arena (ops/encode.py): a pod that went
+    through a previous window carries its arena row index on its __dict__,
+    so a steady-state window is a cached-int gather plus ONE numpy fancy
+    index — no per-pod marshal, and the vector list itself is lazy (the
+    vectorized dedupe never reads it). Any generation movement observed
+    mid-window (intern rebind, vocab rebind, arena rollover, concurrent
+    reset) voids the attempt and restarts it; after bounded retries the
+    scan path answers. ``KARPENTER_MARSHAL_ARENA=0`` disables the arena."""
+    import numpy as np
+
+    if os.environ.get("KARPENTER_MARSHAL_ARENA", "").strip() == "0":
+        return _marshal_pods_interned_scan(pods)
+    from karpenter_tpu.ops import encode as enc_mod
+
+    arena = enc_mod.marshal_arena()
+    m = _marshal
+    assign = arena.assign
+    n = len(pods)
+    for _attempt in range(3):
+        with _INTERN_LOCK:
+            adapter_gen = _INTERN_GEN
+        arena_gen = arena.begin_window(adapter_gen)
+        rows = np.empty(n, np.int64)
+        hits = 0
+        restart = False
+        for i, pod in enumerate(pods):
+            cached = pod.__dict__.get("_arena_row")
+            if cached is not None and cached[0] == arena_gen:
+                rows[i] = cached[1]
+                hits += 1
+                continue
+            _vec, bits, sid, gen = m(pod)
+            row, g = assign(sid, bits, gen)
+            if g != arena_gen:
+                restart = True
+                break
+            pod.__dict__["_arena_row"] = (arena_gen, row)
+            rows[i] = row
+        if restart:
+            continue
+        gathered = arena.gather(rows, arena_gen)
+        if gathered is None:
+            continue
+        sids_arr, mask, sid_gen = gathered
+        arena.note_window(hits, n - hits)
+        required = frozenset(
+            name for bit, name in enumerate(_SPECIAL_RESOURCES)
+            if mask & (1 << bit))
+        return _LazyVecs(pods), required, (sids_arr, sid_gen)
+    return _marshal_pods_interned_scan(pods)
 
 
 def resource_list_vector(rl: res.ResourceList) -> Vec:
@@ -409,6 +495,9 @@ def _instance_token(it: InstanceType) -> int:
     return tok
 
 
+_packables_version_counter = itertools.count(1)
+
+
 def build_packables_cached(
     instance_types: Sequence[InstanceType],
     constraints: Constraints,
@@ -423,6 +512,26 @@ def build_packables_cached(
     frozenset — 50k pods with the same answer share one entry. Callers that
     already marshaled the batch (:func:`marshal_pods`) pass ``required`` to
     skip the O(pods) re-scan."""
+    packables, sorted_types, _ = build_packables_versioned(
+        instance_types, constraints, pods, daemons, required)
+    return packables, sorted_types
+
+
+def build_packables_versioned(
+    instance_types: Sequence[InstanceType],
+    constraints: Constraints,
+    pods: Sequence[Pod],
+    daemons: Sequence[Pod],
+    required: Optional[frozenset] = None,
+) -> Tuple[List[Packable], List[InstanceType], int]:
+    """:func:`build_packables_cached` plus a monotonic content version.
+    The version identifies the exact packable list: a catalog refresh (new
+    instance tokens), a provisioner spec change (new allowed sets), new
+    daemon overhead, or a new required-resource set each land on a new
+    cache key and mint a new version; repeated windows with the same inputs
+    repeat it. It keys the encoder's catalog tensor cache and, through the
+    encoding's catalog token, lets the device ring prove a slot already
+    holds these bytes."""
     allowed = _allowed_sets(constraints)
     daemon_vecs = tuple(pod_vector(d) for d in daemons)
     if required is None:
@@ -436,10 +545,11 @@ def build_packables_cached(
     if hit is None:
         packables, sorted_types = _build_packables_from(
             instance_types, allowed, daemon_vecs, required)
+        version = next(_packables_version_counter)
         with _packables_lock:
             if len(_PACKABLES_CACHE) >= _PACKABLES_CACHE_CAP:
                 _PACKABLES_CACHE.pop(next(iter(_PACKABLES_CACHE)))
-            _PACKABLES_CACHE[key] = (packables, sorted_types)
+            _PACKABLES_CACHE[key] = (packables, sorted_types, version)
     else:
-        packables, sorted_types = hit
-    return [p.copy() for p in packables], list(sorted_types)
+        packables, sorted_types, version = hit
+    return [p.copy() for p in packables], list(sorted_types), version

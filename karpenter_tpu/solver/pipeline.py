@@ -59,7 +59,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 from karpenter_tpu.metrics.pipeline import (
     PIPELINE_DEPTH, PIPELINE_DISPATCH_WAIT_SECONDS,
     PIPELINE_RING_ALLOCATIONS_TOTAL, PIPELINE_RING_REFILLS_TOTAL,
-    PIPELINE_STAGE_SECONDS, SOLVER_DEVICE_BYTES_IN_USE,
+    PIPELINE_RING_REUSES_TOTAL, PIPELINE_STAGE_SECONDS,
+    SOLVER_DEVICE_BYTES_IN_USE,
     SOLVER_OVERLAP_SECONDS_TOTAL,
 )
 from karpenter_tpu.obs import trace
@@ -95,11 +96,15 @@ class _RingSlot:
     allocate) and :meth:`DeviceRing.hand_back` (donated kernel outputs
     returned to slot ownership so the buffer survives the run)."""
 
-    __slots__ = ("sig", "arrays", "in_use", "last_used")
+    __slots__ = ("sig", "arrays", "tokens", "in_use", "last_used")
 
     def __init__(self, sig):
         self.sig = sig
         self.arrays: Dict[str, object] = {}
+        # content identity of each named buffer, when the producer knows one
+        # (encode.py catalog tokens, byte digests): a fill whose token
+        # matches skips the transfer entirely
+        self.tokens: Dict[str, tuple] = {}
         self.in_use = False
         self.last_used = 0.0
 
@@ -121,6 +126,7 @@ class DeviceRing:
         self._lock = threading.Lock()
         self.allocations = 0   # fresh device_puts (slot create/bucket change)
         self.refills = 0       # in-place donation-aliased refills
+        self.reuses = 0        # fills skipped on content-token match
 
     @staticmethod
     def signature(host_arrays: Dict[str, object]) -> Tuple:
@@ -160,11 +166,20 @@ class DeviceRing:
             free.remove(victim)
             self._slots.remove(victim)
             victim.arrays.clear()  # drop the device references
+            victim.tokens.clear()
 
-    def fill(self, slot: _RingSlot, name: str, host_array, sharding):
+    def fill(self, slot: _RingSlot, name: str, host_array, sharding,
+             token: Optional[tuple] = None):
         """Place ``host_array`` on device as ``name`` in this slot: an
         in-place donated refill when a matching live buffer exists (zero
-        fresh allocation), else a counted fresh ``device_put``."""
+        fresh allocation), else a counted fresh ``device_put``.
+
+        ``token`` is the payload's content identity (the encoder's catalog
+        token, or a byte digest). When the slot's live buffer carries the
+        SAME token — and still matches shape/dtype/sharding — the fill is
+        skipped outright: zero host→device transfer, counted in ``reuses``.
+        Donated buffers must NOT be tokened (the donation consumes them);
+        pass None (the default) and the refill path applies."""
         import jax
         import numpy as np
 
@@ -176,6 +191,12 @@ class DeviceRing:
             and str(old.dtype) == str(np.asarray(host_array).dtype)
             and old.sharding == sharding
         )
+        if reusable and token is not None and \
+                slot.tokens.get(name) == token:
+            self.reuses += 1
+            PIPELINE_RING_REUSES_TOTAL.inc()
+            trace.event("ring-reuse", buffer=name)
+            return old
         if reusable:
             new = _refill_jit(sharding, old.ndim)(old, host_array)
             self.refills += 1
@@ -187,6 +208,10 @@ class DeviceRing:
             PIPELINE_RING_ALLOCATIONS_TOTAL.inc()
             trace.event("ring-alloc", buffer=name)
         slot.arrays[name] = new
+        if token is not None:
+            slot.tokens[name] = token
+        else:
+            slot.tokens.pop(name, None)
         return new
 
     def hand_back(self, slot: _RingSlot, **arrays) -> None:
@@ -194,6 +219,10 @@ class DeviceRing:
         slot ownership, so releasing the run doesn't free the device memory
         the next chunk will refill in place."""
         slot.arrays.update(arrays)
+        for name in arrays:
+            # a donated output's content is the kernel's, not the fill's —
+            # its token no longer identifies the bytes
+            slot.tokens.pop(name, None)
 
     def note_allocation(self, count: int = 1) -> None:
         """Off-ring fresh device allocations that belong in the same ledger
@@ -203,7 +232,7 @@ class DeviceRing:
 
     def counters(self) -> Dict[str, int]:
         return {"allocations": self.allocations, "refills": self.refills,
-                "slots": len(self._slots)}
+                "reuses": self.reuses, "slots": len(self._slots)}
 
 
 _RING: Optional[DeviceRing] = None

@@ -23,7 +23,7 @@ from karpenter_tpu.models.cost import (
 from karpenter_tpu.models.ffd import solve_ffd_device
 from karpenter_tpu.solver import host_ffd
 from karpenter_tpu.solver.adapter import (
-    build_packables_cached, marshal_pods_interned,
+    build_packables_versioned, marshal_pods_interned,
 )
 from karpenter_tpu.obs import flight
 from karpenter_tpu.utils.gcguard import gc_deferred
@@ -370,11 +370,12 @@ def solve(
     with gc_deferred():
         # one pass: vecs + special mask + interned shape ids
         pod_vecs, required, sids = marshal_pods_interned(pods)
-        packables, sorted_types = build_packables_cached(
+        packables, sorted_types, catalog_version = build_packables_versioned(
             instance_types, constraints, pods, daemons, required=required)
         return solve_with_packables(constraints, pods, packables,
                                     sorted_types, pod_vecs, config,
-                                    sids=sids)
+                                    sids=sids,
+                                    catalog_version=catalog_version)
 
 
 def solve_with_packables(
@@ -386,10 +387,14 @@ def solve_with_packables(
     config: SolverConfig,
     sids=None,
     enc=None,
+    catalog_version: Optional[int] = None,
 ) -> SolveResult:
     """solve() after problem preparation — entry for callers (batch_solve)
     that already built packables/vectors (and possibly the exact-size
-    encoding) and must not pay for them twice."""
+    encoding) and must not pay for them twice. ``catalog_version`` (from
+    build_packables_versioned) routes the catalog tensors through the
+    encoder's versioned cache so the device ring can recognize bytes it
+    already holds."""
     if not packables:
         # same contract as host_ffd.pack: no viable types → every pod is
         # reported unschedulable (the reference only logs, packer.go:119-121,
@@ -415,7 +420,8 @@ def solve_with_packables(
     if enc is None and (config.use_device or config.use_native):
         from karpenter_tpu.ops.encode import encode
 
-        enc = encode(pod_vecs, pod_ids, packables, pad=False, sids=sids)
+        enc = encode(pod_vecs, pod_ids, packables, pad=False, sids=sids,
+                     catalog_version=catalog_version)
 
     result = None
     executor = None
@@ -436,7 +442,8 @@ def solve_with_packables(
                 prices=prices, cost_tiebreak=prices is not None,
                 max_shapes=resolved_device_max_shapes(config), enc=enc,
                 pallas_max_shapes=config.pallas_max_shapes,
-                hedge=config.device_hedge)
+                hedge=config.device_hedge,
+                donate=config.device_donate)
 
         try:
             with trace("karpenter.solve.device"):

@@ -168,13 +168,13 @@ class TestBatchKernels:
         import karpenter_tpu.solver.batch_solve as bs
 
         calls = {"n": 0}
-        real = bs.build_packables_cached
+        real = bs.build_packables_versioned
 
         def counting(*a, **kw):
             calls["n"] += 1
             return real(*a, **kw)
 
-        monkeypatch.setattr(bs, "build_packables_cached", counting)
+        monkeypatch.setattr(bs, "build_packables_versioned", counting)
         problems = mixed_problems(seed=3, n=3)
         solve_batch(problems, config=SolverConfig(device_min_pods=10**9))
         assert calls["n"] == len(problems)

@@ -481,3 +481,65 @@ class TestSchedulerIntegration:
                 for s in got}
             assert got_map == ref, f"case {case}"
             assert [list(v) for v in got_map.values()] == list(ref.values())
+
+
+class TestTopologyAllowed:
+    """The columnar allowed-domain algebra behind topology injection
+    (feasibility.topology_allowed) versus the scalar requirement oracle
+    (Topology._scalar_allowed's inner expression)."""
+
+    def test_fuzz_matches_scalar_oracle(self):
+        from karpenter_tpu.api.requirements import pod_requirements
+        rng = random.Random(0x70110)
+        keys = _CANON_KEYS + [wellknown.LABEL_HOSTNAME]
+        checked = 0
+        for i in range(600):
+            c = rand_constraints(rng)
+            pod = rand_pod(rng, i)
+            cc = feasibility.compile_constraints(c)
+            sig = feasibility.pod_signature(pod)
+            if cc is None or sig is None:
+                continue
+            key = rng.choice(keys)
+            want = c.requirements.add(
+                *pod_requirements(pod).items).requirement(key)
+            got = feasibility.topology_allowed(cc, sig, key)
+            assert got == want, (
+                f"case {i} key={key}: got={got!r} want={want!r} "
+                f"reqs={c.requirements!r} sel={pod.spec.node_selector}")
+            checked += 1
+        assert checked >= 300
+
+    def test_out_of_vocab_pod_values_survive_without_constraint_in_row(self):
+        """A pod In value the constraint never mentioned must stay in the
+        allowed set when the constraint has no In row for the key (the
+        string-space leg) — the mask space would silently drop it."""
+        from karpenter_tpu.api.requirements import pod_requirements
+        c = Constraints(requirements=Requirements().add(
+            NodeSelectorRequirement(key=ZONE, operator=NOT_IN,
+                                    values=["us-1a"])))
+        pod = Pod()
+        pod.metadata.name = "oov"
+        pod.spec.node_selector[ZONE] = "zone-never-interned"
+        cc = feasibility.compile_constraints(c)
+        sig = feasibility.pod_signature(pod)
+        assert cc is not None and sig is not None
+        want = c.requirements.add(
+            *pod_requirements(pod).items).requirement(ZONE)
+        got = feasibility.topology_allowed(cc, sig, ZONE)
+        assert got == want == frozenset({"zone-never-interned"})
+
+    def test_go_notin_quirk_yields_empty_not_none(self):
+        """NotIn with no In anywhere: Go's (result or set()) - vals quirk
+        makes the requirement the empty set, never None."""
+        c = Constraints(requirements=Requirements())
+        pod = Pod()
+        pod.metadata.name = "quirk"
+        pod.spec.affinity = Affinity(node_affinity=NodeAffinity(required=[
+            NodeSelectorTerm(match_expressions=[NodeSelectorRequirement(
+                key=ZONE, operator=NOT_IN, values=["us-1a"])])]))
+        cc = feasibility.compile_constraints(c)
+        sig = feasibility.pod_signature(pod)
+        assert cc is not None and sig is not None
+        got = feasibility.topology_allowed(cc, sig, ZONE)
+        assert got == frozenset()

@@ -1,0 +1,348 @@
+"""Round-10 differential suite: the delta-marshal arena, the versioned
+catalog encoding, and the token-aware device ring (docs/solver.md §14).
+
+The contract under test is the encode.py exactness rule: every cache is
+versioned, a version mismatch means a rebuild, and NO input — churn,
+provisioner spec change, intern-table rollover, or a concurrent reset
+landing mid-window — may ever produce bytes that differ from a cold
+from-scratch marshal+encode.
+"""
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.cloudprovider.fake.provider import instance_types
+from karpenter_tpu.controllers.provisioning import universe_constraints
+from karpenter_tpu.ops import encode as enc_mod
+from karpenter_tpu.ops import feasibility
+from karpenter_tpu.solver import adapter
+from karpenter_tpu.solver.solve import SolverConfig, solve
+from tests.test_pack_parity import make_pod
+
+SHAPES = [(100, 64), (250, 128), (500, 256), (1000, 512), (2000, 1024),
+          (4000, 4096)]
+
+
+def mixed_pods(rng, n):
+    pods = []
+    for i in range(n):
+        c, m = SHAPES[rng.randrange(len(SHAPES))]
+        pods.append(make_pod({"cpu": f"{c}m", "memory": f"{m}Mi"}))
+    return pods
+
+
+def cold_clear(pods):
+    """The pre-round-10 state: no arena, no per-pod handles, no cached
+    catalog tensors."""
+    for p in pods:
+        p.__dict__.pop("_marshal", None)
+        p.__dict__.pop("_arena_row", None)
+    enc_mod.reset_marshal_arena()
+    enc_mod.clear_catalog_encoding_cache()
+
+
+def marshal_key(pods):
+    """Everything marshal_pods_interned feeds the encoder, materialized."""
+    vecs, required, sids = adapter.marshal_pods_interned(pods)
+    return (list(vecs), required,
+            None if sids is None else sids[0].tolist())
+
+
+class TestDeltaEqualsCold:
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_churned_windows_bit_for_bit(self, seed):
+        """Five windows with ~20% object churn: the warm (delta) marshal
+        must equal a cold full marshal exactly — vectors, required set,
+        and interned shape ids."""
+        rng = random.Random(seed)
+        pods = mixed_pods(rng, 300)
+        cold_clear(pods)
+        for _ in range(5):
+            for idx in rng.sample(range(len(pods)), len(pods) // 5):
+                c, m = SHAPES[rng.randrange(len(SHAPES))]
+                pods[idx] = make_pod({"cpu": f"{c}m", "memory": f"{m}Mi"})
+            delta = marshal_key(pods)
+            cold_clear(pods)
+            cold = marshal_key(pods)
+            assert delta[0] == cold[0]
+            assert delta[1] == cold[1]
+            assert delta[2] == cold[2]
+
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_encode_bit_for_bit_through_versioned_catalog(self, seed):
+        """The full window encode (marshal + versioned catalog tensors)
+        delta vs cold, compared on raw array bytes."""
+        rng = random.Random(seed)
+        catalog = instance_types(8)
+        constraints = universe_constraints(catalog)
+        pods = mixed_pods(rng, 200)
+        cold_clear(pods)
+
+        def window_encode():
+            vecs, required, sids = adapter.marshal_pods_interned(pods)
+            packables, _st, ver = adapter.build_packables_versioned(
+                catalog, constraints, pods, [], required=required)
+            e = enc_mod.encode(vecs, list(range(len(pods))), packables,
+                               pad=False, sids=sids, catalog_version=ver)
+            return (e.shapes.tobytes(), e.counts.tobytes(),
+                    e.totals.tobytes(), e.reserved0.tobytes(),
+                    e.valid.tobytes(), e.shape_pods, e.scales, e.pods_unit)
+
+        window_encode()  # warm
+        for _ in range(3):
+            for idx in rng.sample(range(len(pods)), len(pods) // 10):
+                c, m = SHAPES[rng.randrange(len(SHAPES))]
+                pods[idx] = make_pod({"cpu": f"{c}m", "memory": f"{m}Mi"})
+            warm = window_encode()
+            cold_clear(pods)
+            assert window_encode() == warm
+
+
+class TestInvalidation:
+    def test_spec_change_mints_new_catalog_version(self):
+        """A provisioner spec change (different allowed sets) must land on
+        a new packables version — the encoder can never serve the old
+        spec's catalog tensors to the new spec."""
+        from karpenter_tpu.api import wellknown
+        from karpenter_tpu.api.core import NodeSelectorRequirement as Req
+
+        catalog = instance_types(6)
+        constraints = universe_constraints(catalog)
+        pods = mixed_pods(random.Random(3), 40)
+        _p1, _s1, v1 = adapter.build_packables_versioned(
+            catalog, constraints, pods, [])
+        tightened = constraints.deepcopy()
+        tightened.requirements = tightened.requirements.add(
+            Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In",
+                values=["test-zone-1"]))
+        _p2, _s2, v2 = adapter.build_packables_versioned(
+            catalog, tightened, pods, [])
+        assert v1 != v2
+        # and the same spec repeats its version (cache hit, same bytes)
+        _p3, _s3, v3 = adapter.build_packables_versioned(
+            catalog, constraints, pods, [])
+        assert v3 == v1
+
+    def test_spec_change_solve_parity(self):
+        """Back-to-back solves under two different specs, arena warm
+        throughout: each result equals its own cold solve."""
+        from karpenter_tpu.api import wellknown
+        from karpenter_tpu.api.core import NodeSelectorRequirement as Req
+
+        catalog = instance_types(8)
+        base = universe_constraints(catalog)
+        tightened = base.deepcopy()
+        tightened.requirements = tightened.requirements.add(
+            Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In",
+                values=["test-zone-2"]))
+        pods = mixed_pods(random.Random(11), 120)
+        cold_clear(pods)
+        warm = [solve(base, pods, catalog).node_count,
+                solve(tightened, pods, catalog).node_count,
+                solve(base, pods, catalog).node_count]
+        cold_counts = []
+        for c in (base, tightened, base):
+            cold_clear(pods)
+            cold_counts.append(solve(c, pods, catalog).node_count)
+        assert warm == cold_counts
+
+    def test_intern_table_rollover_rebinds_arena(self, monkeypatch):
+        """Force the adapter intern table over its cap mid-stream: the
+        arena must follow the generation rebind (never serving rows keyed
+        by dead shape ids) and the marshal stays exact."""
+        monkeypatch.setattr(adapter, "_INTERN_MAX", 4)
+        monkeypatch.setattr(adapter, "_INTERN_GEN", 50_000)
+        monkeypatch.setattr(adapter, "_VEC_INTERN", {})
+        monkeypatch.setattr(adapter, "_VEC_BY_ID", [])
+        enc_mod.reset_marshal_arena()
+        rng = random.Random(7)
+        # > _INTERN_MAX distinct shapes: guaranteed rollovers
+        pods = [make_pod({"cpu": f"{100 + 10 * i}m", "memory": "64Mi"})
+                for i in range(12)]
+        for _ in range(3):
+            delta = marshal_key(pods)
+            oracle = [adapter.pod_vector(p) for p in pods]
+            assert delta[0] == oracle
+            for idx in rng.sample(range(len(pods)), 3):
+                pods[idx] = make_pod(
+                    {"cpu": f"{100 + 10 * rng.randrange(40)}m",
+                     "memory": "64Mi"})
+
+    def test_feasibility_vocab_rebind_resets_arena(self):
+        """A feasibility intern-table generation rebind (the provisioner
+        spec-change signal) must bump the arena generation on the next
+        window — and the marshal stays exact across it."""
+        pods = mixed_pods(random.Random(13), 50)
+        cold_clear(pods)
+        marshal_key(pods)
+        gen0 = enc_mod.marshal_arena().stats()["generation"]
+        feasibility.reset_intern_table()
+        delta = marshal_key(pods)
+        assert enc_mod.marshal_arena().stats()["generation"] > gen0
+        assert delta[0] == [adapter.pod_vector(p) for p in pods]
+
+
+class TestChaos:
+    def test_mid_window_reset_never_stale(self, monkeypatch):
+        """A concurrent arena reset landing between assign() and gather()
+        must void the attempt (restart or scan fallback), never splice old
+        rows into the window tensor."""
+        pods = mixed_pods(random.Random(5), 60)
+        cold_clear(pods)
+        marshal_key(pods)  # warm rows
+        real_gather = enc_mod.MarshalArena.gather
+        hits = {"n": 0}
+
+        def chaotic_gather(self, rows, generation):
+            if hits["n"] < 2:
+                hits["n"] += 1
+                # the concurrent-reset race: the process arena is replaced
+                # between this window's assigns and its gather
+                enc_mod.reset_marshal_arena()
+                return None
+            return real_gather(self, rows, generation)
+
+        monkeypatch.setattr(enc_mod.MarshalArena, "gather", chaotic_gather)
+        delta = adapter.marshal_pods_interned(pods)
+        monkeypatch.setattr(enc_mod.MarshalArena, "gather", real_gather)
+        oracle = [adapter.pod_vector(p) for p in pods]
+        assert list(delta[0]) == oracle
+        assert hits["n"] == 2  # the chaos actually fired
+
+    def test_threaded_marshal_with_concurrent_resets(self):
+        """Hammer the arena from worker threads while a chaos thread
+        resets the arena and both intern tables: every returned window
+        must equal the pure per-pod oracle."""
+        rng = random.Random(21)
+        windows = [mixed_pods(rng, 40) for _ in range(4)]
+        oracles = [[adapter.pod_vector(p) for p in w] for w in windows]
+        stop = threading.Event()
+        errors = []
+
+        def chaos():
+            while not stop.is_set():
+                enc_mod.reset_marshal_arena()
+                feasibility.reset_intern_table()
+
+        def worker(i):
+            try:
+                for _ in range(30):
+                    w = windows[i]
+                    vecs, _req, _sids = adapter.marshal_pods_interned(w)
+                    if list(vecs) != oracles[i]:
+                        errors.append(f"window {i}: stale marshal")
+                        return
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(f"window {i}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(windows))]
+        chaos_t = threading.Thread(target=chaos)
+        chaos_t.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        chaos_t.join()
+        assert not errors, errors
+
+
+class TestDeviceResidency:
+    def test_steady_state_zero_fresh_catalog_transfers(self):
+        """The round-10 acceptance property: an identical repeat solve
+        through the solo donate ring ships NO fresh catalog bytes — every
+        catalog tensor answers by token (reuses), only the donated counts
+        buffer refills, and nothing allocates."""
+        from karpenter_tpu.solver import pipeline as pl
+
+        pl.reset_ring()
+        catalog = instance_types(8)
+        constraints = universe_constraints(catalog)
+        pods = mixed_pods(random.Random(2), 48)
+        cold_clear(pods)
+        cfg = SolverConfig(device_min_pods=1, device_donate=True)
+        r1 = solve(constraints, pods, catalog, config=cfg)
+        c1 = pl.get_ring().counters()
+        assert c1["allocations"] > 0
+        r2 = solve(constraints, pods, catalog, config=cfg)
+        c2 = pl.get_ring().counters()
+        assert r1.node_count == r2.node_count
+        assert c2["allocations"] == c1["allocations"], (
+            f"steady-state solo solve allocated fresh buffers: {c2}")
+        # catalog + shape tensors answer by token: totals, reserved0,
+        # valid, last_valid, pods_unit, shapes, dropped
+        assert c2["reuses"] - c1["reuses"] >= 5
+        # the donated counts buffer is NEVER token-reused — it must refill
+        assert c2["refills"] > c1["refills"]
+
+    def test_donate_parity_with_no_donate(self):
+        catalog = instance_types(8)
+        constraints = universe_constraints(catalog)
+        pods = mixed_pods(random.Random(9), 64)
+        a = solve(constraints, pods, catalog,
+                  config=SolverConfig(device_min_pods=1, device_donate=True))
+        b = solve(constraints, pods, catalog,
+                  config=SolverConfig(device_min_pods=1, device_donate=False))
+        assert a.node_count == b.node_count
+        key = lambda r: sorted(  # noqa: E731
+            (tuple(it.name for it in p.instance_type_options),
+             p.node_quantity) for p in r.packings)
+        assert key(a) == key(b)
+
+    def test_solo_donated_refill_read_raises(self):
+        """Use-after-donate guard on the SOLO ring surface
+        (SingleDeviceSharding): after a donating refill of the same slot
+        buffer, reading the pre-refill array must raise RuntimeError —
+        never return stale bytes."""
+        import jax
+        from jax.sharding import SingleDeviceSharding
+
+        from karpenter_tpu.solver.pipeline import DeviceRing
+
+        ring = DeviceRing()
+        sh = SingleDeviceSharding(jax.devices()[0])
+        host = np.arange(8, dtype=np.int32)
+        slot = ring.acquire(DeviceRing.signature({"solo_counts": host}))
+        first = ring.fill(slot, "solo_counts", host, sh)
+        jax.block_until_ready(first)
+        second = ring.fill(slot, "solo_counts", host + 1, sh)
+        jax.block_until_ready(second)
+        assert np.array_equal(np.asarray(second), host + 1)
+        assert first.is_deleted()
+        with pytest.raises(RuntimeError):
+            np.asarray(first)
+        ring.release(slot)
+
+    def test_token_reuse_skips_refill_and_hand_back_clears(self):
+        """fill(token=) returns the live buffer without any transfer when
+        the token matches; hand_back drops the token (kernel output bytes
+        are unknown) so the next fill must refill."""
+        import jax
+        from jax.sharding import SingleDeviceSharding
+
+        from karpenter_tpu.solver.pipeline import DeviceRing
+
+        ring = DeviceRing()
+        sh = SingleDeviceSharding(jax.devices()[0])
+        host = np.arange(6, dtype=np.int32)
+        slot = ring.acquire(DeviceRing.signature({"totals": host}))
+        tok = ("cat", 1, (1, 1), 6)
+        a = ring.fill(slot, "totals", host, sh, token=tok)
+        b = ring.fill(slot, "totals", host, sh, token=tok)
+        assert b is a  # no transfer at all
+        assert ring.counters()["reuses"] == 1
+        # different token: must transfer (refill), then the new token holds
+        c = ring.fill(slot, "totals", host + 2, sh, token=("cat", 2, (1, 1), 6))
+        jax.block_until_ready(c)
+        assert np.array_equal(np.asarray(c), host + 2)
+        ring.hand_back(slot, totals=c)
+        d = ring.fill(slot, "totals", host + 2, sh,
+                      token=("cat", 2, (1, 1), 6))
+        jax.block_until_ready(d)
+        counters = ring.counters()
+        assert counters["reuses"] == 1  # hand_back cleared the token
+        ring.release(slot)

@@ -314,7 +314,8 @@ class TestDonatedRing:
         jax.block_until_ready(second)
         assert second.addressable_shards[0].data.unsafe_buffer_pointer() == ptr0
         assert np.array_equal(np.asarray(second), host + 5)
-        assert ring.counters() == {"allocations": 1, "refills": 1, "slots": 1}
+        assert ring.counters() == {
+            "allocations": 1, "refills": 1, "reuses": 0, "slots": 1}
 
     def test_donated_buffer_read_raises_cleanly(self):
         """Use-after-donate guard: the kernel CONSUMES counts/dropped; any

@@ -435,6 +435,37 @@ class TestWindowLogAggregation:
         assert not [r for r in caplog.records
                     if "unable to schedule" in r.getMessage()]
 
+    def test_topology_reason_bucket(self, caplog):
+        """Pods Topology.inject marked ``_topology_unsat`` (no satisfiable
+        spread domain) are bucketed separately in the window summary."""
+        import logging
+
+        from karpenter_tpu.scheduling.scheduler import Scheduler
+
+        constraints = Constraints(requirements=Requirements().add(
+            Req(key=ZONE, operator="In", values=["test-zone-1"])))
+        pods = [unschedulable_pod(node_selector={ZONE: "test-zone-1"},
+                                  name="ok-1")]
+        for i in range(3):
+            # what inject leaves behind for an unsatisfiable spread: the ""
+            # domain selector plus the marker
+            p = unschedulable_pod(node_selector={ZONE: ""}, name=f"topo-{i}")
+            p.__dict__["_topology_unsat"] = True
+            pods.append(p)
+        for i in range(2):
+            pods.append(unschedulable_pod(
+                node_selector={ZONE: f"nope-{i}"}, name=f"bad-{i}"))
+        with caplog.at_level(logging.INFO, logger="karpenter.scheduler"):
+            schedules = Scheduler(KubeCore())._get_schedules(constraints, pods)
+        assert len(schedules) == 1 and len(schedules[0].pods) == 1
+        records = [r for r in caplog.records
+                   if "unable to schedule" in r.getMessage()]
+        assert len(records) == 1
+        message = records[0].getMessage()
+        assert "5/6" in message
+        assert "reason=topology: 3" in message
+        assert "other: 2" in message
+
 
 class TestMemoizedTighten:
     """The scheduler memoizes constraints.tighten() per group signature;

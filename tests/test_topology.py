@@ -96,3 +96,96 @@ class TestHostnameTopology:
         expect_provisioned(kube, selection, provisioning, pods)
         nodes = {kube.get("Pod", p.metadata.name).spec.node_name for p in pods}
         assert len(nodes) == 2  # ceil(4/2) domains
+
+
+class TestColumnarInjectParity:
+    """Topology.inject's columnar path (compiled-bitset topology_allowed)
+    versus the scalar leg (KARPENTER_TOPOLOGY_COLUMNAR=0): identical
+    injected domains, identical unsat markers, and scalar-wins self-heal."""
+
+    ZONE = wellknown.LABEL_TOPOLOGY_ZONE
+
+    def _window(self):
+        from karpenter_tpu.api.constraints import Constraints
+        from karpenter_tpu.api.core import NodeSelectorRequirement
+        from karpenter_tpu.api.requirements import Requirements
+
+        constraints = Constraints(requirements=Requirements().add(
+            NodeSelectorRequirement(
+                key=self.ZONE, operator="In",
+                values=["test-zone-1", "test-zone-2", "test-zone-3"])))
+        pods = []
+        for i in range(30):
+            p = spread_pod(self.ZONE)
+            p.metadata.name = f"p-{i}"
+            if i % 5 == 0:
+                # pinned to one viable zone: the allowed set narrows
+                p.spec.node_selector[self.ZONE] = "test-zone-2"
+            if i % 7 == 0:
+                # outside the viable zones: no satisfiable domain
+                p.spec.node_selector[self.ZONE] = "zone-nope"
+            pods.append(p)
+        return constraints, pods
+
+    def test_columnar_and_scalar_legs_inject_identical_domains(self, monkeypatch):
+        from karpenter_tpu.scheduling.topology import Topology
+
+        monkeypatch.delenv("KARPENTER_TOPOLOGY_COLUMNAR", raising=False)
+        c1, pods1 = self._window()
+        Topology(KubeCore()).inject(c1, pods1)
+
+        monkeypatch.setenv("KARPENTER_TOPOLOGY_COLUMNAR", "0")
+        c2, pods2 = self._window()
+        Topology(KubeCore()).inject(c2, pods2)
+
+        got = [p.spec.node_selector[self.ZONE] for p in pods1]
+        want = [p.spec.node_selector[self.ZONE] for p in pods2]
+        assert got == want
+        marks = [bool(p.__dict__.get("_topology_unsat")) for p in pods1]
+        assert marks == [bool(p.__dict__.get("_topology_unsat"))
+                         for p in pods2]
+        # the window mixes both outcomes, so the parity is non-vacuous
+        assert any(marks) and not all(marks)
+        assert all(d == "" for p, d in zip(pods1, got)
+                   if p.__dict__.get("_topology_unsat"))
+
+    def test_self_heal_scalar_wins_on_columnar_divergence(self, monkeypatch):
+        from karpenter_tpu.metrics.filter import FILTER_FALLBACK_TOTAL
+        from karpenter_tpu.ops import feasibility
+        from karpenter_tpu.scheduling import topology as topo_mod
+        from karpenter_tpu.scheduling.topology import Topology
+
+        monkeypatch.delenv("KARPENTER_TOPOLOGY_COLUMNAR", raising=False)
+        # sabotage the columnar answer: claims nothing is ever allowed
+        monkeypatch.setattr(topo_mod.feasibility, "topology_allowed",
+                            lambda cc, sig, key: frozenset(),
+                            raising=True)
+        assert feasibility is topo_mod.feasibility  # same module object
+        label = (("reason", "topology-mismatch"),)
+        before = FILTER_FALLBACK_TOTAL.collect().get(label, 0.0)
+
+        constraints, pods = self._window()
+        satisfiable = [p for p in pods
+                       if p.spec.node_selector.get(self.ZONE) != "zone-nope"]
+        Topology(KubeCore()).inject(constraints, pods)
+
+        # every satisfiable pod still landed in a real zone: scalar won
+        assert all(p.spec.node_selector[self.ZONE].startswith("test-zone-")
+                   for p in satisfiable)
+        assert not any(p.__dict__.get("_topology_unsat") for p in satisfiable)
+        assert FILTER_FALLBACK_TOTAL.collect()[label] > before
+
+    def test_kill_switch_disables_columnar_path(self, monkeypatch):
+        from karpenter_tpu.scheduling import topology as topo_mod
+        from karpenter_tpu.scheduling.topology import Topology
+
+        monkeypatch.setenv("KARPENTER_TOPOLOGY_COLUMNAR", "0")
+
+        def boom(cc, sig, key):  # pragma: no cover - must never run
+            raise AssertionError("columnar path used despite kill switch")
+
+        monkeypatch.setattr(topo_mod.feasibility, "topology_allowed", boom,
+                            raising=True)
+        constraints, pods = self._window()
+        Topology(KubeCore()).inject(constraints, pods)
+        assert all(self.ZONE in p.spec.node_selector for p in pods)

@@ -39,6 +39,19 @@ def _config_ids(extra: dict) -> str:
     return ",".join(sorted(ids, key=lambda s: int(s.rstrip("!")))) or "-"
 
 
+def _marshal_cell(extra: dict) -> str:
+    """Compressed delta-marshal column (config_10, round 10+): speedup,
+    steady-state fresh catalog transfers, window delta fraction —
+    '3.98x/0xfer/d0.10'. '-' when the config never ran."""
+    cfg = extra.get("config_10_marshal_delta")
+    if not isinstance(cfg, dict) or "speedup" not in cfg:
+        return "-"
+    frac = cfg.get("delta_fraction")
+    frac_s = f"/d{frac:.2f}" if isinstance(frac, (int, float)) else ""
+    return (f"{cfg['speedup']}x/"
+            f"{cfg.get('fresh_catalog_transfers', '?')}xfer{frac_s}")
+
+
 def _from_tail(tail: str):
     """Best-effort recovery of the bench JSON line from a captured stdout
     tail: parse from the LAST '{"metric"' occurrence (the line is emitted
@@ -83,7 +96,8 @@ def load_rows(root: str) -> list:
                     "round": rnd, "variant": variant,
                     "metric": f"(tail truncated, rc={line.get('rc')})",
                     "value": None, "unit": "", "device_count": None,
-                    "backend": "?", "degraded": None, "configs": "-"})
+                    "backend": "?", "degraded": None, "configs": "-",
+                    "marshal": "-"})
                 continue
             line = inner
         extra = line.get("extra", {}) if isinstance(line, dict) else {}
@@ -97,6 +111,7 @@ def load_rows(root: str) -> list:
             "backend": extra.get("backend", "?"),
             "degraded": extra.get("degraded"),
             "configs": _config_ids(extra),
+            "marshal": _marshal_cell(extra),
         })
     for b in bad:
         print(f"bench-history: skipped {b}", file=sys.stderr)
@@ -106,7 +121,7 @@ def load_rows(root: str) -> list:
 
 def render(rows: list) -> str:
     headers = ["round", "variant", "metric", "value", "unit",
-               "device_count", "backend", "degraded", "configs"]
+               "device_count", "backend", "degraded", "configs", "marshal"]
     table = [headers] + [
         ["" if r[h] is None else str(r[h]) for h in headers] for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(headers))]

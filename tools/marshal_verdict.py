@@ -1,0 +1,72 @@
+"""Marshal-delta verdict: one human-readable line from the bench JSON.
+
+`make bench-marshal` pipes bench.py's stdout through this filter. The
+bench line passes through UNCHANGED on stdout (so `> BENCH_rNN.json`
+redirects still capture the pure JSON); the verdict goes to stderr:
+
+    marshal delta: 3.98x (p50 31.5ms vs 125.5ms cold) delta_frac=0.10 \
+encode_parity=True solve_parity=True catalog_transfers=0 — PASS (>=3x)
+
+PASS needs speedup >= 3 at steady state (the round-10 acceptance gate),
+bit-for-bit encode parity across every window, node-count + bound-set
+parity on the end-to-end solve, and zero fresh catalog device transfers
+on the donate-leg repeat solve.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+GATE_SPEEDUP = 3.0
+
+
+def verdict(line: dict) -> str:
+    extra = line.get("extra", {})
+    cfg = extra.get("config_10_marshal_delta", {})
+    if "error" in cfg or "speedup" not in cfg:
+        return ("marshal delta: no config_10 in bench line "
+                f"({cfg.get('error', 'config_10 not run')}) — NO VERDICT")
+    speedup = cfg.get("speedup")
+    frac = cfg.get("delta_fraction")
+    enc_par = cfg.get("encode_parity")
+    solve_par = cfg.get("solve_parity")
+    transfers = cfg.get("fresh_catalog_transfers")
+    ring = cfg.get("steady_ring", {})
+    head = (f"marshal delta: {speedup}x "
+            f"(p50 {cfg.get('delta_p50_ms')}ms vs "
+            f"{cfg.get('cold_p50_ms')}ms cold) "
+            f"delta_frac={frac} encode_parity={enc_par} "
+            f"solve_parity={solve_par} catalog_transfers={transfers} "
+            f"ring={ring.get('allocations', '?')} allocs/"
+            f"{ring.get('refills', '?')} refills/"
+            f"{ring.get('reuses', '?')} reuses")
+    ok = (speedup is not None and speedup >= GATE_SPEEDUP
+          and enc_par is True and solve_par is True and transfers == 0)
+    return f"{head} — {'PASS' if ok else 'FAIL'} (gate >={GATE_SPEEDUP}x)"
+
+
+def main() -> int:
+    last = None
+    for raw in sys.stdin:
+        sys.stdout.write(raw)  # pass-through: stdout stays the pure JSON
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            line = json.loads(raw)
+            if isinstance(line, dict) and "metric" in line:
+                last = line
+        except ValueError:
+            continue
+    sys.stdout.flush()
+    if last is None:
+        print("marshal delta: no bench JSON line on stdin — NO VERDICT",
+              file=sys.stderr)
+        return 1
+    print(verdict(last), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -158,6 +158,7 @@ def _bench_mode() -> int:
     """Verdict-chain filter: JSON stdin -> stdout unchanged, table ->
     stderr from the dump named by the line's ``trace_dump`` key."""
     dump_path = None
+    marshal = None
     for raw in sys.stdin:
         sys.stdout.write(raw)
         raw = raw.strip()
@@ -170,7 +171,18 @@ def _bench_mode() -> int:
         hit = _find_key(line, "trace_dump")
         if hit:
             dump_path = hit
+        mc = _find_key(line, "config_10_marshal_delta")
+        if isinstance(mc, dict) and "speedup" in mc:
+            marshal = mc
     sys.stdout.flush()
+    if marshal is not None:
+        ring = marshal.get("steady_ring", {})
+        print(f"traceview: marshal-cache {marshal['speedup']}x delta "
+              f"(frac={marshal.get('delta_fraction')}, "
+              f"{marshal.get('fresh_catalog_transfers', '?')} fresh catalog "
+              f"transfers, ring {ring.get('allocations', '?')} allocs/"
+              f"{ring.get('refills', '?')} refills/"
+              f"{ring.get('reuses', '?')} reuses)", file=sys.stderr)
     if not dump_path:
         print("traceview: no trace_dump in bench output — NO TABLE",
               file=sys.stderr)
