@@ -754,8 +754,8 @@ def config_9_million_pod_replay():
     cfg = ReplayConfig(
         pods_total=10_000, shards=2, tenants=2, seed=7, bound_cohort=200,
         churn_pods=200, max_depth=4_000, ticks=8, tick_sleep_s=0.1,
-        burst_ticks=2, chaos=True, settle_s=60.0,
-        flood_pool=128) if smoke else ReplayConfig()
+        burst_ticks=2, chaos=True, settle_s=60.0, flood_pool=128,
+        gang_fraction=0.2) if smoke else ReplayConfig(gang_fraction=0.2)
     try:
         ab = store_ab(objects=100_000, minority=2_000)
         report = run_replay(cfg)  # 1M / 4-shard default (smoke: 10k / 2)
@@ -894,6 +894,112 @@ def config_10_marshal_delta():
         "steady_ring": steady,
         "fresh_catalog_transfers": steady.get("allocations", -1),
         "arena": enc_mod.marshal_arena().stats(),
+    }
+
+
+def config_11_gang_copack():
+    """Round-11 gate: batched gang co-pack (docs/solver.md §15). A
+    256-gang window of all-or-nothing pod groups (2-4 heavyweight
+    members each) is solved two ways over the SAME encoding:
+
+    - leg A, the per-gang sequential host loop: ops/gang.host_gang runs
+      one exact first-fit per gang over its private pool copy — G python
+      solves back to back (what a host-only implementation pays);
+    - leg B, one batched device solve: solver/gang.solve_gang_window
+      vmaps all G sub-solves into a single kernel dispatch through the
+      DeviceRing.
+
+    Both verdicts then feed plan_gang_window, whose host re-verification
+    commits every accepted gang on exact nano ints — the plans must be
+    node-for-node identical (exact node parity) and every placement is
+    host-verified (zero unverified placements). `make bench-gang` gates
+    speedup >= 5x via tools/gang_verdict.py."""
+    import numpy as _np
+
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.ops import feasibility
+    from karpenter_tpu.ops.gang import encode_gang_window, host_gang
+    from karpenter_tpu.solver import adapter
+    from karpenter_tpu.solver.gang import (
+        GangConfig, plan_gang_window, solve_gang_window,
+    )
+
+    G = 256
+    catalog = make_catalog(100)
+    constraints = universe_constraints(catalog)
+    # the realistic TPU gang shape: small groups of heavyweight slice
+    # workers (2-4 members, 2-6 CPU each) — the member axis stays narrow
+    # while the prospective-node pool is wide
+    sizes = [2, 3, 4]
+    shapes = [(2000, 2048), (4000, 4096), (6000, 6144)]
+    gangs = []
+    all_pods = []
+    for gi in range(G):
+        k = sizes[gi % len(sizes)]
+        members = make_pods(k, [shapes[(gi + j) % len(shapes)]
+                                for j in range(k)])
+        for j, p in enumerate(members):
+            p.metadata.name = f"gang-{gi}-m{j}"
+        all_pods.extend(members)
+        gangs.append((f"gang-{gi}", members))
+
+    packables, sorted_types = adapter.build_packables_cached(
+        catalog, constraints, all_pods, ())
+    type_frees = [[t - r for t, r in zip(pk.total, pk.reserved)]
+                  for pk in packables]
+    type_prices = [it.price for it in sorted_types]
+    type_names = [it.name for it in sorted_types]
+    allowed = adapter._allowed_sets(constraints)
+    required = adapter._required_resources(all_pods)
+    mask = feasibility.gang_feasibility_mask(
+        sorted_types, [(allowed, required)])
+    enc = encode_gang_window(
+        [(key, pods, mask, None) for key, pods in gangs],
+        type_frees, type_prices, type_names)
+    assert enc.g == G, f"encode dropped gangs: {enc.g}/{G} ({enc.skipped})"
+    assert enc.device_ready and enc.cells >= GangConfig().device_min_cells, \
+        f"window too small for the device leg: {enc.cells} cells"
+
+    cfg = GangConfig()
+    # leg parity first: identical verdicts, then identical plans
+    feas_a, slots_a = host_gang(enc)
+    feas_b, slots_b, executor = solve_gang_window(enc, cfg)  # warm-up + jit
+    assert executor == "device-gang", f"device leg fell back: {executor}"
+    feas_parity = bool(_np.array_equal(feas_a, feas_b))
+    slots_parity = bool(_np.array_equal(slots_a, slots_b))
+
+    def plan_sig(plan):
+        return [(pl.gang.index,
+                 tuple((bi, tuple(pl.gang.pods.index(p) for p in ps))
+                       for bi, ps in pl.node_sets))
+                for pl in plan.placements]
+
+    plan_a = plan_gang_window(enc, feas_a)
+    plan_b = plan_gang_window(enc, feas_b)
+    node_parity = plan_sig(plan_a) == plan_sig(plan_b)
+    # the device verdict is a FILTER: every placement re-verified on host
+    unverified = len(plan_b.placements) - min(plan_b.verified,
+                                              len(plan_b.placements))
+
+    host_times = run_timed(lambda: host_gang(enc), budget_s=45.0)
+    device_times = run_timed(lambda: solve_gang_window(enc, cfg),
+                             budget_s=20.0)
+    st_host = _stats(host_times)
+    st_device = _stats(device_times)
+    speedup = round(st_host["p50_ms"] / (st_device["p50_ms"] or 1e-9), 2)
+    return {
+        "gangs": enc.g, "members": len(all_pods), "bins": enc.b,
+        "padded_cells": enc.cells,
+        "host_p50_ms": st_host["p50_ms"], "host_p99_ms": st_host["p99_ms"],
+        "device_p50_ms": st_device["p50_ms"],
+        "device_p99_ms": st_device["p99_ms"],
+        "speedup": speedup,
+        "executor": executor,
+        "feasible_gangs": int(feas_b.sum()),
+        "placed_gangs": len(plan_b.placements),
+        "verdict_parity": bool(feas_parity and slots_parity),
+        "node_parity": bool(node_parity),
+        "unverified_placements": int(unverified),
     }
 
 
@@ -1286,6 +1392,7 @@ def run_all(degraded: bool, probe_note: str = ""):
         ("config_8_large_catalog_type_spmd", config_8_large_catalog_type_spmd),
         ("config_9_million_pod_replay", config_9_million_pod_replay),
         ("config_10_marshal_delta", config_10_marshal_delta),
+        ("config_11_gang_copack", config_11_gang_copack),
     ):
         if not _selected(key, only):
             continue

@@ -38,6 +38,16 @@ LAUNCH_NONCE_TAG = KARPENTER_DOMAIN + "/launch-nonce"
 CAPACITY_TYPE_SPOT = "spot"
 CAPACITY_TYPE_ON_DEMAND = "on-demand"
 
+# Gang scheduling labels (ROADMAP item 1 / Tesserae): pods carrying the same
+# pod-group value (within one namespace) bind all-or-nothing. pod-group-size
+# declares the full membership count — the batcher holds the group until
+# that many members are queued (or a TTL expires). pod-group-slice
+# optionally names a TPU slice shape ("v5e-4x4"): only instance types whose
+# topology contains that shape may host the group (api/gang.py).
+POD_GROUP_LABEL = KARPENTER_DOMAIN + "/pod-group"
+POD_GROUP_SIZE_LABEL = KARPENTER_DOMAIN + "/pod-group-size"
+POD_GROUP_SLICE_LABEL = KARPENTER_DOMAIN + "/pod-group-slice"
+
 WELL_KNOWN_LABELS = frozenset({
     LABEL_TOPOLOGY_ZONE,
     LABEL_INSTANCE_TYPE,

@@ -48,6 +48,7 @@ def make_instance_type(
     aws_neurons: str = "0",
     aws_pod_eni: str = "0",
     price: float = 0.0,
+    tpu_topology: str = "",
 ) -> InstanceType:
     """fake.NewInstanceType defaults (instancetype.go:27-52)."""
     return InstanceType(
@@ -64,6 +65,7 @@ def make_instance_type(
         aws_pod_eni=Quantity.parse(aws_pod_eni),
         overhead=parse_resource_list({"cpu": "100m", "memory": "10Mi"}),
         price=price,
+        tpu_topology=tpu_topology,
     )
 
 

@@ -75,6 +75,10 @@ class InstanceType:
     aws_pod_eni: Quantity = field(default_factory=lambda: Quantity(0))
     overhead: ResourceList = field(default_factory=dict)
     price: float = 0.0
+    # TPU slice topology this type advertises ("v5e-4x4"; "" = none). Gangs
+    # carrying a pod-group-slice label only land on types whose topology
+    # contains the requested shape (api/gang.py, ops/feasibility.py).
+    tpu_topology: str = ""
 
 
 BindCallback = Callable[[Node], Optional[str]]

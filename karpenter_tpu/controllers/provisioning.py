@@ -38,13 +38,19 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.constraints import Constraints
 from karpenter_tpu.api.core import Node, NodeSelectorRequirement as Req, Pod, Taint
+from karpenter_tpu.api.gang import gang_of
 from karpenter_tpu.api.provisioner import Provisioner, set_condition
 from karpenter_tpu.api.requirements import Requirements
 from karpenter_tpu.cloudprovider.spi import CloudProvider, InstanceType
 from karpenter_tpu import pressure
+from karpenter_tpu.metrics.gang import (
+    GANG_WINDOWS_TOTAL, GANGS_PLACED_TOTAL, GANGS_UNPLACEABLE_TOTAL,
+)
 from karpenter_tpu.metrics.pressure import WINDOW_SPLITS_TOTAL
 from karpenter_tpu.metrics.registry import HISTOGRAMS
 from karpenter_tpu.obs import trace as obtrace
@@ -53,7 +59,11 @@ from karpenter_tpu.runtime.kubecore import (
 )
 from karpenter_tpu.scheduling.batcher import Batcher
 from karpenter_tpu.scheduling.scheduler import Scheduler
+from karpenter_tpu.ops.gang import GangEncoding, encode_gang_window
 from karpenter_tpu.solver.batch_solve import Problem, dispatch_batch
+from karpenter_tpu.solver.gang import (
+    GangConfig, GangPlacement, dispatch_gang_window, plan_gang_window,
+)
 from karpenter_tpu.solver.pipeline import PipelineConfig, SolvePipeline
 from karpenter_tpu.solver.solve import SolveResult, SolverConfig
 from karpenter_tpu.utils import pod as podutil
@@ -103,6 +113,12 @@ class _ChunkPrep:
     schedules: list
     problems: List[Problem]
     dispatch_s: float = field(default=0.0)
+    # gang co-pack half of the chunk: one batched device solve for every
+    # complete pod group the scheduler grouped out of this chunk
+    gang_enc: Optional[GangEncoding] = None
+    gang_types: list = field(default_factory=list)  # type idx → (schedule, it)
+    gang_handle: Optional[object] = None
+    gang_nodes: Dict[int, str] = field(default_factory=dict)  # bin → node
 
 
 class ProvisionerEngine:
@@ -142,6 +158,7 @@ class ProvisionerWorker:
         self.kube = kube
         self.cloud_provider = cloud_provider
         self.solver_config = solver_config or SolverConfig()
+        self.gang_config = GangConfig()
         self.batcher = batcher or Batcher()
         self.pipeline_config = pipeline_config or PipelineConfig()
         self.shard = shard
@@ -243,8 +260,11 @@ class ProvisionerWorker:
         pod to that engine's group within the shard window; None means the
         default (first attached) engine — the legacy single-tenant call."""
         band, priority = pressure.classify(pod)
+        gspec = gang_of(pod)
+        gang = (gspec.key, gspec.size) \
+            if gspec is not None and not gspec.error else None
         return self.batcher.add((provisioner, pod), key=key, band=band,
-                                priority=priority)
+                                priority=priority, gang=gang)
 
     def pending(self, key) -> bool:
         """True while a pod with this (namespace, name) key awaits a batch
@@ -383,6 +403,10 @@ class ProvisionerWorker:
                               provisioner=eng.provisioner.metadata.name,
                               pods=len(pods)):
                 schedules = eng.scheduler.solve(eng.provisioner, pods)
+            # gang schedules peel off into the co-pack window; the rest
+            # keep the reference's per-schedule packing problems
+            gang_scheds = [s for s in schedules if s.gang is not None]
+            schedules = [s for s in schedules if s.gang is None]
             problems = [
                 Problem(
                     constraints=s.constraints,
@@ -392,7 +416,52 @@ class ProvisionerWorker:
                     daemons=self._get_daemons(s.constraints))
                 for s in schedules
             ]
-        return _ChunkPrep(schedules=schedules, problems=problems)
+        prep = _ChunkPrep(schedules=schedules, problems=problems)
+        if gang_scheds:
+            prep.gang_enc, prep.gang_types = self._encode_gangs(gang_scheds)
+        return prep
+
+    def _encode_gangs(self, gang_scheds):
+        """Marshal every gang schedule of the chunk into ONE window
+        encoding. The window type axis is the concatenation of each
+        schedule's validated+sorted catalog segment, so a gang's
+        group-feasibility column (ops/feasibility.gang_feasibility_mask)
+        is zero outside its own segment — prospective nodes only ever
+        carry one schedule's labels/taints, exactly like the scalar
+        launch path."""
+        from karpenter_tpu.ops import feasibility
+        from karpenter_tpu.solver import adapter
+
+        type_frees: list = []
+        type_prices: list = []
+        type_names: list = []
+        type_ctx: list = []
+        segments = []
+        for s in gang_scheds:
+            catalog = self.cloud_provider.get_instance_types(s.constraints)
+            daemons = self._get_daemons(s.constraints)
+            packables, sorted_types = adapter.build_packables_cached(
+                catalog, s.constraints, s.pods, daemons)
+            allowed = adapter._allowed_sets(s.constraints)
+            required = adapter._required_resources(s.pods)
+            seg_mask = feasibility.gang_feasibility_mask(
+                sorted_types, [(allowed, required)], s.gang.slice_)
+            base = len(type_frees)
+            for pk, it in zip(packables, sorted_types):
+                type_frees.append(
+                    [t - r for t, r in zip(pk.total, pk.reserved)])
+                type_prices.append(it.price)
+                type_names.append(it.name)
+                type_ctx.append((s, it))
+            segments.append((s, base, seg_mask))
+        n = len(type_frees)
+        gangs = []
+        for s, base, seg_mask in segments:
+            mask = np.zeros(n, bool)
+            mask[base:base + len(seg_mask)] = seg_mask
+            gangs.append((s.gang.key, s.pods, mask, s))
+        enc = encode_gang_window(gangs, type_frees, type_prices, type_names)
+        return enc, type_ctx
 
     def _dispatch_chunk(self, prep: _ChunkPrep):
         """ALL the chunk's schedules pack in one batched device call (one
@@ -402,6 +471,11 @@ class ProvisionerWorker:
         for the pipeline to fetch; fallbacks resolve at fetch time."""
         t0 = time.perf_counter()
         handle = dispatch_batch(prep.problems, config=self.solver_config)
+        if prep.gang_enc is not None and prep.gang_enc.g > 0:
+            # same round trip: the gang window rides the dispatch stage
+            # alongside the per-schedule batch, fetch resolves both
+            prep.gang_handle = dispatch_gang_window(prep.gang_enc,
+                                                    self.gang_config)
         prep.dispatch_s = time.perf_counter() - t0
         return handle
 
@@ -416,7 +490,142 @@ class ProvisionerWorker:
                 err = self._launch(schedule.constraints, packing)
                 if err is not None:
                     log.error("could not launch node: %s", err)
+        if prep.gang_enc is not None:
+            self._complete_gangs(prep)
         return last_result
+
+    # -- gang co-pack (all-or-nothing pod groups) ----------------------------
+    def _complete_gangs(self, prep: _ChunkPrep) -> None:
+        """Fetch the window's batched gang solve, re-verify every accepted
+        gang on exact host ints, and bind atomically. Unplaceable gangs
+        stay Pending — the selection requeue's jittered backoff re-enters
+        them on the next pass."""
+        enc = prep.gang_enc
+        GANG_WINDOWS_TOTAL.inc()
+        for key, reason in enc.skipped:
+            GANGS_UNPLACEABLE_TOTAL.inc(reason="no-type")
+            log.info("gang %s unplaceable: %s window_id=%s shard=%s",
+                     key, reason, self._window_id, self.shard or "0")
+        feasible = None
+        if prep.gang_handle is not None:
+            feasible, _, executor = prep.gang_handle.fetch()
+            log.info("gang window solved: %d gang(s) executor=%s "
+                     "window_id=%s shard=%s", enc.g, executor,
+                     self._window_id, self.shard or "0")
+        plan = plan_gang_window(enc, feasible)
+        for e, reason in plan.unplaced:
+            GANGS_UNPLACEABLE_TOTAL.inc(reason=reason)
+            log.info("gang %s unplaceable: %s window_id=%s shard=%s",
+                     e.key, reason, self._window_id, self.shard or "0")
+        for placement in plan.placements:
+            err = self._launch_gang(prep, placement)
+            if err is None:
+                GANGS_PLACED_TOTAL.inc()
+            else:
+                GANGS_UNPLACEABLE_TOTAL.inc(reason="bind-failed")
+                log.error("gang %s bind failed (unwound): %s window_id=%s "
+                          "shard=%s", placement.gang.key, err,
+                          self._window_id, self.shard or "0")
+
+    def _launch_gang(self, prep: _ChunkPrep,
+                     placement: GangPlacement) -> Optional[str]:
+        """Atomic gang launch: every member binds or none stays bound.
+        Two phases — create all node objects first, then bind members —
+        so a mid-fleet launch failure costs zero binds; a mid-bind
+        failure unwinds the bound members and hands the created nodes to
+        the termination finalizer."""
+        schedule = placement.gang.context
+        constraints = schedule.constraints
+        provisioner = self._engine().provisioner
+        try:
+            latest = self.kube.get("Provisioner", provisioner.metadata.name)
+        except NotFound:
+            return "provisioner deleted"
+        err = provisioner.spec.limits.exceeded_by(latest.status.resources)
+        if err is not None:
+            return err
+        enc = prep.gang_enc
+        # phase 1: every node object exists before any member binds
+        created: List[str] = []
+        node_of: Dict[int, str] = {}
+        for bin_index, _pods in placement.node_sets:
+            name = prep.gang_nodes.get(bin_index)
+            if name is None:
+                _, itype = prep.gang_types[enc.bins[bin_index].type_index]
+                name = self._create_gang_node(constraints, itype)
+                if name is None:
+                    self._unwind_gang(prep, placement, node_of, created)
+                    return (f"could not launch node for bin "
+                            f"{enc.bins[bin_index].name}")
+                prep.gang_nodes[bin_index] = name
+                created.append(name)
+            node_of[bin_index] = name
+        # phase 2: bind members node-set by node-set
+        for bin_index, pods in placement.node_sets:
+            name = node_of[bin_index]
+            try:
+                errs = self.kube.bind_pods(pods, name)
+            except ApiError as e:
+                errs = [str(e)] * len(pods)
+            errs = [e for e in errs
+                    if "already bound" not in e and "already exists" not in e]
+            if errs:
+                self._unwind_gang(prep, placement, node_of, created)
+                return f"binding to {name}: " + "; ".join(errs)
+        log.info("gang %s bound: %d pod(s) across %d node(s) window_id=%s "
+                 "shard=%s", placement.gang.key, len(placement.gang.pods),
+                 len(placement.node_sets), self._window_id,
+                 self.shard or "0")
+        return None
+
+    def _create_gang_node(self, constraints: Constraints,
+                          itype) -> Optional[str]:
+        """Launch ONE node of ``itype`` and create its Node object
+        (finalizer + not-ready taint) without binding anything."""
+        names: List[str] = []
+
+        def bind(node: Node) -> Optional[str]:
+            node.metadata.labels.update(constraints.labels)
+            node.spec.taints.extend(constraints.taints)
+            err = self._bind(node, [])
+            if err is None:
+                names.append(node.metadata.name)
+            return err
+
+        errs = self.cloud_provider.create(constraints, [itype], 1, bind)
+        errs = [e for e in errs if e]
+        if errs:
+            log.error("gang node launch failed: %s", "; ".join(errs))
+        return names[0] if names else None
+
+    def _unwind_gang(self, prep: _ChunkPrep, placement: GangPlacement,
+                     node_of: Dict[int, str], created: List[str]) -> None:
+        """Roll a partially-bound gang back to nothing: unbind every
+        member that landed on one of this gang's nodes, then delete the
+        nodes created for it — the termination finalizer walks them
+        through cordon/drain/instance teardown like any other node."""
+        names = set(node_of.values())
+
+        def clear(obj):
+            if getattr(obj.spec, "node_name", "") in names:
+                obj.spec.node_name = ""
+            else:
+                raise _NoChange
+
+        for pod in placement.gang.pods:
+            try:
+                self.kube.patch("Pod", pod.metadata.name,
+                                pod.metadata.namespace, clear)
+            except (_NoChange, NotFound):
+                pass
+        gone = set(created)
+        for bi in [b for b, n in prep.gang_nodes.items() if n in gone]:
+            del prep.gang_nodes[bi]  # a later gang must not bind here
+        for name in created:
+            try:
+                self.kube.delete("Node", name, "")
+            except (NotFound, ApiError):
+                pass
 
     def _observe_chunk(self, prep: _ChunkPrep, stats: dict) -> None:
         # binpacking = solver wall the hot loop actually paid (dispatch +

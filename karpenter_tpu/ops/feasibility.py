@@ -702,8 +702,116 @@ def catalog_feasibility_mask(instance_types, allowed: tuple,
     return mask
 
 
+# -- group-level (gang) columns ----------------------------------------------
+#
+# A gang's allowed-offering mask is the AND of its members' per-type
+# feasibility columns intersected with a slice-compatibility column
+# (offering topology ⊇ requested slice shape) — the same mask-space algebra
+# `_compute_mask` runs over, with the same scalar self-heal contract
+# `topology_allowed` carries: any suspicious mask-space answer is re-derived
+# from the scalar per-member oracle, the scalar verdict wins, and the
+# divergence is counted on FILTER_FALLBACK_TOTAL. Built once per gang
+# signature (catalog tokens + distinct member keys + slice shape) — a
+# 256-gang window whose gangs share constraints pays for one column.
+
+_GANG_MASK_CACHE: dict = {}
+_GANG_MASK_CACHE_CAP = 128
+_SLICE_COL_CACHE: dict = {}
+_SLICE_COL_CACHE_CAP = 64
+
+
+def _slice_column(instance_types, tokens: tuple, shape) -> np.ndarray:
+    """Per-type slice compatibility column, cached per (catalog, shape)."""
+    skey = (tokens, str(shape))
+    with _CATALOG_LOCK:
+        col = _SLICE_COL_CACHE.get(skey)
+        if col is not None:
+            return col
+    from karpenter_tpu.api.gang import instance_slice_shape, slice_fits
+
+    col = np.fromiter(
+        (slice_fits(instance_slice_shape(it), shape) for it in instance_types),
+        dtype=bool, count=len(instance_types))
+    col.flags.writeable = False
+    with _CATALOG_LOCK:
+        if len(_SLICE_COL_CACHE) >= _SLICE_COL_CACHE_CAP:
+            _SLICE_COL_CACHE.pop(next(iter(_SLICE_COL_CACHE)))
+        _SLICE_COL_CACHE[skey] = col
+    return col
+
+
+def gang_scalar_mask(instance_types, member_keys, slice_shape) -> np.ndarray:
+    """The scalar per-member oracle: type t is gang-viable iff
+    adapter._validate accepts it for EVERY member (allowed, required) key
+    and its advertised topology contains the requested slice. This is the
+    reference semantics the columnar path must reproduce exactly
+    (tests/test_gang.py fuzzes the two against each other)."""
+    from karpenter_tpu.api.gang import instance_slice_shape, slice_fits
+    from karpenter_tpu.solver.adapter import _validate
+
+    out = np.zeros(len(instance_types), bool)
+    for t, it in enumerate(instance_types):
+        if any(_validate(it, allowed, required) is not None
+               for allowed, required in member_keys):
+            continue
+        if slice_shape is not None and not slice_fits(
+                instance_slice_shape(it), slice_shape):
+            continue
+        out[t] = True
+    return out
+
+
+def gang_feasibility_mask(instance_types, member_keys,
+                          slice_shape=None) -> np.ndarray:
+    """Group-level feasibility column for one gang: True = every member's
+    scalar validators accept the type AND the type can carve the requested
+    slice (when one is declared). ``member_keys`` is a sequence of
+    (allowed, required) pairs as :func:`catalog_feasibility_mask` takes —
+    one per member (duplicates collapse; a gang whose members share
+    tightened constraints costs one column). Never returns None: when the
+    catalog cannot be indexed the scalar oracle fills in. The result is
+    shared and read-only."""
+    tokens = tuple(_catalog_token(it) for it in instance_types)
+    distinct = tuple(sorted(set(member_keys)))
+    gkey = (tokens, distinct, str(slice_shape) if slice_shape else "")
+    with _CATALOG_LOCK:
+        hit = _GANG_MASK_CACHE.get(gkey)
+    if hit is not None:
+        return hit
+    t0 = time.perf_counter()
+    mask: Optional[np.ndarray] = np.ones(len(instance_types), bool)
+    for allowed, required in distinct:
+        col = catalog_feasibility_mask(instance_types, allowed, required)
+        if col is None:
+            mask = None  # catalog not indexable: scalar path
+            break
+        mask = mask & col
+    if mask is not None and slice_shape is not None:
+        mask = mask & _slice_column(instance_types, tokens, slice_shape)
+    if mask is None:
+        mask = gang_scalar_mask(instance_types, distinct, slice_shape)
+        FILTER_FALLBACK_TOTAL.inc(reason="gang-unindexable")
+    elif distinct and not mask.any():
+        # scalar self-heal (the topology_allowed contract): an all-False
+        # group column is re-derived from the scalar oracle; scalar wins.
+        scalar = gang_scalar_mask(instance_types, distinct, slice_shape)
+        if scalar.any():
+            FILTER_FALLBACK_TOTAL.inc(reason="gang-mismatch")
+            mask = scalar
+    mask = np.asarray(mask, bool)
+    mask.flags.writeable = False
+    FILTER_BATCH_SECONDS.observe(time.perf_counter() - t0, stage="gang")
+    with _CATALOG_LOCK:
+        if len(_GANG_MASK_CACHE) >= _GANG_MASK_CACHE_CAP:
+            _GANG_MASK_CACHE.pop(next(iter(_GANG_MASK_CACHE)))
+        _GANG_MASK_CACHE[gkey] = mask
+    return mask
+
+
 def clear_catalog_caches() -> None:
     """Tests only."""
     with _CATALOG_LOCK:
         _INDEX_CACHE.clear()
         _MASK_CACHE.clear()
+        _GANG_MASK_CACHE.clear()
+        _SLICE_COL_CACHE.clear()

@@ -1,0 +1,284 @@
+"""Gang co-pack window encoding: G gangs × B candidate bins as one tensor.
+
+The batched what-if pattern (ops/whatif.py, docs/solver.md §13) applied to
+provisioning-side gangs: a window holds G all-or-nothing pod groups; each
+gang is one independent sub-solve — first-fit its members into a shared
+pool of *prospective* nodes (bins) — and all G sub-solves fold into one
+vmap'd device kernel (solver/gang.py). Where what-if's sub-solves exclude
+their own bin, a gang's sub-solve has no own bin (the nodes do not exist
+yet); the same masked-write reserve discipline applies, and rollback is
+structural — vmap hands every gang a private copy of the pool, so an
+unplaceable gang perturbs nothing.
+
+Bins are prospective nodes. For each gang the encoder materializes enough
+empty nodes of its *cheapest* feasible instance type (by catalog price) to
+host the whole gang alone; the pool is shared, so a gang may also land in
+the leftover space of another gang's compatible bins — the co-pack win
+Tesserae measures. ``compat[g, b]`` is the gang's group-level feasibility
+column (ops/feasibility.gang_feasibility_mask) indexed by bin type.
+
+The device result is a FILTER. Every gang the device calls feasible is
+re-verified member-by-member on exact host nano ints against the window's
+running pool state (:func:`verify_and_commit_gang`) before any bind —
+zero unverified placements by construction, exactly the what-if contract.
+
+All integers are nano units GCD-scaled to int32 (whatif._gcd_scale_signed);
+scaling divides by a common factor, so device comparisons are exact.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.api.core import Pod
+from karpenter_tpu.ops.whatif import (
+    MAX_WINDOW_CELLS, _gcd_scale_signed, _pow2, _reserve_vec,
+)
+from karpenter_tpu.solver.host_ffd import NUM_RESOURCES
+
+Vec = Tuple[int, ...]
+
+
+@dataclass
+class GangBin:
+    """One prospective node: an empty instance of ``type_index`` whose free
+    vector is the type's allocatable after overhead + daemon reserve."""
+
+    name: str
+    type_index: int
+    free: List[int]
+
+
+@dataclass
+class EncodedGang:
+    """One gang's host-side view inside a window."""
+
+    index: int
+    key: Any                      # gang identity (namespace, name)
+    pods: List[Pod]
+    vecs: List[Vec]               # reserve vectors, sorted desc (cpu, mem)
+    type_mask: np.ndarray         # (T,) group feasibility over instance types
+    context: Any = None           # caller payload (Schedule), carried through
+
+
+@dataclass
+class GangEncoding:
+    """Host + device tensors of one gang co-pack window."""
+
+    gangs: List[EncodedGang]
+    bins: List[GangBin]
+    compat: np.ndarray            # (G, B) bool: gang may use bin
+    g: int
+    k: int                        # max members over gangs
+    b: int
+    # device side (None when the window did not encode: too big / empty)
+    d_pods: Optional[np.ndarray] = None     # (GB, KB, R) int32, scaled
+    d_valid: Optional[np.ndarray] = None    # (GB, KB) bool
+    d_compat: Optional[np.ndarray] = None   # (GB, BB) bool
+    d_free0: Optional[np.ndarray] = None    # (BB, R) int32, scaled
+    scales: Optional[Tuple[int, ...]] = None
+    skipped: List[Tuple[Any, str]] = field(default_factory=list)
+
+    @property
+    def device_ready(self) -> bool:
+        return self.d_pods is not None
+
+    @property
+    def cells(self) -> int:
+        if self.d_pods is None:
+            return 0
+        gb, kb, _ = self.d_pods.shape
+        return gb * kb * self.d_compat.shape[1]
+
+
+def _nodes_needed(vecs: Sequence[Vec], free: Sequence[int]) -> Optional[int]:
+    """First-fit node count for one gang alone on unlimited empty bins with
+    this free vector; None when some member overflows even an empty bin."""
+    opened: List[List[int]] = []
+    for vec in vecs:
+        if any(vec[r] > free[r] for r in range(NUM_RESOURCES)):
+            return None
+        for node in opened:
+            if all(node[r] >= vec[r] for r in range(NUM_RESOURCES)):
+                for r in range(NUM_RESOURCES):
+                    node[r] -= vec[r]
+                break
+        else:
+            node = list(free)
+            for r in range(NUM_RESOURCES):
+                node[r] -= vec[r]
+            opened.append(node)
+    return len(opened)
+
+
+def encode_gang_window(
+    gangs: Sequence[Tuple[Any, Sequence[Pod], np.ndarray, Any]],
+    type_frees: Sequence[Optional[Sequence[int]]],
+    type_prices: Sequence[float],
+    type_names: Sequence[str],
+    max_cells: int = MAX_WINDOW_CELLS,
+    max_bins: int = 4096,
+) -> GangEncoding:
+    """Encode one window.
+
+    ``gangs``: (key, pods, type_mask, context) per gang, window priority
+    order. ``type_frees[t]`` is type t's empty-node free vector (nano,
+    after overhead + daemons) or None when the type cannot even boot
+    (daemons overflow it). A gang with no viable type — empty mask, no
+    type that fits its largest member — is recorded in ``skipped`` with a
+    reason and excluded from the tensors; a partial answer beats no window.
+    """
+    encoded: List[EncodedGang] = []
+    bins: List[GangBin] = []
+    skipped: List[Tuple[Any, str]] = []
+    bins_per_type: dict = {}  # type_index → bin count already materialized
+
+    for key, pods, type_mask, context in gangs:
+        # sort members desc (cpu, mem) keeping the pod association: slots[i]
+        # names the bin for pods[i] all the way through bind
+        pairs = sorted(((_reserve_vec(p), p) for p in pods),
+                       key=lambda t: (-t[0][0], -t[0][1]))
+        vecs = [v for v, _ in pairs]
+        pods = [p for _, p in pairs]
+        viable = [t for t in np.flatnonzero(np.asarray(type_mask))
+                  if type_frees[t] is not None]
+        if not viable:
+            skipped.append((key, "no feasible instance type"))
+            continue
+        # cheapest-first: the gang's bins come from its cheapest type that
+        # can host it alone; cost tiebreak by name keeps runs deterministic
+        viable.sort(key=lambda t: (type_prices[t], type_names[t]))
+        need, chosen = None, None
+        for t in viable:
+            need = _nodes_needed(vecs, type_frees[t])
+            if need is not None:
+                chosen = t
+                break
+        if chosen is None:
+            skipped.append((key, "members exceed every feasible type"))
+            continue
+        # grow the shared pool so this gang could place alone on its chosen
+        # type even after earlier gangs consumed their own replicas
+        have = bins_per_type.get(chosen, 0)
+        grow = need  # one gang's worth; sharing leftovers is a bonus
+        for i in range(grow):
+            bins.append(GangBin(
+                name=f"{type_names[chosen]}~{have + i}",
+                type_index=chosen,
+                free=list(type_frees[chosen])))
+        bins_per_type[chosen] = have + grow
+        encoded.append(EncodedGang(
+            index=len(encoded), key=key, pods=list(pods), vecs=vecs,
+            type_mask=np.asarray(type_mask, bool), context=context))
+        if len(bins) > max_bins:
+            break
+
+    g, b = len(encoded), len(bins)
+    k = max((len(e.vecs) for e in encoded), default=0)
+    enc = GangEncoding(gangs=encoded, bins=bins,
+                       compat=np.zeros((g, b), bool), g=g, k=k, b=b,
+                       skipped=skipped)
+    if g == 0 or b == 0 or k == 0:
+        return enc
+    bin_types = np.array([bn.type_index for bn in bins], np.int64)
+    for e in encoded:
+        enc.compat[e.index] = e.type_mask[bin_types]
+
+    # GCD-scale every column that meets the comparator (whatif contract)
+    cols = [[bn.free[r] for bn in bins] for r in range(NUM_RESOURCES)]
+    for r in range(NUM_RESOURCES):
+        cols[r].extend(v[r] for e in encoded for v in e.vecs)
+    scales = _gcd_scale_signed(cols)
+    if scales is None:
+        return enc  # values overflow int32 even scaled: host path only
+    gb, kb, bb = _pow2(g), _pow2(k), _pow2(b)
+    if gb * kb * bb > max_cells:
+        return enc
+    d_pods = np.zeros((gb, kb, NUM_RESOURCES), np.int32)
+    d_valid = np.zeros((gb, kb), bool)
+    d_compat = np.zeros((gb, bb), bool)
+    d_free0 = np.zeros((bb, NUM_RESOURCES), np.int32)
+    for bi, bn in enumerate(bins):
+        for r in range(NUM_RESOURCES):
+            d_free0[bi, r] = bn.free[r] // scales[r]
+    for e in encoded:
+        for ki, vec in enumerate(e.vecs):
+            for r in range(NUM_RESOURCES):
+                d_pods[e.index, ki, r] = vec[r] // scales[r]
+            d_valid[e.index, ki] = True
+        d_compat[e.index, :b] = enc.compat[e.index]
+    enc.d_pods, enc.d_valid, enc.d_compat, enc.d_free0 = (
+        d_pods, d_valid, d_compat, d_free0)
+    enc.scales = scales
+    return enc
+
+
+def host_gang(enc: GangEncoding) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact host mirror of the device kernel: per gang, first-fit its
+    members into a PRIVATE copy of the full pool (each gang judged
+    independently, as vmap does). Returns (feasible (G,), slots (G, K))
+    with -1 for unplaced/padded members. Nano ints, no scaling."""
+    feasible = np.zeros(enc.g, bool)
+    slots = np.full((enc.g, enc.k), -1, np.int64)
+    for e in enc.gangs:
+        free = [list(bn.free) for bn in enc.bins]
+        ok = True
+        for ki, vec in enumerate(e.vecs):
+            placed = False
+            for bi in range(enc.b):
+                if not enc.compat[e.index, bi]:
+                    continue
+                if all(free[bi][r] >= vec[r] for r in range(NUM_RESOURCES)):
+                    for r in range(NUM_RESOURCES):
+                        free[bi][r] -= vec[r]
+                    slots[e.index, ki] = bi
+                    placed = True
+                    break
+            if not placed:
+                ok = False
+                break
+        feasible[e.index] = ok
+        if not ok:
+            slots[e.index, :] = -1
+    return feasible, slots
+
+
+def verify_and_commit_gang(
+    enc: GangEncoding,
+    gang_index: int,
+    free_state: List[List[int]],
+) -> Optional[List[int]]:
+    """Exact host re-verification of one gang against the window's RUNNING
+    pool state: first-fit every member on nano ints into a trial copy;
+    commit the trial (mutating ``free_state``) only when every member
+    lands. Returns the member→bin assignment or None (state untouched).
+    This is the only path to a gang bind — the device verdict never
+    commits anything by itself."""
+    e = enc.gangs[gang_index]
+    trial: dict = {}  # copy-on-write: only touched bins are copied
+    slots: List[int] = []
+    for vec in e.vecs:
+        placed = False
+        for bi in range(enc.b):
+            if not enc.compat[gang_index, bi]:
+                continue
+            free = trial.get(bi)
+            if free is None:
+                free = free_state[bi]
+            if all(free[r] >= vec[r] for r in range(NUM_RESOURCES)):
+                work = trial.get(bi)
+                if work is None:
+                    work = trial[bi] = list(free_state[bi])
+                for r in range(NUM_RESOURCES):
+                    work[r] -= vec[r]
+                slots.append(bi)
+                placed = True
+                break
+        if not placed:
+            return None
+    for bi, work in trial.items():
+        free_state[bi] = work
+    return slots

@@ -92,12 +92,19 @@ class ReplayConfig:
     chaos: bool = True            # FaultPlan + ChaosKube wrapper
     settle_s: float = 180.0       # post-flood budget: binds + L0 recovery
     flood_pool: int = 512         # distinct flood pod objects (cycled)
+    gang_fraction: float = 0.0    # of the cohort: all-or-nothing pod groups
+    gang_size: int = 4            # members per injected gang
 
     def validate(self) -> None:
         if self.shards < 1:
             raise ValueError(f"shards must be >= 1: {self.shards}")
         if self.tenants < 1:
             raise ValueError(f"tenants must be >= 1: {self.tenants}")
+        if not 0.0 <= self.gang_fraction <= 1.0:
+            raise ValueError(
+                f"gang_fraction must be in [0, 1]: {self.gang_fraction}")
+        if self.gang_size < 1:
+            raise ValueError(f"gang_size must be >= 1: {self.gang_size}")
         overhead = self.bound_cohort + self.churn_pods
         if self.pods_total < overhead:
             raise ValueError(
@@ -332,6 +339,50 @@ def run_replay(cfg: ReplayConfig) -> dict:
             created_at[pod.metadata.name] = time.perf_counter()
             band_of[pod.metadata.name] = band
 
+        # ---- gang cohort: all-or-nothing pod groups (gang_fraction) ----
+        # seeded gang workloads ride the same full path as the cohort;
+        # the SLO report asserts ZERO partial gangs — a gang either binds
+        # whole or stays wholly Pending
+        gang_members: Dict[str, List[str]] = {}
+        n_gangs = int(cfg.bound_cohort * cfg.gang_fraction) // cfg.gang_size
+        for gi in range(n_gangs):
+            gname = f"replay-gang-{gi}"
+            zone = tenant_zone(gi % cfg.tenants)
+            members: List[str] = []
+            ok = True
+            for m in range(cfg.gang_size):
+                pod = _pending_pod(
+                    f"{gname}-m{m}", zone=zone,
+                    requests={"cpu": f"{rng.choice([250, 500])}m",
+                              "memory": "256Mi"})
+                pod.metadata.labels[wellknown.POD_GROUP_LABEL] = gname
+                pod.metadata.labels[wellknown.POD_GROUP_SIZE_LABEL] = \
+                    str(cfg.gang_size)
+                try:
+                    kube.create(pod)
+                except Exception:
+                    try:  # injected apiserver fault: one retry
+                        kube.create(pod)
+                    except Exception:
+                        ok = False
+                        break
+                members.append(pod.metadata.name)
+            if not ok:
+                # a member never reached the apiserver: the gang can never
+                # complete, so withdraw the partial group entirely rather
+                # than leave a forever-partial gang in the run
+                for name in members:
+                    try:
+                        kube.delete("Pod", name, "default")
+                    except Exception:
+                        pass
+                continue
+            for name in members:
+                offered["default"] += 1
+                created_at[name] = time.perf_counter()
+                band_of[name] = "default"
+            gang_members[gname] = members
+
         # ---- flood + churn, shaped by the diurnal schedule -------------
         flood_total = cfg.pods_total - sum(offered.values()) - cfg.churn_pods
         weights = diurnal_weights(cfg.ticks, cfg.burst_ticks, rng)
@@ -424,6 +475,11 @@ def run_replay(cfg: ReplayConfig) -> dict:
                               for n in bound_at if band_of[n] == band])
             for band in COHORT_BANDS
         }
+        gangs_full = sum(1 for ms in gang_members.values()
+                         if all(n in bound_at for n in ms))
+        partial_gangs = sum(
+            1 for ms in gang_members.values()
+            if 0 < sum(n in bound_at for n in ms) < len(ms))
         import os as _os
         report = {
             "config": asdict(cfg),
@@ -438,6 +494,12 @@ def run_replay(cfg: ReplayConfig) -> dict:
             "recovery_to_l0_s": (round(recovery_at - flood_end, 2)
                                  if recovery_at is not None else None),
             "churn_deleted": churn_deleted,
+            "gangs": {
+                "offered_gangs": len(gang_members),
+                "gang_size": cfg.gang_size,
+                "gangs_fully_bound": gangs_full,
+                "partial_gangs": partial_gangs,
+            },
             "store_ops": sampler.report(),
             "rss_growth_mib": (peak_rss - start_rss) >> 20,
             "chaos_fired": ({f"{b}/{o}/{k}": n for (b, o, k), n
@@ -447,7 +509,7 @@ def run_replay(cfg: ReplayConfig) -> dict:
             "nproc": _os.cpu_count(),
             "wall_s": round(time.perf_counter() - t_run0, 2),
             "completed": (not unbound and recovery_at is not None
-                          and manager.healthz()),
+                          and manager.healthz() and partial_gangs == 0),
         }
         return report
     finally:
@@ -519,3 +581,45 @@ def store_ab(objects: int = 100_000, minority: int = 2_000,
         "gate": "scan_speedup >= 5 (no-copy by-kind path; the list leg's "
                 "deep copies cost the same in both stores)",
     }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Knob-level CLI for ad-hoc replays (the bench path is config_9):
+    ``python -m karpenter_tpu.replay --gang-fraction 0.2`` injects seeded
+    all-or-nothing pod groups into the cohort and fails the run if any
+    gang bound partially."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description="cluster-in-a-box replay")
+    ap.add_argument("--pods-total", type=int, default=10_000)
+    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--tenants", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--bound-cohort", type=int, default=200)
+    ap.add_argument("--churn-pods", type=int, default=200)
+    ap.add_argument("--ticks", type=int, default=8)
+    ap.add_argument("--settle-s", type=float, default=60.0)
+    ap.add_argument("--gang-fraction", type=float, default=0.0,
+                    help="fraction of the cohort offered as gangs")
+    ap.add_argument("--gang-size", type=int, default=4)
+    ap.add_argument("--no-chaos", action="store_true")
+    args = ap.parse_args(argv)
+    cfg = ReplayConfig(
+        pods_total=args.pods_total, shards=args.shards,
+        tenants=args.tenants, seed=args.seed,
+        bound_cohort=args.bound_cohort, churn_pods=args.churn_pods,
+        max_depth=max(400, args.pods_total // 3), ticks=args.ticks,
+        tick_sleep_s=0.1, chaos=not args.no_chaos, settle_s=args.settle_s,
+        flood_pool=128, gang_fraction=args.gang_fraction,
+        gang_size=args.gang_size)
+    report = run_replay(cfg)
+    print(json.dumps(report, indent=2, default=str))
+    return 0 if report["completed"] else 1
+
+
+if __name__ == "__main__":
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    raise SystemExit(main())

@@ -24,6 +24,13 @@ Brownout extensions (docs/robustness.md §4):
   step promotes it one band, so sustained pressure cannot starve it.
 - **Window shrink**: at L1+ the idle/max windows halve so assembly wall
   time — itself a pressure signal — is bounded under load.
+- **Gang hold** (docs/scheduling.md): items added with ``gang=(key, size)``
+  belong to an all-or-nothing pod group. Window assembly holds the group
+  until ``size`` distinct members are queued — a partial gang never enters
+  a solve window — and never splits a complete group at the item cap. A
+  partial group older than ``gang_ttl_seconds`` is shed whole (reason
+  ``gang-expired``), keys released immediately, so the selection requeue
+  re-offers every member through the band-aware path.
 
 Callers block on the gate returned by add(); the provisioning worker
 flushes the gate after a provisioning pass so selection reconcilers can
@@ -36,6 +43,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from karpenter_tpu.metrics.gang import (
+    GANG_HOLD_SECONDS, GANGS_UNPLACEABLE_TOTAL)
 from karpenter_tpu.metrics.pressure import INTAKE_QUEUE_DEPTH, PODS_SHED_TOTAL
 from karpenter_tpu.obs import trace
 from karpenter_tpu.pressure import bands as _bands
@@ -49,10 +58,11 @@ _FIRST_SEEN_SWEEP_MIN = 1024
 
 class _Entry:
     __slots__ = ("seq", "item", "key", "band", "rank", "priority",
-                 "first_seen", "sid")
+                 "first_seen", "sid", "gang", "gang_size")
 
     def __init__(self, seq: int, item: Any, key: Any, band: str, rank: int,
-                 priority: int, first_seen: float):
+                 priority: int, first_seen: float,
+                 gang: Any = None, gang_size: int = 0):
         self.seq = seq
         self.item = item
         self.key = key
@@ -60,6 +70,10 @@ class _Entry:
         self.rank = rank
         self.priority = priority
         self.first_seen = first_seen
+        # gang identity + declared size: a gang is held out of windows
+        # until gang_size distinct members are queued (or the TTL sheds it)
+        self.gang = gang
+        self.gang_size = gang_size
         # stable identity for deterministic ordering: the same pod set
         # sorts identically whatever the arrival interleaving (keyed items;
         # unkeyed test payloads fall back to arrival order)
@@ -74,11 +88,13 @@ class Batcher:
         max_items: int = 50_000,
         max_depth: int = 100_000,
         monitor=None,
+        gang_ttl_seconds: float = 30.0,
     ):
         self.idle_seconds = idle_seconds
         self.max_seconds = max_seconds
         self.max_items = max_items
         self.max_depth = max_depth
+        self.gang_ttl_seconds = gang_ttl_seconds
         self._monitor_obj = monitor
         # shard label for intake metrics ("" = unsharded: emit the legacy
         # unlabeled series so existing exact-label-tuple lookups hold; the
@@ -101,6 +117,10 @@ class Batcher:
         # pods age out via the TTL sweep
         self._first_seen: Dict[Any, Tuple[float, float]] = {}
         self._next_first_seen_sweep = 0.0
+        # gang → monotonic time its hold started (first member seen while
+        # the group was incomplete). Cleared when the gang is released into
+        # a window (hold histogram observed) or TTL-shed.
+        self._gang_first: Dict[Any, float] = {}
         # monotonic counters for synchronizers (tests/expectations.py):
         # added_total — items ADMITTED; consumed_total — items a wait()
         # window has picked up; processed_total — items whose window has
@@ -150,14 +170,21 @@ class Batcher:
 
     # -- intake --------------------------------------------------------------
     def add(self, item: Any, key: Any = None, band: str = "default",
-            priority: int = 0) -> Optional[threading.Event]:
+            priority: int = 0,
+            gang: Optional[Tuple[Any, int]] = None
+            ) -> Optional[threading.Event]:
         """Enqueue an item; returns the gate event the caller may wait on
         (batcher.go:61-69), or **None when the item was shed** (pressure
         level refused its band, or the depth bound is hit). ``key``
         (optional) registers the item for :meth:`contains` until its window
         is consumed. The key is registered BEFORE the item becomes
         consumable so a concurrent wait() can never observe the item yet
-        miss the key (which would strand it forever)."""
+        miss the key (which would strand it forever). ``gang`` —
+        (gang key, declared size) — marks the item as a gang member: the
+        window assembly holds the whole group back until ``size`` distinct
+        members are queued, and sheds the partial group after
+        ``gang_ttl_seconds`` (reason ``gang-expired``, keys released so the
+        selection requeue re-offers the members band-aware)."""
         monitor = self._monitor()
         level = int(monitor.level())
         now = time.monotonic()
@@ -186,7 +213,9 @@ class Batcher:
                 depth = len(self._entries)
             else:
                 entry = _Entry(self._seq, item, key, band, rank, priority,
-                               first_seen)
+                               first_seen,
+                               gang=gang[0] if gang else None,
+                               gang_size=gang[1] if gang else 0)
                 self._seq += 1
                 self._entries.append(entry)
                 if key is not None:
@@ -250,6 +279,79 @@ class Batcher:
 
             get_monitor().forget_source(id(self))
 
+    # -- gang hold (all-or-nothing groups) -----------------------------------
+    def _gang_gate_locked(self, now: float) -> set:
+        """Seqs of gang members to hold OUT of this window because their
+        group is incomplete. Partial groups past ``gang_ttl_seconds`` (and
+        groups that can never fit one window) are shed here instead:
+        entries leave the queue, keys release IMMEDIATELY so the selection
+        requeue re-offers every member band-aware — never a silent drop —
+        and first_seen persists so aging keeps accruing across the shed."""
+        held: set = set()
+        groups: Dict[Any, List[_Entry]] = {}
+        for e in self._entries:
+            if e.gang is not None:
+                groups.setdefault(e.gang, []).append(e)
+        if not groups:
+            return held
+        for gkey, members in groups.items():
+            distinct = {m.key if m.key is not None else m.seq
+                        for m in members}
+            size = max(m.gang_size for m in members)
+            if len(distinct) >= size and size <= self.max_items:
+                continue  # complete: enters this window
+            reason = None
+            if size > self.max_items:
+                reason = "gang-oversize"
+            first = self._gang_first.setdefault(gkey, now)
+            if reason is None and now - first > self.gang_ttl_seconds:
+                reason = "gang-expired"
+            if reason is None:
+                held.update(m.seq for m in members)
+                continue
+            shed_seqs = {m.seq for m in members}
+            self._entries = [e for e in self._entries
+                             if e.seq not in shed_seqs]
+            for m in members:
+                if m.key is not None:
+                    self._pending_keys.discard(m.key)
+                self._count_shed_locked(reason, m.band)
+            self._gang_first.pop(gkey, None)
+            GANGS_UNPLACEABLE_TOTAL.inc(
+                reason="oversize" if reason == "gang-oversize"
+                else "expired")
+        return held
+
+    def _trim_split_gangs(self, take: List[_Entry]) -> List[_Entry]:
+        """Never split a gang at the item cap: members whose group the cap
+        cut in half stay queued (the group is still complete, so a
+        following window carries it whole)."""
+        in_take: Dict[Any, set] = {}
+        size_of: Dict[Any, int] = {}
+        for e in take:
+            if e.gang is not None:
+                in_take.setdefault(e.gang, set()).add(
+                    e.key if e.key is not None else e.seq)
+                size_of[e.gang] = max(size_of.get(e.gang, 0), e.gang_size)
+        cut = {g for g, ks in in_take.items() if len(ks) < size_of[g]}
+        if not cut:
+            return take
+        return [e for e in take if e.gang not in cut]
+
+    def _note_gangs_released_locked(self, take: List[_Entry],
+                                    now: float) -> None:
+        """Observe hold time for every gang this window carries and stop
+        its TTL clock."""
+        done: set = set()
+        for e in take:
+            if e.gang is None or e.gang in done:
+                continue
+            done.add(e.gang)
+            first = self._gang_first.pop(e.gang, None)
+            if first is None:
+                first = e.first_seen
+            GANG_HOLD_SECONDS.observe(max(0.0, now - first))
+
     # -- window assembly -----------------------------------------------------
     @staticmethod
     def _sort_key(entry: _Entry, now: float, aging_step: float):
@@ -285,9 +387,16 @@ class Batcher:
                     break  # idle window expired with no new arrivals
             now = time.monotonic()
             step = self._aging_step(monitor)
-            ordered = sorted(self._entries,
+            # gang gate: a partial gang never enters a window. Incomplete
+            # groups hold; groups past the TTL (or larger than a window)
+            # shed here through the band-aware requeue path.
+            held = self._gang_gate_locked(now)
+            ordered = sorted((e for e in self._entries if e.seq not in held),
                              key=lambda e: self._sort_key(e, now, step))
             take = ordered[:self.max_items]
+            if len(take) < len(ordered):
+                take = self._trim_split_gangs(take)
+            self._note_gangs_released_locked(take, now)
             if len(take) < len(self._entries):
                 taken_seqs = {e.seq for e in take}
                 self._entries = [e for e in self._entries

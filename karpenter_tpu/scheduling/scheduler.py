@@ -11,10 +11,11 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from karpenter_tpu.api.constraints import Constraints
 from karpenter_tpu.api.core import Pod
+from karpenter_tpu.api.gang import GangSpec, gang_of
 from karpenter_tpu.api.provisioner import Provisioner
 from karpenter_tpu.metrics.filter import FILTER_BATCH_SECONDS
 from karpenter_tpu.ops import feasibility
@@ -30,10 +31,13 @@ log = logging.getLogger("karpenter.scheduler")
 @dataclass
 class Schedule:
     """Equivalently-schedulable pods + their tightened constraints
-    (scheduler.go:53-57)."""
+    (scheduler.go:53-57). ``gang`` is set when the group is an
+    all-or-nothing pod group — the gang spec is folded into the group key,
+    so a gang schedule holds exactly its members and nothing else."""
 
     constraints: Constraints
     pods: List[Pod] = field(default_factory=list)
+    gang: Optional[GangSpec] = None
 
 
 def _constraints_key(c: Constraints, gpu_requests) -> tuple:
@@ -70,8 +74,20 @@ class Scheduler:
         schedules: Dict[tuple, Schedule] = {}
         skipped = 0
         topo_skipped = 0
+        gang_skipped = 0
         samples: List[str] = []
         for pod in pods:
+            gspec = gang_of(pod)
+            if gspec is not None and gspec.error:
+                # malformed gang labels never enter a solve window — the
+                # pod sheds back through the band-aware requeue path
+                skipped += 1
+                gang_skipped += 1
+                pod.__dict__["_gang_unsat"] = gspec.error
+                if len(samples) < 5:
+                    samples.append(f"{pod.metadata.namespace}/"
+                                   f"{pod.metadata.name}: {gspec.error}")
+                continue
             if engine is not None:
                 err, tightened, key = engine.schedule_entry(pod)
             else:
@@ -88,16 +104,37 @@ class Scheduler:
                     samples.append(f"{pod.metadata.namespace}/"
                                    f"{pod.metadata.name}: {err}")
                 continue
+            if gspec is not None:
+                # fold the gang identity into the group key: a gang
+                # schedule holds exactly its members, so the co-pack
+                # window sees whole gangs and nothing else
+                key = key + (gspec.group_part,)
             schedule = schedules.get(key)
             if schedule is None:
                 schedule = schedules[key] = Schedule(
-                    constraints=tightened, pods=[])
+                    constraints=tightened, pods=[], gang=gspec)
             schedule.pods.append(pod)
+        # a gang schedule that lost members to validation above is partial;
+        # all-or-nothing means the survivors shed with the group rather
+        # than entering a solve window alone
+        for key in [k for k, s in schedules.items()
+                    if s.gang is not None and len(s.pods) != s.gang.size]:
+            s = schedules.pop(key)
+            skipped += len(s.pods)
+            gang_skipped += len(s.pods)
+            for pod in s.pods:
+                pod.__dict__["_gang_unsat"] = (
+                    f"gang {s.gang.namespace}/{s.gang.name} incomplete in "
+                    f"window ({len(s.pods)}/{s.gang.size} members)")
+            if len(samples) < 5:
+                samples.append(f"gang {s.gang.namespace}/{s.gang.name}: "
+                               f"{len(s.pods)}/{s.gang.size} members")
         if skipped:
             log.info("unable to schedule %d/%d pod(s) in window "
-                     "(reason=topology: %d, other: %d): %s",
-                     skipped, len(pods), topo_skipped,
-                     skipped - topo_skipped, "; ".join(samples))
+                     "(reason=topology: %d, reason=gang: %d, other: %d): %s",
+                     skipped, len(pods), topo_skipped, gang_skipped,
+                     skipped - topo_skipped - gang_skipped,
+                     "; ".join(samples))
         FILTER_BATCH_SECONDS.observe(time.perf_counter() - t0,
                                      stage="schedule")
         return list(schedules.values())

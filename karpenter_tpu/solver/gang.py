@@ -1,0 +1,226 @@
+"""Batched gang co-pack solves: a window of G gangs, one device kernel.
+
+Mirror of the batched what-if engine (solver/whatif.py) for the
+provisioning side: the dispatch half marshals the encoded window
+(ops/gang.py) onto the device through the process DeviceRing without
+blocking, the fetch half materializes under the device watchdog / circuit
+breaker, and any failure anywhere falls through to the exact host mirror —
+a gang window never stalls the hot loop on a sick transport.
+
+The kernel is vmap-over-gangs of a first-fit scan over members: every gang
+sub-solve sees a PRIVATE copy of the shared prospective-node pool (vmap's
+functional semantics are the rollback — an unplaceable gang cannot disturb
+a neighbor), reserves via masked writes, and reports all-members-placed or
+unplaceable. The device verdict is a FILTER: plan selection walks the
+window in priority order and re-verifies every accepted gang on exact host
+nano ints against the running pool (ops/gang.verify_and_commit_gang)
+before anything binds — zero unverified placements, by construction.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from functools import lru_cache
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.obs import trace as obtrace
+from karpenter_tpu.ops.gang import (
+    EncodedGang, GangEncoding, host_gang, verify_and_commit_gang)
+from karpenter_tpu.solver import solve as solve_module
+from karpenter_tpu.solver.solve import record_executor
+
+log = logging.getLogger("karpenter.solver.gang")
+
+
+@dataclass
+class GangConfig:
+    use_device: bool = True
+    # below this many padded cells (GB*KB*BB) the jit compile outweighs the
+    # solve — tiny test windows stay on the exact host mirror
+    device_min_cells: int = 1 << 14
+    device_timeout_s: float = 120.0
+    device_breaker_seconds: float = 120.0
+
+
+@lru_cache(maxsize=32)
+def _gang_jit(gb: int, kb: int, bb: int):
+    """One executable per (gangs, members, bins) padded bucket: vmap over
+    the gang axis of a first-fit scan over the member axis. All int32."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(pvecs, pvalid, gcompat, free0):
+        def step(free, xs):
+            vec, ok_pod = xs
+            fits = jnp.all(free >= vec[None, :], axis=1) & gcompat
+            can = fits.any()
+            b = jnp.argmax(fits).astype(jnp.int32)
+            placed = can & ok_pod
+            free = free.at[b].add(-jnp.where(placed, vec, 0))
+            return free, (jnp.where(placed, b, jnp.int32(-1)), can | ~ok_pod)
+
+        _, (slots, oks) = jax.lax.scan(step, free0, (pvecs, pvalid))
+        return jnp.all(oks), slots
+
+    def kernel(pods, valid, compat, free0):
+        return jax.vmap(one, in_axes=(0, 0, 0, None))(
+            pods, valid, compat, free0)
+
+    return jax.jit(kernel)
+
+
+@dataclass
+class GangHandle:
+    """In-flight half of a gang window solve; ``fetch()`` blocks (under the
+    watchdog when on device) and is idempotent."""
+
+    enc: GangEncoding
+    config: GangConfig
+    _out: Optional[tuple] = None
+    _slot: Optional[object] = None
+    _ring: Optional[object] = None
+    _result: Optional[Tuple[np.ndarray, np.ndarray, str]] = None
+    _trace_ctx: Optional[object] = None
+    dispatch_seconds: float = 0.0
+
+    def fetch(self) -> Tuple[np.ndarray, np.ndarray, str]:
+        """(feasible (G,), slots (G,K), executor). Device failure or a
+        tripped breaker falls through to the exact host mirror."""
+        if self._result is not None:
+            return self._result
+        with obtrace.use_context(self._trace_ctx), \
+                obtrace.span("gang-fetch", gangs=self.enc.g):
+            self._result = self._fetch()
+        return self._result
+
+    def _fetch(self) -> Tuple[np.ndarray, np.ndarray, str]:
+        feas = slots = None
+        executor = "host-gang"
+        if self._out is not None:
+            try:
+                def _materialize():
+                    f, s = self._out
+                    return np.asarray(f), np.asarray(s)
+
+                if self.config.device_timeout_s > 0:
+                    feas, slots = solve_module._WATCHDOG.run(
+                        _materialize, self.config.device_timeout_s,
+                        self.config.device_breaker_seconds)
+                else:
+                    feas, slots = _materialize()
+                feas = feas[:self.enc.g]
+                slots = slots[:self.enc.g, :max(self.enc.k, 1)]
+                executor = "device-gang"
+            except Exception:
+                log.exception("device gang fetch failed; host mirror fallback")
+                feas = slots = None
+            finally:
+                if self._ring is not None and self._slot is not None:
+                    self._ring.release(self._slot)
+                    self._slot = None
+        if feas is None:
+            feas, slots = host_gang(self.enc)
+        record_executor(executor, count=max(self.enc.g, 1))
+        return (feas, slots, executor)
+
+
+def dispatch_gang_window(enc: GangEncoding,
+                         config: Optional[GangConfig] = None) -> GangHandle:
+    """Marshal the window to the device and launch WITHOUT blocking.
+    Buffers cycle through the process DeviceRing keyed by the padded
+    bucket signature — steady-state gang windows refill pinned device
+    memory in place instead of allocating."""
+    config = config or GangConfig()
+    handle = GangHandle(enc=enc, config=config,
+                        _trace_ctx=obtrace.current_context())
+    if (not config.use_device or not enc.device_ready
+            or enc.cells < config.device_min_cells
+            or solve_module._WATCHDOG.tripped()):
+        return handle
+    t0 = time.perf_counter()
+    try:
+        from karpenter_tpu.parallel.mesh import (
+            batch_sharding, replicated, solver_mesh)
+        from karpenter_tpu.solver.pipeline import DeviceRing, get_ring
+
+        mesh = solver_mesh()
+        gb = enc.d_pods.shape[0]
+        gang_sh = batch_sharding(mesh) if gb % mesh.devices.size == 0 \
+            else replicated(mesh)
+        rep = replicated(mesh)
+        host = {"gg_pods": enc.d_pods, "gg_valid": enc.d_valid,
+                "gg_compat": enc.d_compat, "gg_free0": enc.d_free0}
+        ring = get_ring()
+        slot = ring.acquire(DeviceRing.signature(host))
+        dev = {}
+        for name, arr in host.items():
+            sharding = rep if name == "gg_free0" else gang_sh
+            dev[name] = ring.fill(slot, name, arr, sharding)
+        fn = _gang_jit(enc.d_pods.shape[0], enc.d_pods.shape[1],
+                       enc.d_compat.shape[1])
+        handle._out = fn(dev["gg_pods"], dev["gg_valid"],
+                         dev["gg_compat"], dev["gg_free0"])
+        handle._slot, handle._ring = slot, ring
+    except Exception:
+        log.exception("device gang dispatch failed; host mirror fallback")
+        handle._out = handle._slot = handle._ring = None
+    handle.dispatch_seconds = time.perf_counter() - t0
+    obtrace.add_span("gang-dispatch", t0, time.perf_counter(), gangs=enc.g)
+    return handle
+
+
+def solve_gang_window(enc: GangEncoding,
+                      config: Optional[GangConfig] = None
+                      ) -> Tuple[np.ndarray, np.ndarray, str]:
+    """dispatch + fetch in one call (bench and tests)."""
+    return dispatch_gang_window(enc, config).fetch()
+
+
+@dataclass
+class GangPlacement:
+    """One verified gang: member pods grouped by receiving bin."""
+
+    gang: EncodedGang
+    node_sets: List[Tuple[int, List[Any]]]  # (bin index, member pods)
+
+
+@dataclass
+class GangPlan:
+    placements: List[GangPlacement] = field(default_factory=list)
+    unplaced: List[Tuple[EncodedGang, str]] = field(default_factory=list)
+    verified: int = 0  # gangs re-verified on host nano ints
+
+
+def plan_gang_window(enc: GangEncoding,
+                     feasible: Optional[np.ndarray] = None) -> GangPlan:
+    """Greedy window-priority-order plan. ``feasible`` is the device (or
+    host-mirror) filter; None runs the pure per-gang sequential host loop —
+    the bench baseline. Either way every accepted gang is re-verified and
+    committed on exact host ints against the running pool, so the two modes
+    are node-for-node identical by construction: the filter only lets the
+    planner SKIP verification of gangs that cannot place (free capacity
+    shrinks monotonically, so full-pool-infeasible implies
+    running-pool-infeasible)."""
+    plan = GangPlan()
+    if enc.g == 0:
+        return plan
+    free_state = [list(bn.free) for bn in enc.bins]
+    for e in enc.gangs:
+        if feasible is not None and not feasible[e.index]:
+            plan.unplaced.append((e, "infeasible"))
+            continue
+        slots = verify_and_commit_gang(enc, e.index, free_state)
+        plan.verified += 1
+        if slots is None:
+            plan.unplaced.append((e, "capacity"))
+            continue
+        by_bin: dict = {}
+        for pod, bi in zip(e.pods, slots):
+            by_bin.setdefault(bi, []).append(pod)
+        plan.placements.append(GangPlacement(
+            gang=e, node_sets=sorted(by_bin.items())))
+    return plan

@@ -1,0 +1,555 @@
+"""Gang (all-or-nothing pod group) scheduling, round 11.
+
+Pins the gang contract end to end:
+
+- label parsing + slice-shape algebra (api/gang.py);
+- the group feasibility column against the scalar per-member oracle,
+  fuzzed (ops/feasibility.gang_feasibility_mask vs gang_scalar_mask);
+- batcher hold/TTL/no-split semantics — a partial gang never enters a
+  solve window (scheduling/batcher.py);
+- scheduler gang grouping + the ``reason=gang`` summary bucket;
+- co-pack kernel parity: host mirror == device kernel, and the device
+  verdict used as a filter produces the node-for-node identical plan to
+  the pure sequential host loop (ops/gang.py, solver/gang.py);
+- the atomic bind invariant under chaos, seeds 1/7/42: a watchdog trip
+  mid-fetch loses and duplicates nothing (host mirror answers), a
+  mid-bind fleet failure unwinds the whole gang (zero members bound).
+"""
+
+import os
+import random
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.gang import (
+    GangSpec, gang_of, instance_slice_shape, parse_slice_shape, slice_fits,
+)
+from karpenter_tpu.chaos import inject
+from karpenter_tpu.cloudprovider.fake.provider import (
+    FakeCloudProvider, instance_types, make_instance_type,
+)
+from karpenter_tpu.cloudprovider.spi import Offering
+from karpenter_tpu.controllers.provisioning import (
+    ProvisioningController, universe_constraints,
+)
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.metrics.gang import (
+    GANGS_PLACED_TOTAL, GANGS_UNPLACEABLE_TOTAL,
+)
+from karpenter_tpu.ops import feasibility
+from karpenter_tpu.ops.gang import encode_gang_window, host_gang
+from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.scheduling.batcher import Batcher
+from karpenter_tpu.solver.gang import (
+    GangConfig, plan_gang_window, solve_gang_window,
+)
+from karpenter_tpu.utils import resources as res
+from tests.expectations import (
+    expect_not_scheduled, expect_provisioned, expect_scheduled,
+    make_provisioner, unschedulable_pod,
+)
+
+ZONES = ["test-zone-1", "test-zone-2", "test-zone-3"]
+
+
+def _count(metric, **labels) -> float:
+    return metric.collect().get(tuple(sorted(labels.items())), 0.0)
+
+
+def gang_pod(gname: str, size: int, i: int, requests=None, slice_=None,
+             size_label=None):
+    pod = unschedulable_pod(
+        requests=requests or {"cpu": "2", "memory": "1Gi"},
+        name=f"{gname}-m{i}")
+    pod.metadata.labels[wellknown.POD_GROUP_LABEL] = gname
+    pod.metadata.labels[wellknown.POD_GROUP_SIZE_LABEL] = (
+        size_label if size_label is not None else str(size))
+    if slice_ is not None:
+        pod.metadata.labels[wellknown.POD_GROUP_SLICE_LABEL] = slice_
+    return pod
+
+
+class TestSliceAlgebra:
+    def test_parse_valid(self):
+        s = parse_slice_shape("v5e-4x4")
+        assert s.family == "v5e" and s.dims == (4, 4) and s.chips == 16
+        s = parse_slice_shape("v4-2x2x4")
+        assert s.family == "v4" and s.dims == (2, 2, 4) and s.chips == 16
+        assert str(parse_slice_shape("v5p-8x16")) == "v5p-8x16"
+
+    def test_parse_invalid(self):
+        for bad in ("", "v5e", "v5e-", "4x4", "v5e-4x0", "v5e-4x-4",
+                    "V5E-4x4", "v5e-4x4x"):
+            assert parse_slice_shape(bad) is None, bad
+
+    def test_slice_fits_containment(self):
+        v4x8 = parse_slice_shape("v5e-4x8")
+        v4x4 = parse_slice_shape("v5e-4x4")
+        assert slice_fits(v4x8, v4x4)
+        assert not slice_fits(parse_slice_shape("v5e-2x2"), v4x4)
+        # family mismatch never fits, regardless of grid
+        assert not slice_fits(parse_slice_shape("v4-4x8"), v4x4)
+        # shorter grid pads with 1s: a (4,4) request fits a (4,4,2) host
+        assert slice_fits(parse_slice_shape("v5e-4x4x2"), v4x4)
+        assert not slice_fits(None, v4x4)
+
+    def test_instance_slice_shape_cached(self):
+        it = make_instance_type("tpu-host", tpu_topology="v5e-4x8")
+        s = instance_slice_shape(it)
+        assert s.dims == (4, 8)
+        assert instance_slice_shape(it) is s  # cached on the instance
+        assert instance_slice_shape(make_instance_type("plain")) is None
+
+
+class TestGangLabelContract:
+    def test_plain_pod_is_not_a_gang(self):
+        assert gang_of(unschedulable_pod()) is None
+
+    def test_valid_spec_and_group_part(self):
+        pod = gang_pod("trainer", 4, 0, slice_="v5e-4x4")
+        spec = gang_of(pod)
+        assert spec.error is None
+        assert spec.key == ("default", "trainer") and spec.size == 4
+        assert spec.slice_.dims == (4, 4)
+        assert spec.group_part == ("gang", "default", "trainer", 4, "v5e-4x4")
+        assert gang_of(pod) is spec  # cached on the pod
+
+    def test_malformed_size_sets_error_not_singleton(self):
+        for bad in ("zero?", "", "0", "-3", "999999"):
+            spec = gang_of(gang_pod("g", 2, 0, size_label=bad))
+            assert spec is not None and spec.error, bad
+
+    def test_malformed_slice_sets_error(self):
+        spec = gang_of(gang_pod("g", 2, 0, slice_="not a shape"))
+        assert spec is not None and spec.error
+
+    def test_disagreeing_members_land_in_distinct_groups(self):
+        a = gang_of(gang_pod("g", 2, 0))
+        b = gang_of(gang_pod("g", 3, 1))
+        assert a.error is None and b.error is None
+        assert a.key == b.key and a.group_part != b.group_part
+
+
+class TestGangFeasibilityFuzz:
+    """The columnar group mask must reproduce the scalar per-member oracle
+    exactly — not via the self-heal path (gang-mismatch fallbacks stay 0)."""
+
+    def test_columnar_matches_scalar_oracle(self):
+        feasibility.clear_catalog_caches()
+        mismatch0 = _count(feasibility.FILTER_FALLBACK_TOTAL,
+                           reason="gang-mismatch")
+        rng = random.Random(20260805)
+        cases = int(os.environ.get("KARPENTER_FUZZ_CASES", "500"))
+        topos = ["", "", "v5e-4x4", "v5e-4x8", "v5e-2x2", "v4-2x2x4",
+                 "v4-4x4x8"]
+        slices = [None, "v5e-4x4", "v5e-2x2", "v5e-8x8", "v4-2x2x2",
+                  "v5p-4x4"]
+        for case in range(cases):
+            cat = []
+            for i in range(rng.randint(1, 8)):
+                offerings = [
+                    Offering(ct, z)
+                    for ct in rng.sample(["on-demand", "spot"],
+                                         rng.randint(1, 2))
+                    for z in rng.sample(ZONES, rng.randint(1, 3))]
+                cat.append(make_instance_type(
+                    name=f"fuzz-{case}-{i}",
+                    offerings=offerings,
+                    architecture=rng.choice(["amd64", "arm64"]),
+                    operating_systems=frozenset(rng.sample(
+                        ["linux", "windows", "darwin"], rng.randint(1, 3))),
+                    nvidia_gpus=rng.choice(["0", "0", "2"]),
+                    amd_gpus=rng.choice(["0", "0", "1"]),
+                    aws_neurons=rng.choice(["0", "0", "4"]),
+                    aws_pod_eni=rng.choice(["0", "1"]),
+                    tpu_topology=rng.choice(topos)))
+            names = [it.name for it in cat]
+            keys = []
+            for _ in range(rng.randint(1, 4)):
+                allowed = (
+                    frozenset(rng.sample(["on-demand", "spot"],
+                                         rng.randint(1, 2))),
+                    frozenset(rng.sample(ZONES, rng.randint(1, 3))),
+                    frozenset(rng.sample(names, rng.randint(1, len(names)))),
+                    frozenset(rng.sample(["amd64", "arm64"],
+                                         rng.randint(1, 2))),
+                    frozenset(rng.sample(["linux", "windows", "darwin"],
+                                         rng.randint(1, 3))),
+                )
+                required = frozenset(rng.sample(
+                    [res.NVIDIA_GPU, res.AMD_GPU, res.AWS_NEURON,
+                     res.AWS_POD_ENI], rng.randint(0, 2)))
+                keys.append((allowed, required))
+            shape_text = rng.choice(slices)
+            shape = parse_slice_shape(shape_text) if shape_text else None
+            got = feasibility.gang_feasibility_mask(cat, keys, shape)
+            want = feasibility.gang_scalar_mask(cat, keys, shape)
+            assert np.array_equal(got, want), (
+                f"case {case}: columnar {got.tolist()} != "
+                f"scalar {want.tolist()}")
+        assert _count(feasibility.FILTER_FALLBACK_TOTAL,
+                      reason="gang-mismatch") == mismatch0
+
+    def test_mask_is_cached_per_signature(self):
+        feasibility.clear_catalog_caches()
+        cat = instance_types(4)
+        keys = [((frozenset(["on-demand"]), frozenset(ZONES),
+                  frozenset(it.name for it in cat), frozenset(["amd64"]),
+                  frozenset(["linux"])), frozenset())]
+        a = feasibility.gang_feasibility_mask(cat, keys, None)
+        b = feasibility.gang_feasibility_mask(cat, list(keys), None)
+        assert a is b and not a.flags.writeable
+
+
+class TestBatcherGangHold:
+    def test_incomplete_gang_held_out_of_window(self):
+        b = Batcher(idle_seconds=0.02, max_seconds=0.2)
+        try:
+            g = (("default", "g"), 3)
+            b.add("m0", key="m0", gang=g)
+            b.add("m1", key="m1", gang=g)
+            b.add("solo", key="solo")
+            items, _ = b.wait()
+            assert items == ["solo"]
+            assert b.depth() == 2  # members still queued, not dropped
+            assert b.contains("m0") and b.contains("m1")
+        finally:
+            b.stop()
+
+    def test_complete_gang_released_whole(self):
+        b = Batcher(idle_seconds=0.02, max_seconds=0.2)
+        try:
+            g = (("default", "g"), 3)
+            for i in range(3):
+                b.add(f"m{i}", key=f"m{i}", gang=g)
+            items, _ = b.wait()
+            assert sorted(items) == ["m0", "m1", "m2"]
+            assert b.depth() == 0
+        finally:
+            b.stop()
+
+    def test_expired_partial_gang_shed_through_requeue_path(self):
+        shed0 = _count(GANGS_UNPLACEABLE_TOTAL, reason="expired")
+        b = Batcher(idle_seconds=0.02, max_seconds=0.2,
+                    gang_ttl_seconds=0.05)
+        try:
+            g = (("default", "g"), 3)
+            b.add("m0", key="m0", gang=g)
+            b.add("m1", key="m1", gang=g)
+            items, _ = b.wait()  # first gate: holds, starts the TTL clock
+            assert items == []
+            time.sleep(0.1)
+            b.add("solo", key="solo")
+            items, _ = b.wait()
+            assert items == ["solo"]
+            # shed whole: entries gone, keys released so the selection
+            # requeue re-offers the members band-aware — never silent
+            assert b.depth() == 0
+            assert not b.contains("m0") and not b.contains("m1")
+            assert b.shed_total() >= 2
+            assert _count(GANGS_UNPLACEABLE_TOTAL,
+                          reason="expired") == shed0 + 1
+        finally:
+            b.stop()
+
+    def test_oversize_gang_shed_immediately(self):
+        shed0 = _count(GANGS_UNPLACEABLE_TOTAL, reason="oversize")
+        b = Batcher(idle_seconds=0.02, max_seconds=0.2, max_items=2)
+        try:
+            b.add("m0", key="m0", gang=(("default", "big"), 3))
+            b.add("solo", key="solo")
+            items, _ = b.wait()
+            assert items == ["solo"]
+            assert not b.contains("m0")
+            assert _count(GANGS_UNPLACEABLE_TOTAL,
+                          reason="oversize") == shed0 + 1
+        finally:
+            b.stop()
+
+    def test_item_cap_never_splits_a_gang(self):
+        b = Batcher(idle_seconds=0.02, max_seconds=0.2, max_items=2)
+        try:
+            g = (("default", "g"), 2)
+            b.add("solo", key="solo", priority=10)
+            b.add("m0", key="m0", gang=g)
+            b.add("m1", key="m1", gang=g)
+            items, _ = b.wait()
+            # the cap would cut the gang in half — it stays queued whole
+            assert items == ["solo"]
+            assert b.depth() == 2
+            items, _ = b.wait()
+            assert sorted(items) == ["m0", "m1"]
+        finally:
+            b.stop()
+
+
+class TestSchedulerGangGrouping:
+    def _constraints(self):
+        catalog = instance_types(4)
+        return universe_constraints(catalog)
+
+    def test_gang_folds_into_group_key(self):
+        from karpenter_tpu.scheduling.scheduler import Scheduler
+
+        pods = [gang_pod("trainer", 2, i) for i in range(2)]
+        pods.append(unschedulable_pod(
+            requests={"cpu": "2", "memory": "1Gi"}, name="solo"))
+        schedules = Scheduler(KubeCore())._get_schedules(
+            self._constraints(), pods)
+        gangs = [s for s in schedules if s.gang is not None]
+        assert len(schedules) == 2 and len(gangs) == 1
+        assert {p.metadata.name for p in gangs[0].pods} == {
+            "trainer-m0", "trainer-m1"}
+        assert isinstance(gangs[0].gang, GangSpec)
+
+    def test_malformed_declaration_refused_reason_gang(self, caplog):
+        import logging
+
+        from karpenter_tpu.scheduling.scheduler import Scheduler
+
+        pods = [unschedulable_pod(name="ok"),
+                gang_pod("g", 2, 0, size_label="wat")]
+        with caplog.at_level(logging.INFO, logger="karpenter.scheduler"):
+            schedules = Scheduler(KubeCore())._get_schedules(
+                self._constraints(), pods)
+        assert sum(len(s.pods) for s in schedules) == 1
+        records = [r for r in caplog.records
+                   if "unable to schedule" in r.getMessage()]
+        assert len(records) == 1 and "reason=gang: 1" in records[0].getMessage()
+        assert pods[1].__dict__.get("_gang_unsat")
+
+    def test_partial_gang_dropped_whole_before_solve(self, caplog):
+        import logging
+
+        from karpenter_tpu.scheduling.scheduler import Scheduler
+
+        pods = [gang_pod("g", 3, i) for i in range(2)]  # 2 of 3 members
+        with caplog.at_level(logging.INFO, logger="karpenter.scheduler"):
+            schedules = Scheduler(KubeCore())._get_schedules(
+                self._constraints(), pods)
+        assert not schedules  # the partial gang never enters a window
+        message = [r for r in caplog.records
+                   if "unable to schedule" in r.getMessage()][0].getMessage()
+        assert "reason=gang: 2" in message
+        for p in pods:
+            assert "incomplete in window" in p.__dict__["_gang_unsat"]
+
+
+def _encode_window(rng, catalog, n_gangs):
+    """A random gang window over the real packable path (the same frees
+    production uses: type total minus overhead+daemon reserve)."""
+    from karpenter_tpu.solver.adapter import build_packables
+
+    cpus = ["250m", "500m", "1", "2"]
+    mems = ["256Mi", "512Mi", "1Gi"]
+    gangs = []
+    all_pods = []
+    for gi in range(n_gangs):
+        size = rng.randint(1, 5)
+        pods = [unschedulable_pod(
+            requests={"cpu": rng.choice(cpus), "memory": rng.choice(mems)},
+            name=f"enc-g{gi}-m{m}") for m in range(size)]
+        all_pods.extend(pods)
+        gangs.append(pods)
+    constraints = universe_constraints(catalog)
+    packables, sorted_types = build_packables(
+        catalog, constraints, all_pods, ())
+    frees = [[t - r for t, r in zip(pk.total, pk.reserved)]
+             for pk in packables]
+    prices = [it.price for it in sorted_types]
+    names = [it.name for it in sorted_types]
+    window = []
+    for gi, pods in enumerate(gangs):
+        mask = np.zeros(len(sorted_types), bool)
+        # random feasibility stripe, never empty
+        for t in range(len(sorted_types)):
+            mask[t] = rng.random() < 0.8
+        mask[rng.randrange(len(sorted_types))] = True
+        window.append(((f"g{gi}",), pods, mask, gi))
+    return encode_gang_window(window, frees, prices, names)
+
+
+class TestCopackKernelParity:
+    """Device kernel == host mirror, and the filtered plan == the pure
+    sequential host plan, node for node — the two halves of the
+    device-is-a-filter contract (docs/solver.md §15)."""
+
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_host_mirror_and_plan_parity(self, seed):
+        rng = random.Random(seed)
+        catalog = instance_types(6)
+        enc = _encode_window(rng, catalog, n_gangs=8)
+        assert enc.g == 8 and enc.device_ready
+        feas_h, slots_h = host_gang(enc)
+        feas_d, slots_d, executor = solve_gang_window(
+            enc, GangConfig(device_min_cells=1))
+        assert executor == "device-gang"
+        assert np.array_equal(feas_h, feas_d)
+        assert np.array_equal(slots_h, slots_d)
+        # the verdict as a filter: node-for-node identical to the pure
+        # sequential host loop
+        plan_f = plan_gang_window(enc, feas_d)
+        plan_s = plan_gang_window(enc, None)
+
+        def sig(plan):
+            return [(pl.gang.index, pl.node_sets) for pl in plan.placements]
+
+        assert sig(plan_f) == sig(plan_s)
+        # every placement re-verified on host nano ints before bind
+        assert plan_f.verified >= len(plan_f.placements)
+        # the filter only skips verification work, never changes reasons
+        # for gangs the device already proved infeasible on the full pool
+        assert {e.index for e, _ in plan_f.unplaced} == {
+            e.index for e, _ in plan_s.unplaced}
+
+    def test_skipped_gangs_never_enter_tensors(self):
+        catalog = instance_types(4)
+        pods = [unschedulable_pod(requests={"cpu": "2", "memory": "1Gi"},
+                                  name="sk-m0")]
+        frees, prices, names = [], [], []
+        from karpenter_tpu.solver.adapter import build_packables
+        packables, sorted_types = build_packables(
+            catalog, universe_constraints(catalog), pods, ())
+        frees = [[t - r for t, r in zip(pk.total, pk.reserved)]
+                 for pk in packables]
+        prices = [it.price for it in sorted_types]
+        names = [it.name for it in sorted_types]
+        empty_mask = np.zeros(len(sorted_types), bool)
+        full_mask = np.ones(len(sorted_types), bool)
+        enc = encode_gang_window(
+            [(("dead",), pods, empty_mask, None),
+             (("live",), pods, full_mask, None)],
+            frees, prices, names)
+        assert enc.g == 1 and enc.gangs[0].key == ("live",)
+        assert enc.skipped == [(("dead",), "no feasible instance type")]
+
+
+def _harness(batcher_idle=0.05):
+    kube = KubeCore()
+    provider = FakeCloudProvider(catalog=instance_types(10))
+    provisioning = ProvisioningController(
+        kube, provider,
+        batcher_factory=lambda: Batcher(idle_seconds=batcher_idle,
+                                        max_seconds=2.0))
+    selection = SelectionController(kube, provisioning, gate_timeout=30.0)
+    p = make_provisioner()
+    kube.create(p)
+    provisioning.reconcile(p.metadata.name)
+    return kube, provider, provisioning, selection, p
+
+
+def _stop(provisioning):
+    for w in provisioning.workers.values():
+        w.stop()
+
+
+def _reoffer(kube, selection, provisioning, pods, timeout=15.0):
+    """Re-offer already-created pods and wait for the window to flush
+    (the tail half of expectations.expect_provisioned)."""
+    for p in pods:
+        selection.reconcile(p.metadata.name, p.metadata.namespace)
+    deadline = time.monotonic() + timeout
+    for name, worker in provisioning.workers.items():
+        b = worker.batcher
+        target = b.added_total
+        while b.processed_total < target:
+            remaining = deadline - time.monotonic()
+            assert remaining > 0, f"provisioner {name}: window never flushed"
+            with b._lock:
+                gate = b._gate
+                if b.processed_total >= target:
+                    break
+            gate.wait(timeout=min(remaining, 0.5))
+
+
+class TestAtomicBindE2E:
+    def test_gang_and_solos_bind_through_the_full_path(self):
+        placed0 = _count(GANGS_PLACED_TOTAL)
+        kube, provider, provisioning, selection, _ = _harness()
+        try:
+            pods = [gang_pod("trainer", 4, i) for i in range(4)]
+            solos = [unschedulable_pod(name=f"solo-{i}") for i in range(3)]
+            expect_provisioned(kube, selection, provisioning, pods + solos)
+            for pod in pods + solos:
+                expect_scheduled(kube, pod)
+            assert _count(GANGS_PLACED_TOTAL) == placed0 + 1
+        finally:
+            _stop(provisioning)
+
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_watchdog_trip_mid_fetch_loses_nothing(self, seed, monkeypatch):
+        """The window dispatches to the device; the injected watchdog trip
+        hits the fetch; the exact host mirror answers and every member
+        still binds — nothing lost, nothing duplicated."""
+        from karpenter_tpu.solver import solve as solve_mod
+
+        wd = solve_mod._DeviceWatchdog()
+        monkeypatch.setattr(solve_mod, "_WATCHDOG", wd)
+        placed0 = _count(GANGS_PLACED_TOTAL)
+        kube, provider, provisioning, selection, p = _harness()
+        worker = provisioning.workers[p.metadata.name]
+        worker.gang_config = GangConfig(
+            device_min_cells=1, device_timeout_s=5.0,
+            device_breaker_seconds=60.0)
+        plan = inject.FaultPlan(seed, [
+            inject.FaultSpec("device", "solve", "watchdog-trip", 1)],
+            window=1)
+        inject.install(plan)
+        try:
+            pods = [gang_pod("chaos-gang", 4, i) for i in range(4)]
+            expect_provisioned(kube, selection, provisioning, pods)
+            nodes = [expect_scheduled(kube, pod) for pod in pods]
+        finally:
+            inject.uninstall()
+            _stop(provisioning)
+        assert plan.fired_counts() == {
+            ("device", "solve", "watchdog-trip"): 1}
+        assert wd.tripped(), "injected trip did not open the breaker"
+        # all four bound (nothing lost), each exactly once (nothing
+        # duplicated): four distinct pods report a node, and every node
+        # carries only this gang's members
+        assert len(nodes) == 4
+        for n in set(nodes):
+            on_node = kube.list("Pod", field=("spec.nodeName", n))
+            assert {q.metadata.name for q in on_node} <= {
+                pod.metadata.name for pod in pods}
+        assert _count(GANGS_PLACED_TOTAL) == placed0 + 1
+
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_mid_bind_fleet_failure_unwinds_whole_gang(self, seed):
+        """One node create ICEs mid-fleet: the whole gang unwinds — zero
+        members bound, created nodes released through the termination
+        finalizer — and a clean retry binds all of it."""
+        failed0 = _count(GANGS_UNPLACEABLE_TOTAL, reason="bind-failed")
+        placed0 = _count(GANGS_PLACED_TOTAL)
+        kube, provider, provisioning, selection, _ = _harness()
+        plan = inject.FaultPlan(seed, [
+            inject.FaultSpec("provider", "create", "ice", 1)], window=2)
+        inject.install(plan)
+        try:
+            pods = [gang_pod("ice-gang", 4, i) for i in range(4)]
+            expect_provisioned(kube, selection, provisioning, pods)
+            assert plan.fired_counts() == {("provider", "create", "ice"): 1}
+            # all-or-nothing held: ZERO members bound
+            for pod in pods:
+                expect_not_scheduled(kube, pod)
+            assert _count(GANGS_UNPLACEABLE_TOTAL,
+                          reason="bind-failed") == failed0 + 1
+            # nodes created before the ICE are on their way out through
+            # the termination finalizer, and none carries a bound pod
+            for node in kube.list("Node"):
+                assert node.metadata.deletion_timestamp is not None
+                assert not kube.list(
+                    "Pod", field=("spec.nodeName", node.metadata.name))
+            inject.uninstall()
+            # clean retry: the same pods re-offer and the gang binds whole
+            _reoffer(kube, selection, provisioning, pods)
+            for pod in pods:
+                expect_scheduled(kube, pod)
+            assert _count(GANGS_PLACED_TOTAL) == placed0 + 1
+        finally:
+            inject.uninstall()
+            _stop(provisioning)

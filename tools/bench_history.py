@@ -52,6 +52,18 @@ def _marshal_cell(extra: dict) -> str:
             f"{cfg.get('fresh_catalog_transfers', '?')}xfer{frac_s}")
 
 
+def _gang_cell(extra: dict) -> str:
+    """Compressed gang co-pack column (config_11, round 11+): speedup,
+    parity (verdict AND node), placed/total gangs — '6.6x/par/256'.
+    '!par' flags a parity break; '-' when the config never ran."""
+    cfg = extra.get("config_11_gang_copack")
+    if not isinstance(cfg, dict) or "speedup" not in cfg:
+        return "-"
+    par = "par" if (cfg.get("verdict_parity") and cfg.get("node_parity")) \
+        else "!par"
+    return f"{cfg['speedup']}x/{par}/{cfg.get('placed_gangs', '?')}"
+
+
 def _from_tail(tail: str):
     """Best-effort recovery of the bench JSON line from a captured stdout
     tail: parse from the LAST '{"metric"' occurrence (the line is emitted
@@ -97,7 +109,7 @@ def load_rows(root: str) -> list:
                     "metric": f"(tail truncated, rc={line.get('rc')})",
                     "value": None, "unit": "", "device_count": None,
                     "backend": "?", "degraded": None, "configs": "-",
-                    "marshal": "-"})
+                    "marshal": "-", "gang": "-"})
                 continue
             line = inner
         extra = line.get("extra", {}) if isinstance(line, dict) else {}
@@ -112,6 +124,7 @@ def load_rows(root: str) -> list:
             "degraded": extra.get("degraded"),
             "configs": _config_ids(extra),
             "marshal": _marshal_cell(extra),
+            "gang": _gang_cell(extra),
         })
     for b in bad:
         print(f"bench-history: skipped {b}", file=sys.stderr)
@@ -121,7 +134,8 @@ def load_rows(root: str) -> list:
 
 def render(rows: list) -> str:
     headers = ["round", "variant", "metric", "value", "unit",
-               "device_count", "backend", "degraded", "configs", "marshal"]
+               "device_count", "backend", "degraded", "configs", "marshal",
+               "gang"]
     table = [headers] + [
         ["" if r[h] is None else str(r[h]) for h in headers] for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(headers))]

@@ -1,0 +1,78 @@
+"""Gang co-pack verdict: one human-readable line from the bench JSON.
+
+`make bench-gang` pipes bench.py (``--only config_11``) through this
+filter. The bench line passes through UNCHANGED on stdout (so
+`> BENCH_rNN.json` redirects still capture the pure JSON); the verdict
+goes to stderr:
+
+    gang co-pack: 256 gangs / 768 members, one batched solve \
+(device-gang) 6.5x vs per-gang host loop, verdict_parity=True, \
+node_parity=True, 256 placed (0 unverified) — PASS
+
+PASS needs (the round-11 acceptance gate):
+- >= 256 gangs solved in ONE batched device dispatch;
+- batched solve >= 5x the per-gang sequential host loop (p50);
+- exact parity: identical (feasible, slots) verdicts AND node-for-node
+  identical plans between the two legs;
+- zero unverified placements — every gang that binds was re-verified on
+  exact host nano ints against the running pool (the device verdict is
+  a filter, never a commit).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+GATE_GANGS = 256
+GATE_SPEEDUP = 5.0
+
+
+def verdict(line: dict) -> str:
+    extra = line.get("extra", {})
+    cfg = extra.get("config_11_gang_copack", {})
+    if "error" in cfg or "gangs" not in cfg:
+        return ("gang co-pack: no config_11_gang_copack in bench line "
+                f"({cfg.get('error', 'config_11 not run')}) — NO VERDICT")
+    gangs = cfg.get("gangs", 0)
+    speedup = cfg.get("speedup")
+    vparity = cfg.get("verdict_parity")
+    nparity = cfg.get("node_parity")
+    unverified = cfg.get("unverified_placements")
+    head = (f"gang co-pack: {gangs} gangs / {cfg.get('members')} members, "
+            f"one batched solve ({cfg.get('executor')}) {speedup}x vs "
+            f"per-gang host loop, verdict_parity={vparity}, "
+            f"node_parity={nparity}, {cfg.get('placed_gangs')} placed "
+            f"({unverified} unverified)")
+    ok = (gangs >= GATE_GANGS
+          and speedup is not None and speedup >= GATE_SPEEDUP
+          and vparity is True and nparity is True and unverified == 0)
+    return (f"{head} — {'PASS' if ok else 'FAIL'} "
+            f"(gate >={GATE_GANGS} gangs, >={GATE_SPEEDUP}x, parity, "
+            "0 unverified)")
+
+
+def main() -> int:
+    last = None
+    for raw in sys.stdin:
+        sys.stdout.write(raw)  # pass-through: stdout stays the pure JSON
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            line = json.loads(raw)
+            if isinstance(line, dict) and "metric" in line:
+                last = line
+        except ValueError:
+            continue
+    sys.stdout.flush()
+    if last is None:
+        print("gang co-pack: no bench JSON line on stdin — NO VERDICT",
+              file=sys.stderr)
+        return 1
+    print(verdict(last), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

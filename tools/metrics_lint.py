@@ -35,6 +35,7 @@ REGISTERING_MODULES = [
     "karpenter_tpu.metrics.pipeline",
     "karpenter_tpu.metrics.pressure",
     "karpenter_tpu.metrics.filter",
+    "karpenter_tpu.metrics.gang",
     "karpenter_tpu.metrics.marshal",
     "karpenter_tpu.solver.solve",
     "karpenter_tpu.solver.hedge",

@@ -13,6 +13,9 @@ PASS needs (the round-9 acceptance gates):
   ladder released) with >= 99% of the configured pods actually offered;
 - ZERO system-critical sheds across the whole replay;
 - recovery to L0 after the flood (recovery_to_l0_s present);
+- ZERO partial gangs when the run injected gang workloads
+  (gang_fraction > 0): every all-or-nothing pod group either bound
+  whole or stayed wholly Pending;
 - store list-by-kind scan speedup >= 5x vs the naive store at the
   A/B leg's object count (absent A/B leg → gate N/A, labelled).
 """
@@ -48,9 +51,14 @@ def verdict(line: dict) -> str:
     recovery = replay.get("recovery_to_l0_s")
     lat = (replay.get("pending_to_bound_s") or {}).get("default") or {}
     scan_x = (ab or {}).get("scan_speedup")
+    gangs = replay.get("gangs") or {}
+    gang_cell = (f"{gangs.get('gangs_fully_bound')}/"
+                 f"{gangs.get('offered_gangs')}"
+                 if gangs.get("offered_gangs") else "n/a")
     head = (f"replay: {offered} pods / {cfg.get('shards')} shards "
             f"peak=L{replay.get('peak_level')} crit_shed={crit_shed} "
             f"recovery={recovery}s default_p99={lat.get('p99')}s "
+            f"gangs={gang_cell} "
             f"store_scan={scan_x if scan_x is not None else 'n/a'}x")
     problems = []
     if not replay.get("completed"):
@@ -63,6 +71,9 @@ def verdict(line: dict) -> str:
         problems.append(f"{crit_shed} system-critical sheds")
     if recovery is None:
         problems.append("never recovered to L0")
+    if gangs.get("offered_gangs") and gangs.get("partial_gangs", 0) != 0:
+        problems.append(f"{gangs['partial_gangs']} partial gang(s) — "
+                        "all-or-nothing invariant broken")
     if ab is None:
         return f"{head} — store GATE N/A (A/B leg not run); replay " + \
             ("PASS" if not problems else f"FAIL ({'; '.join(problems)})")
