@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-replay bench-replay-smoke bench-history replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-overload clean help
+.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-filter bench-replay bench-replay-smoke bench-history replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-overload clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -38,6 +38,10 @@ bench-marshal: ## Steady-state window replay, cold vs delta marshal+encode A/B (
 bench-gang: ## Batched gang co-pack window, one device solve vs per-gang host loop (config_11); prints verdict line on stderr
 	python bench.py --only config_11 \
 		| python tools/gang_verdict.py
+
+bench-filter: ## Device-resident fused feasibility, bit-plane window filter vs host columnar A/B (config_12); prints verdict line on stderr
+	python bench.py --only config_12 \
+		| python tools/filter_verdict.py
 
 bench-replay: ## Million-pod replay across 4 shards + 100k-object store A/B (config_9); verdict + traceview table on stderr
 	python bench.py --only config_9 \

@@ -1003,6 +1003,164 @@ def config_11_gang_copack():
     }
 
 
+def config_12_device_filter():
+    """Round-12 gate: device-resident fused feasibility (docs/solver.md
+    §16). The filter stage of a 24-schedule window over a 400-type catalog
+    is timed two ways, cycling 192 distinct constraint variants (more than
+    the host mask cache holds — every host iteration pays the columnar
+    build, the way a live control plane rotating tenants does):
+
+    - leg A, host columnar: one catalog_feasibility_mask + packables build
+      per schedule (what the pre-§16 solve path pays per window);
+    - leg B, device fused: ONE bit-plane program for the whole window
+      (ops/device_filter.compute_mask) + the shared universe packables
+      (cached; built once per catalog) — the planes never re-cross PCIe
+      (token-aware ring slots), only the tiny row stack does.
+
+    Verdict parity is asserted per variant (device mask vs the host
+    columnar mask, bit for bit), and a full 10k-pod solve_batch runs
+    filter-on vs filter-off for node parity. Ring counters prove the
+    steady-state residency claim: plane reuses move during the timed loop,
+    fresh device allocations do not. `make bench-filter` gates >= 2x via
+    tools/filter_verdict.py."""
+    import numpy as _np
+
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.metrics.filter import (
+        FILTER_DEVICE_FALLBACK_TOTAL, FILTER_PLANE_RING_REUSES_TOTAL,
+    )
+    from karpenter_tpu.ops import device_filter, feasibility
+    from karpenter_tpu.solver import adapter
+    from karpenter_tpu.solver.batch_solve import Problem, solve_batch
+    from karpenter_tpu.solver.pipeline import get_ring
+    from karpenter_tpu.solver.solve import SolverConfig
+    from karpenter_tpu.utils import resources as res
+
+    if not device_filter.enabled():
+        return {"skipped": "KARPENTER_DEVICE_FILTER=0"}
+
+    T, S, VARIANTS = 400, 24, 192
+    catalog = make_catalog(T)
+    constraints = universe_constraints(catalog)
+    base = adapter._allowed_sets(constraints)
+    cts = sorted(base[0])
+    zones = sorted(base[1])
+    names = sorted(base[2]) if base[2] else sorted(it.name for it in catalog)
+
+    # 192 distinct (allowed, required) keys (v mod lcm(4,3,50)=300 is
+    # injective below 192): rotate capacity type, drop one zone, drop a
+    # rotating prefix of type names, sprinkle an ENI requirement
+    pairs_ring = []
+    for v in range(VARIANTS):
+        allowed = (
+            frozenset(cts if v % 4 else cts[:1]),
+            frozenset(z for j, z in enumerate(zones) if j != v % len(zones)),
+            frozenset(names[(v * 7) % 50:]),
+            base[3], base[4],
+        )
+        required = (frozenset([res.AWS_POD_ENI]) if v % 16 == 15
+                    else frozenset())
+        pairs_ring.append((allowed, required))
+    n_windows = VARIANTS // S
+    windows = [pairs_ring[w * S:(w + 1) * S] for w in range(n_windows)]
+
+    # verdict parity, every variant: the device bit-plane mask must equal
+    # the host columnar mask bit for bit (this also warms planes/rows/jit)
+    divergence = 0
+    for w in windows:
+        mask_d = device_filter.compute_mask(catalog, w)
+        assert mask_d is not None, "catalog not device-indexable"
+        for s, (allowed, required) in enumerate(w):
+            mask_h = feasibility.catalog_feasibility_mask(
+                catalog, allowed, required)
+            divergence += int(_np.sum(mask_d[s] != mask_h))
+
+    # full-solve node parity: 10k pods over S zone-rotated schedules,
+    # fused filter on vs kill switch off
+    from karpenter_tpu.api.core import NodeSelectorRequirement as _Req
+    from karpenter_tpu.api import wellknown as _wk
+
+    per = 10_000 // S
+    problems = []
+    for b in range(S):
+        tightened = constraints.deepcopy()
+        tightened.requirements = tightened.requirements.add(_Req(
+            key=_wk.LABEL_TOPOLOGY_ZONE, operator="In",
+            values=[f"bench-zone-{1 + b % 3}"]))
+        pods = make_pods(per, MIXED_SHAPES[b % len(MIXED_SHAPES):]
+                         + MIXED_SHAPES[:b % len(MIXED_SHAPES)])
+        for j, p in enumerate(pods):
+            p.metadata.name = f"f{b}-{j}"
+        problems.append(Problem(constraints=tightened, pods=pods,
+                                instance_types=catalog))
+    cfg = SolverConfig(device_min_pods=1)
+    fb_before = dict(FILTER_DEVICE_FALLBACK_TOTAL.collect())
+    prev = os.environ.get("KARPENTER_DEVICE_FILTER")
+    try:
+        os.environ["KARPENTER_DEVICE_FILTER"] = "1"
+        on = solve_batch(problems, cfg)
+        os.environ["KARPENTER_DEVICE_FILTER"] = "0"
+        off = solve_batch(problems, cfg)
+    finally:
+        if prev is None:
+            os.environ.pop("KARPENTER_DEVICE_FILTER", None)
+        else:
+            os.environ["KARPENTER_DEVICE_FILTER"] = prev
+
+    def nodes(rs):
+        return [sum(p.node_quantity for p in r.packings) for r in rs]
+
+    nodes_on, nodes_off = nodes(on), nodes(off)
+    node_parity = nodes_on == nodes_off
+    fb_after = dict(FILTER_DEVICE_FALLBACK_TOTAL.collect())
+    fallbacks = {dict(k).get("reason", "?"): fb_after[k] - fb_before.get(k, 0)
+                 for k in fb_after
+                 if fb_after[k] - fb_before.get(k, 0.0) > 0}
+
+    # the timed filter-stage A/B, cycling windows so the host caches
+    # (mask cap 128 < 192 variants) keep missing while the device side
+    # hits its planes/rows interning
+    state_h, state_d = {"i": 0}, {"i": 0}
+
+    def host_leg():
+        w = windows[state_h["i"] % n_windows]
+        state_h["i"] += 1
+        for allowed, required in w:
+            adapter._build_packables_from(catalog, allowed, (), required)
+
+    def device_leg():
+        w = windows[state_d["i"] % n_windows]
+        state_d["i"] += 1
+        assert device_filter.compute_mask(catalog, w) is not None
+        adapter.build_universe_packables(catalog)
+
+    host_leg()
+    device_leg()  # warmup both once more post-solve
+    ring = get_ring()
+    reuses0 = FILTER_PLANE_RING_REUSES_TOTAL.collect().get((), 0.0)
+    allocs0 = ring.allocations
+    host_times = run_timed(host_leg, budget_s=30.0)
+    device_times = run_timed(device_leg, budget_s=15.0)
+    st_host = _stats(host_times)
+    st_device = _stats(device_times)
+    speedup = round(st_host["p50_ms"] / (st_device["p50_ms"] or 1e-9), 2)
+    return {
+        "pods": per * S, "types": T, "schedules_per_window": S,
+        "variants": VARIANTS,
+        "host_p50_ms": st_host["p50_ms"], "host_p99_ms": st_host["p99_ms"],
+        "device_p50_ms": st_device["p50_ms"],
+        "device_p99_ms": st_device["p99_ms"],
+        "speedup": speedup,
+        "verdict_divergence": int(divergence),
+        "node_parity": bool(node_parity),
+        "nodes": int(sum(nodes_on)),
+        "plane_ring_reuses": FILTER_PLANE_RING_REUSES_TOTAL.collect().get(
+            (), 0.0) - reuses0,
+        "steady_allocations": ring.allocations - allocs0,
+        "device_fallbacks": fallbacks,
+    }
+
+
 def jax_devices_first():
     import jax
 
@@ -1393,6 +1551,7 @@ def run_all(degraded: bool, probe_note: str = ""):
         ("config_9_million_pod_replay", config_9_million_pod_replay),
         ("config_10_marshal_delta", config_10_marshal_delta),
         ("config_11_gang_copack", config_11_gang_copack),
+        ("config_12_device_filter", config_12_device_filter),
     ):
         if not _selected(key, only):
             continue

@@ -24,3 +24,16 @@ FILTER_FALLBACK_TOTAL = DEFAULT.counter(
 FILTER_INTERN_TABLE_SIZE = DEFAULT.gauge(
     "filter_intern_table_size",
     "Interned label values held by the feasibility engine's vocab table")
+
+# -- device-resident fused filter (ops/device_filter.py, round 12) ----------
+FILTER_DEVICE_SECONDS = DEFAULT.histogram(
+    "filter_device_seconds",
+    "Device-resident fused feasibility filter time "
+    "(stage=dispatch|verify|gang)")
+FILTER_DEVICE_FALLBACK_TOTAL = DEFAULT.counter(
+    "filter_device_fallback_total",
+    "Device-filter retreats to the host columnar / scalar path, by reason")
+FILTER_PLANE_RING_REUSES_TOTAL = DEFAULT.counter(
+    "filter_plane_ring_reuses_total",
+    "Catalog bit-plane ring fills skipped because the slot already held "
+    "this catalog's planes (content-token match: zero transfer)")

@@ -502,15 +502,22 @@ def _decode(
     dropped: np.ndarray,
     packables: Sequence[Packable],
     max_instance_types: int,
+    options_fn=None,
 ) -> HostSolveResult:
     """Materialize packings: map per-shape counts back to pod ids and dedupe
-    by instance-option set (the hash dedupe in packer.go:130-139)."""
+    by instance-option set (the hash dedupe in packer.go:130-139).
+
+    ``options_fn`` (same signature as :func:`instance_options`) lets the
+    device-filter fused path substitute its feasibility-aware option walk
+    over the universe type axis (ops/device_filter.py); it may raise to
+    reject the decode — the caller self-heals to the host path."""
     queues = [list(p) for p in enc.shape_pods]
     heads = [0] * len(queues)
     packings: List[HostPacking] = []
     by_options = {}
     for chosen, qty, packedv in records:
-        options = instance_options(packables, chosen, max_instance_types)
+        options = (options_fn or instance_options)(
+            packables, chosen, max_instance_types)
         key = tuple(options)
         # iterate only the shapes this record touches: at high cardinality
         # (tens of thousands of shapes) a per-record full-S Python loop

@@ -1,0 +1,694 @@
+"""Device-resident fused feasibility: the pods×types bitset mask on device.
+
+The PR 3 columnar filter (ops/feasibility.py) answers "which instance
+types can this schedule use?" with numpy AND-reduces computed on host,
+once per (catalog, allowed, required) key; the batched solver then ships
+the resulting ``valid`` rows to the device every window. This module moves
+the whole question onto the device and fuses the answer straight into the
+FFD pack kernel:
+
+- **Catalog bit-planes** (:class:`Planes`): the per-key value vocab of one
+  instance-type list interned as persistent uint32 bit-planes — one-hot
+  name/arch words ``(T, W)``, multi-bit OS words, and a per-capacity-type
+  zone bitmask ``(T, C, W_z)`` for the non-separable (capacity type, zone)
+  offering product. Planes are cached by the catalog feasibility token
+  (the PR 3 identity) and ride a token-aware ``DeviceRing`` slot
+  (solver/pipeline.py): a steady-state window re-fills by token match —
+  zero transfer, zero fresh device allocation, counted on
+  ``filter_plane_ring_reuses_total``.
+- **Schedule rows**: each schedule's ``(allowed, required)`` key encodes to
+  a handful of uint32 allowed-bitmask words (``allowed=None`` encodes to
+  an all-zero row — Go's ``sets.Has(nil)`` rejection, exactly like the
+  scalar oracle). Rows are tiny, cached per (planes, key), and flow to the
+  device through the same ring slot the planes live in.
+- **One pjit per window** (:func:`_window_jit`): computes the whole
+  pods×types mask as an AND-reduce of ``pod_allowed_word &
+  type_value_bit`` across requirement keys, batched over every schedule in
+  the window, plus ``last_valid`` and small probe outputs. The ``(B, T)``
+  mask is emitted with the batch sharding the pack kernel expects and is
+  handed to ``pack_batch_sharded_*`` as its ``valid`` input directly — it
+  is never materialized on host and never crosses PCIe.
+
+The device verdict stays a FILTER in the repo's idiom: every window's mask
+is spot-checked against the scalar oracle (``adapter._validate``) on a
+sampled set of type columns (the full row for small catalogs), every
+kernel-chosen type is re-validated at decode, and any divergence sends
+that problem back to the host columnar path — scalar wins, counted on
+``filter_fallback_total{reason="device-mask-mismatch"}`` and
+``filter_device_fallback_total``. ``KARPENTER_DEVICE_FILTER=0`` is the
+kill switch; the legacy ``KARPENTER_FEASIBILITY_BACKEND=jax`` toggle
+(whose host-side leg this module replaces) aliases to ON. The host
+columnar path is preserved unchanged as the differential reference and
+the CPU/fallback leg.
+
+Type-axis contract (docs/solver.md §16): fused problems encode against
+the **universe packables** (adapter.build_universe_packables) — the whole
+catalog with overhead/daemons reserved, sorted by the stable
+``(cpu, memory)`` key. On every fused-eligible feasible subset (at least
+one GPU class uniformly zero — guaranteed unless all three classes are
+required, which is excluded below) this order restricted to the feasible
+types equals the host comparator's order, so masking the universe axis IS
+the host path's sorted feasible axis and decode indices agree by
+construction.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.metrics.filter import (
+    FILTER_DEVICE_FALLBACK_TOTAL, FILTER_DEVICE_SECONDS,
+    FILTER_FALLBACK_TOTAL, FILTER_PLANE_RING_REUSES_TOTAL,
+)
+from karpenter_tpu.utils import resources as res
+
+_ENV = "KARPENTER_DEVICE_FILTER"
+_LEGACY_ENV = "KARPENTER_FEASIBILITY_BACKEND"
+
+# special-resource bit layout (planes.special / row.req words) — bit order
+# is adapter._SPECIAL_RESOURCES: ENI, then the GPU classes, which are
+# exclusive both ways (packable.go:205-219)
+_ENI_BIT = np.uint32(1)
+_GPU_MASK = np.uint32(0b1110)
+_GPU_CLASSES = (res.NVIDIA_GPU, res.AMD_GPU, res.AWS_NEURON)
+
+_MAX_CT_VOCAB = 32       # ct bits live in ONE uint32 row word
+_PROBE_K = 32            # sampled columns per window (full row when T <= K)
+
+_LOCK = threading.Lock()
+_PLANES_CACHE: dict = {}           # catalog token tuple -> Planes|_FAILED
+_PLANES_CACHE_CAP = 8
+_FAILED = object()
+_ROW_CACHE: dict = {}              # (planes key, allowed, required) -> row
+_ROW_CACHE_CAP = 1024
+_window_counter = itertools.count(1)
+
+
+def enabled() -> bool:
+    """Kill switch / opt-in resolution. ``KARPENTER_DEVICE_FILTER`` wins
+    (0/false/off disables, 1/true/on enables); the legacy
+    ``KARPENTER_FEASIBILITY_BACKEND=jax`` toggle aliases to ON; default is
+    ON (the verdict is a filter — every divergence self-heals to scalar)."""
+    v = os.environ.get(_ENV, "").strip().lower()
+    if v in ("0", "false", "off"):
+        return False
+    if v in ("1", "true", "on"):
+        return True
+    if os.environ.get(_LEGACY_ENV, "").strip().lower() == "jax":
+        return True
+    return True
+
+
+def _words(nbits: int) -> int:
+    return max(1, -(-nbits // 32))
+
+
+class Planes:
+    """Persistent uint32 bit-planes of one instance-type list (type axis
+    padded to the encoder's TYPE_BUCKETS so the mask aligns with the padded
+    encoding's type axis). Padding rows are all-zero, which the mask algebra
+    rejects — a padded type column is never valid."""
+
+    __slots__ = ("key", "n", "TB", "name_vocab", "arch_vocab", "os_vocab",
+                 "ct_vocab", "zone_vocab", "name_plane", "arch_plane",
+                 "os_plane", "offer_plane", "special")
+
+    def host_arrays(self) -> Dict[str, np.ndarray]:
+        return {"name_plane": self.name_plane, "arch_plane": self.arch_plane,
+                "os_plane": self.os_plane, "offer_plane": self.offer_plane,
+                "special": self.special}
+
+
+def _build_planes(instance_types, key: tuple) -> Optional[Planes]:
+    from karpenter_tpu.ops.encode import TYPE_BUCKETS, bucket
+
+    n = len(instance_types)
+    TB = bucket(max(n, 1), TYPE_BUCKETS)
+    if TB is None:
+        return None  # beyond the largest device type bucket
+    p = Planes()
+    p.key, p.n, p.TB = key, n, TB
+    p.name_vocab = {}
+    p.arch_vocab = {}
+    p.os_vocab = {}
+    p.ct_vocab = {}
+    p.zone_vocab = {}
+    # first pass: vocabs (so word counts are known before the planes)
+    for it in instance_types:
+        p.name_vocab.setdefault(it.name, len(p.name_vocab))
+        p.arch_vocab.setdefault(it.architecture, len(p.arch_vocab))
+        for os_name in it.operating_systems:
+            p.os_vocab.setdefault(os_name, len(p.os_vocab))
+        for o in it.offerings:
+            p.ct_vocab.setdefault(o.capacity_type, len(p.ct_vocab))
+            p.zone_vocab.setdefault(o.zone, len(p.zone_vocab))
+    if len(p.ct_vocab) > _MAX_CT_VOCAB:
+        return None  # ct bits must fit one row word
+    wn, wa = _words(len(p.name_vocab)), _words(len(p.arch_vocab))
+    wo, wz = _words(len(p.os_vocab)), _words(len(p.zone_vocab))
+    C = max(1, len(p.ct_vocab))
+    p.name_plane = np.zeros((TB, wn), np.uint32)
+    p.arch_plane = np.zeros((TB, wa), np.uint32)
+    p.os_plane = np.zeros((TB, wo), np.uint32)
+    p.offer_plane = np.zeros((TB, C, wz), np.uint32)
+    p.special = np.zeros((TB,), np.uint32)
+    for t, it in enumerate(instance_types):
+        b = p.name_vocab[it.name]
+        p.name_plane[t, b // 32] |= np.uint32(1 << (b % 32))
+        b = p.arch_vocab[it.architecture]
+        p.arch_plane[t, b // 32] |= np.uint32(1 << (b % 32))
+        for os_name in it.operating_systems:
+            b = p.os_vocab[os_name]
+            p.os_plane[t, b // 32] |= np.uint32(1 << (b % 32))
+        for o in it.offerings:
+            c = p.ct_vocab[o.capacity_type]
+            b = p.zone_vocab[o.zone]
+            p.offer_plane[t, c, b // 32] |= np.uint32(1 << (b % 32))
+        sp = 0
+        if not it.aws_pod_eni.is_zero():
+            sp |= 1
+        for i, (name, qty) in enumerate(
+                ((res.NVIDIA_GPU, it.nvidia_gpus), (res.AMD_GPU, it.amd_gpus),
+                 (res.AWS_NEURON, it.aws_neurons))):
+            if not qty.is_zero():
+                sp |= 1 << (1 + i)
+        p.special[t] = sp
+    for arr in p.host_arrays().values():
+        arr.flags.writeable = False
+    return p
+
+
+def planes_for(instance_types) -> Optional[Planes]:
+    """Planes for this catalog identity (the PR 3 feasibility token),
+    cached. None = not device-indexable (counted); the caller falls back to
+    the host columnar path."""
+    from karpenter_tpu.ops.feasibility import _catalog_token
+
+    key = tuple(_catalog_token(it) for it in instance_types)
+    with _LOCK:
+        hit = _PLANES_CACHE.get(key)
+    if hit is _FAILED:
+        return None
+    if hit is not None:
+        return hit
+    planes = _build_planes(instance_types, key)
+    if planes is None:
+        FILTER_DEVICE_FALLBACK_TOTAL.inc(reason="ct-vocab-overflow")
+    with _LOCK:
+        if len(_PLANES_CACHE) >= _PLANES_CACHE_CAP:
+            _PLANES_CACHE.pop(next(iter(_PLANES_CACHE)))
+        _PLANES_CACHE[key] = planes if planes is not None else _FAILED
+    return planes
+
+
+def _bits_row(vocab: Dict[str, int], allowed, nwords: int) -> np.ndarray:
+    """Allowed-set bitmask words over a plane vocab. ``None`` → all-zero
+    (rejects everything — the scalar oracle's Go sets.Has(nil) contract);
+    out-of-vocab values contribute nothing (they can't match any type)."""
+    row = np.zeros((nwords,), np.uint32)
+    if allowed:
+        for v in allowed:
+            b = vocab.get(v)
+            if b is not None:
+                row[b // 32] |= np.uint32(1 << (b % 32))
+    return row
+
+
+def schedule_row(planes: Planes, allowed: tuple, required: frozenset) -> tuple:
+    """One schedule's device row: per-axis allowed bitmask words + the
+    required special-resource bits. Cached per (planes, allowed, required)
+    — the pod-side analog of the delta-marshal arena: constraint churn
+    re-encodes a few words, never the planes."""
+    key = (planes.key, allowed, required)
+    with _LOCK:
+        hit = _ROW_CACHE.get(key)
+        if hit is not None:
+            return hit
+    cts, zones, its, archs, oss = allowed
+    req = 0
+    if res.AWS_POD_ENI in required:
+        req |= 1
+    for i, name in enumerate(_GPU_CLASSES):
+        if name in required:
+            req |= 1 << (1 + i)
+    ct_bits = np.uint32(0)
+    if cts:
+        for v in cts:
+            b = planes.ct_vocab.get(v)
+            if b is not None:
+                ct_bits |= np.uint32(1 << b)
+    row = (
+        _bits_row(planes.name_vocab, its, planes.name_plane.shape[1]),
+        _bits_row(planes.arch_vocab, archs, planes.arch_plane.shape[1]),
+        _bits_row(planes.os_vocab, oss, planes.os_plane.shape[1]),
+        _bits_row(planes.zone_vocab, zones, planes.offer_plane.shape[2]),
+        ct_bits,
+        np.uint32(req),
+    )
+    with _LOCK:
+        if len(_ROW_CACHE) >= _ROW_CACHE_CAP:
+            _ROW_CACHE.pop(next(iter(_ROW_CACHE)))
+        _ROW_CACHE[key] = row
+    return row
+
+
+def _stack_rows(planes: Planes, rows: Sequence[tuple], Bpad: int):
+    """Stack per-schedule rows into (Bpad, W) arrays; padding rows are
+    all-zero (reject everything — a padded batch row packs nothing)."""
+    wn = planes.name_plane.shape[1]
+    wa = planes.arch_plane.shape[1]
+    wo = planes.os_plane.shape[1]
+    wz = planes.offer_plane.shape[2]
+    name_r = np.zeros((Bpad, wn), np.uint32)
+    arch_r = np.zeros((Bpad, wa), np.uint32)
+    os_r = np.zeros((Bpad, wo), np.uint32)
+    zone_r = np.zeros((Bpad, wz), np.uint32)
+    ct_r = np.zeros((Bpad,), np.uint32)
+    req_r = np.zeros((Bpad,), np.uint32)
+    for b, (nr, ar, osr, zr, ct, rq) in enumerate(rows):
+        name_r[b], arch_r[b], os_r[b], zone_r[b] = nr, ar, osr, zr
+        ct_r[b], req_r[b] = ct, rq
+    return name_r, arch_r, os_r, zone_r, ct_r, req_r
+
+
+def _mask_expr(jnp, name_p, arch_p, os_p, offer_p, special_p,
+               name_r, arch_r, os_r, zone_r, ct_r, req_r):
+    """The shared (B, T) mask algebra — one AND-reduce of
+    ``pod_allowed_word & type_value_bit`` per requirement key, plus the
+    offering product and the exclusive special-resource rule. Exactly the
+    scalar oracle (adapter._validate), fuzz-pinned in
+    tests/test_device_filter.py."""
+    def axis_ok(plane, row):  # (T, W) x (B, W) -> (B, T)
+        return ((plane[None, :, :] & row[:, None, :]) != 0).any(-1)
+
+    name_ok = axis_ok(name_p, name_r)
+    arch_ok = axis_ok(arch_p, arch_r)
+    os_ok = axis_ok(os_p, os_r)
+    # offerings: feasible iff SOME offering has (ct allowed AND zone
+    # allowed) — a per-(type, ct) zone bitmask keeps the product exact
+    # (any-ct AND any-zone would be wrong: the pair is not separable)
+    zc = ((offer_p[None, :, :, :] & zone_r[:, None, None, :]) != 0).any(-1)
+    C = offer_p.shape[1]
+    ct_bits = ((ct_r[:, None] >> jnp.arange(C, dtype=jnp.uint32)) &
+               jnp.uint32(1)).astype(bool)              # (B, C)
+    offer_ok = (zc & ct_bits[:, None, :]).any(-1)
+    req = req_r[:, None]                                 # (B, 1)
+    tb = special_p[None, :]                              # (1, T)
+    eni_ok = (req & jnp.uint32(1) & ~tb) == 0
+    gpu_ok = (req & jnp.uint32(14)) == (tb & jnp.uint32(14))
+    return name_ok & arch_ok & os_ok & offer_ok & eni_ok & gpu_ok
+
+
+@functools.lru_cache(maxsize=4)
+def _window_jit(mesh):
+    """The per-window fused-filter program: (B, T) mask + last_valid with
+    the pack kernel's batch sharding (consumed on device — the mask never
+    lands on host), plus the small probe outputs the fetch-side
+    verification reads (any-feasible per schedule, sampled mask columns)."""
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.parallel.mesh import batch_sharding, replicated
+
+    bs, rep = batch_sharding(mesh), replicated(mesh)
+
+    def body(name_p, arch_p, os_p, offer_p, special_p,
+             name_r, arch_r, os_r, zone_r, ct_r, req_r, probe_idx):
+        mask = _mask_expr(jnp, name_p, arch_p, os_p, offer_p, special_p,
+                          name_r, arch_r, os_r, zone_r, ct_r, req_r)
+        iota = jnp.arange(mask.shape[1], dtype=jnp.int32)
+        lv = jnp.max(jnp.where(mask, iota[None, :], -1), axis=1)
+        any_feas = lv >= 0
+        last_valid = jnp.maximum(lv, 0).astype(jnp.int32)
+        probe = jnp.take(mask, probe_idx, axis=1)
+        return mask, last_valid, any_feas, probe
+
+    return jax.jit(body,
+                   in_shardings=(rep,) * 5 + (bs,) * 6 + (rep,),
+                   out_shardings=(bs, bs, bs, bs))
+
+
+@functools.lru_cache(maxsize=4)
+def _rows_jit(mesh):
+    """Replicated small-batch variant (gang columns, tests, bench stage
+    timing): same algebra, no batch padding/sharding requirements."""
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.parallel.mesh import replicated
+
+    rep = replicated(mesh)
+
+    def body(name_p, arch_p, os_p, offer_p, special_p,
+             name_r, arch_r, os_r, zone_r, ct_r, req_r):
+        return _mask_expr(jnp, name_p, arch_p, os_p, offer_p, special_p,
+                          name_r, arch_r, os_r, zone_r, ct_r, req_r)
+
+    return jax.jit(body, in_shardings=(rep,) * 11, out_shardings=rep)
+
+
+class _PlanesResidency:
+    """Device residency of one Planes set (plus, for the fused path, the
+    window's row stack) on a token-aware DeviceRing slot. The slot is held
+    until :meth:`release` so an in-flight program can never see its buffers
+    donated away by a later refill; a steady-state window re-acquires the
+    same slot and every plane fill short-circuits on its content token
+    (``filter_plane_ring_reuses_total``)."""
+
+    def __init__(self, planes: Planes, mesh, rows_host=None):
+        from karpenter_tpu.parallel.mesh import batch_sharding, replicated
+        from karpenter_tpu.solver.pipeline import DeviceRing, get_ring
+
+        self._ring = get_ring()
+        host = dict(planes.host_arrays())
+        row_names = ("name_r", "arch_r", "os_r", "zone_r", "ct_r", "req_r")
+        if rows_host is not None:
+            host.update(zip(row_names, rows_host))
+        self._slot = self._ring.acquire(DeviceRing.signature(host))
+        try:
+            rep = replicated(mesh)
+            before = self._ring.reuses
+            self.planes_d = tuple(
+                self._ring.fill(self._slot, name, arr, rep,
+                                token=("planes", planes.key, name))
+                for name, arr in planes.host_arrays().items())
+            reused = self._ring.reuses - before
+            if reused:
+                FILTER_PLANE_RING_REUSES_TOTAL.inc(amount=float(reused))
+            self.rows_d = None
+            if rows_host is not None:
+                # every row array leads with the padded batch axis
+                bsh = batch_sharding(mesh)
+                self.rows_d = tuple(
+                    self._ring.fill(self._slot, name, arr, bsh)
+                    for name, arr in zip(row_names, rows_host))
+        except BaseException:
+            self.release()
+            raise
+
+    def release(self) -> None:
+        slot, self._slot = self._slot, None
+        if slot is not None:
+            self._ring.release(slot)
+
+
+def compute_mask(instance_types, pairs) -> Optional[np.ndarray]:
+    """Host-visible (S, T) device mask for ``pairs`` of (allowed, required)
+    keys — the differential surface tests and the gang column use (the
+    fused solve path never materializes its mask; this wrapper exists for
+    everything that wants the same verdicts ON host). None when the
+    catalog is not device-indexable or the device backend is unavailable."""
+    planes = planes_for(instance_types)
+    if planes is None:
+        return None
+    try:
+        from karpenter_tpu.parallel.mesh import solver_mesh
+
+        mesh = solver_mesh()
+        rows = [schedule_row(planes, allowed, required)
+                for allowed, required in pairs]
+        stacked = _stack_rows(planes, rows, max(1, len(rows)))
+        # ride the token-aware ring for the planes (a planes-only slot —
+        # distinct signature from the fused window slots): repeat calls on
+        # the same catalog skip the plane transfer entirely. The small row
+        # stack transfers per call (it varies per call anyway).
+        residency = _PlanesResidency(planes, mesh)
+        try:
+            out = _rows_jit(mesh)(*residency.planes_d, *stacked)
+            mask = np.asarray(out)[:len(rows), :planes.n]
+        finally:
+            # np.asarray above blocks until the program retires, so the
+            # plane buffers are safe to hand back for donation
+            residency.release()
+    except Exception:
+        FILTER_DEVICE_FALLBACK_TOTAL.inc(reason="jax-backend-unavailable")
+        FILTER_FALLBACK_TOTAL.inc(reason="jax-backend-unavailable")
+        return None
+    return mask
+
+
+def gang_member_column(instance_types, member_keys) -> Optional[np.ndarray]:
+    """The gang member-AND column ((T,) bool — every member's validators
+    accept the type) computed from the persistent catalog bit-planes in one
+    device call, instead of one host columnar mask per distinct member key.
+    None → the caller runs the host/scalar leg unchanged."""
+    if not enabled() or not member_keys:
+        return None
+    t0 = time.perf_counter()
+    mask = compute_mask(instance_types, member_keys)
+    if mask is None:
+        return None
+    FILTER_DEVICE_SECONDS.observe(time.perf_counter() - t0, stage="gang")
+    col = mask.all(axis=0)
+    col.flags.writeable = False
+    return col
+
+
+# --------------------------------------------------------------------------
+# The fused batched-solve path (solver/batch_solve.py)
+# --------------------------------------------------------------------------
+
+class FusedMismatch(Exception):
+    """Raised at decode when the kernel's chosen type fails the scalar
+    oracle — the device mask lied; the problem self-heals to the host path."""
+
+
+class FusedBatch:
+    """Everything the batched run needs to consume the device mask:
+    the mask/last_valid device arrays (batch-sharded, fed to the pack
+    kernel as ``valid``), the shared universe packables/types axis, and
+    the per-problem verification state (probe columns + scalar memo)."""
+
+    def __init__(self, batch_idx, encs, packables, uni_types, verify,
+                 mask_d, last_valid_d, any_d, probe_d, probe_idx,
+                 residency: _PlanesResidency):
+        self.batch_idx = list(batch_idx)
+        self.encs = list(encs)
+        self.packables = packables
+        self.uni_types = uni_types
+        self.verify = list(verify)         # [(allowed, required)] per member
+        self.mask_d = mask_d
+        self.last_valid_d = last_valid_d
+        self.any_d = any_d
+        self.probe_d = probe_d
+        self.probe_idx = probe_idx         # host np (K,) int32, deduped view
+        self._residency = residency
+        self._ok_memos: List[Optional[dict]] = [None] * len(self.batch_idx)
+
+    def release(self) -> None:
+        residency, self._residency = self._residency, None
+        if residency is not None:
+            residency.release()
+
+    def _ok(self, b: int, t: int) -> bool:
+        """Memoized scalar oracle for (member b, universe type t)."""
+        from karpenter_tpu.solver.adapter import _validate
+
+        memo = self._ok_memos[b]
+        if memo is None:
+            memo = self._ok_memos[b] = {}
+        if t not in memo:
+            allowed, required = self.verify[b]
+            memo[t] = _validate(self.uni_types[t], allowed,
+                                required) is None
+        return memo[t]
+
+    def _options_fn(self, b: int):
+        """instance_options over the FEASIBLE subsequence of the universe
+        axis: the window is the next ``maxn`` feasible types from ``chosen``
+        (host_ffd.instance_options over the host's feasible list, by the
+        §16 order equivalence), with every scanned type re-validated by the
+        scalar oracle — the chosen type's check IS the primary fused
+        verification."""
+        from karpenter_tpu.solver.host_ffd import R_MEMORY, R_PODS
+
+        def options_fn(packables, chosen, maxn):
+            if not self._ok(b, chosen):
+                raise FusedMismatch(chosen)
+            base = packables[chosen]
+            out: List[int] = []
+            taken = 0
+            j = chosen
+            while j < len(packables) and taken < maxn:
+                if self._ok(b, j):
+                    taken += 1
+                    if base.total[R_MEMORY] <= packables[j].total[R_MEMORY] \
+                            and base.total[R_PODS] <= packables[j].total[R_PODS]:
+                        out.append(packables[j].index)
+                j += 1
+            return out
+
+        return options_fn
+
+    def decode_all(self, decode, records, dropped_full, max_instance_types):
+        """Per-problem decode with the self-heal contract: probe columns
+        re-checked against the scalar oracle, all-False rows re-derived,
+        every chosen type re-validated inside the options walk. A problem
+        that diverges returns None in its slot (the handle solves it on
+        the host path — scalar wins) and counts on BOTH fallback series."""
+        t0 = time.perf_counter()
+        probe = np.asarray(self.probe_d)
+        any_feas = np.asarray(self.any_d)
+        out: List[Optional[object]] = []
+        for b, enc in enumerate(self.encs):
+            bad = None
+            for k, t in enumerate(self.probe_idx):
+                if bool(probe[b, k]) != self._ok(b, int(t)):
+                    bad = f"probe type {int(t)}"
+                    break
+            if bad is None and not any_feas[b] and any(
+                    self._ok(b, t) for t in range(len(self.uni_types))):
+                bad = "all-false row"
+            if bad is None:
+                try:
+                    out.append(decode(enc, records[b], dropped_full[b],
+                                      self.packables, max_instance_types,
+                                      options_fn=self._options_fn(b)))
+                    continue
+                except FusedMismatch as e:
+                    bad = f"chosen type {e.args[0]}"
+            FILTER_FALLBACK_TOTAL.inc(reason="device-mask-mismatch")
+            FILTER_DEVICE_FALLBACK_TOTAL.inc(reason="device-mask-mismatch")
+            out.append(None)
+        FILTER_DEVICE_SECONDS.observe(time.perf_counter() - t0,
+                                      stage="verify")
+        return out
+
+
+def _probe_indices(n: int) -> np.ndarray:
+    """The window's verification columns: every real type for small
+    catalogs (tests verify the full row), else a deterministic per-window
+    sample. Always shape (_PROBE_K,) so the jit never retraces."""
+    if n <= _PROBE_K:
+        idx = np.arange(n, dtype=np.int32)
+    else:
+        rng = np.random.default_rng(next(_window_counter))
+        idx = rng.choice(n, size=_PROBE_K, replace=False).astype(np.int32)
+    if len(idx) < _PROBE_K:
+        idx = np.concatenate(
+            [idx, np.full(_PROBE_K - len(idx), idx[-1] if len(idx) else 0,
+                          np.int32)])
+    return idx
+
+
+def prepare_fused(problems, marshaled, config, max_shapes: int):
+    """Dispatch-side fused preparation for one window: universe packables,
+    planes residency, row encode, universe encodes, and the async mask
+    dispatch. Returns a :class:`FusedBatch` (≥2 members) or None — the
+    caller then runs the classic host-columnar batch path unchanged."""
+    if not enabled():
+        return None
+    t0 = time.perf_counter()
+    try:
+        from karpenter_tpu.ops.encode import encode, pad_encoding
+        from karpenter_tpu.parallel.mesh import solver_mesh
+        from karpenter_tpu.solver import adapter
+
+        # one universe per fused batch: every member must share the catalog
+        # identity and daemon overhead (the shared type axis + planes)
+        key0 = None
+        for prob in problems:
+            key = (tuple(adapter._instance_token(it)
+                         for it in prob.instance_types),
+                   tuple(adapter.pod_vector(d) for d in prob.daemons))
+            if key0 is None:
+                key0 = key
+            elif key != key0:
+                FILTER_DEVICE_FALLBACK_TOTAL.inc(reason="mixed-universe")
+                return None
+        if key0 is None or not key0[0]:
+            return None
+        packables, uni_types, uni_version = adapter.build_universe_packables(
+            problems[0].instance_types, daemon_vecs=key0[1])
+        if not packables:
+            return None
+        planes = planes_for(uni_types)
+        if planes is None:
+            return None
+
+        batch_idx: List[int] = []
+        encs = []
+        verify = []
+        for i, prob in enumerate(problems):
+            vecs, required, sids = marshaled[i]
+            if len(required & set(_GPU_CLASSES)) >= 3:
+                # all three GPU classes required: the host comparator's
+                # order on the feasible subset is no longer the stable
+                # (cpu, mem) key (§16) — keep such exotica on the host path
+                FILTER_DEVICE_FALLBACK_TOTAL.inc(reason="gpu-trio")
+                continue
+            allowed = adapter.allowed_sets_cached(prob.constraints)
+            if any(a is not None and len(a) == 0 or a is None
+                   for a in allowed):
+                # a None/empty allowed set rejects every type (Go
+                # sets.Has(nil)) — the solo path answers "all
+                # unschedulable" immediately; an all-False device row
+                # would grind through the kernel's drop path instead
+                continue
+            enc = encode(vecs, list(range(len(prob.pods))), packables,
+                         pad=False, sids=sids, catalog_version=uni_version)
+            if enc is None or enc.num_shapes > max_shapes:
+                continue
+            penc = pad_encoding(enc)
+            if penc is None:
+                continue
+            batch_idx.append(i)
+            encs.append(penc)
+            verify.append((allowed, required))
+        if len(batch_idx) < 2:
+            return None
+
+        TB = encs[0].totals.shape[0]
+        if TB != planes.TB or any(e.totals.shape[0] != TB for e in encs):
+            FILTER_DEVICE_FALLBACK_TOTAL.inc(reason="bucket-mismatch")
+            return None
+        mesh = solver_mesh()
+        B = len(encs)
+        Bpad = -(-B // mesh.devices.size) * mesh.devices.size
+        rows = [schedule_row(planes, allowed, required)
+                for allowed, required in verify]
+        stacked = _stack_rows(planes, rows, Bpad)
+        probe_idx = _probe_indices(planes.n)
+        residency = _PlanesResidency(planes, mesh, rows_host=stacked)
+        try:
+            import jax
+
+            from karpenter_tpu.parallel.mesh import replicated
+
+            probe_d = jax.device_put(probe_idx, replicated(mesh))
+            mask_d, lv_d, any_d, probe_out = _window_jit(mesh)(
+                *residency.planes_d, *residency.rows_d, probe_d)
+        except BaseException:
+            residency.release()
+            raise
+        fused = FusedBatch(
+            batch_idx, encs, packables, uni_types, verify, mask_d, lv_d,
+            any_d, probe_out, probe_idx, residency)
+        FILTER_DEVICE_SECONDS.observe(time.perf_counter() - t0,
+                                      stage="dispatch")
+        return fused
+    except Exception:
+        FILTER_DEVICE_FALLBACK_TOTAL.inc(reason="jax-backend-unavailable")
+        FILTER_FALLBACK_TOTAL.inc(reason="jax-backend-unavailable")
+        return None
+
+
+def clear_caches() -> None:
+    """Tests only."""
+    with _LOCK:
+        _PLANES_CACHE.clear()
+        _ROW_CACHE.clear()
+    try:
+        from karpenter_tpu.solver import adapter
+
+        with adapter._packables_lock:
+            adapter._UNIVERSE_CACHE.clear()
+    except Exception:
+        pass

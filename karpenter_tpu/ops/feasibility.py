@@ -620,16 +620,13 @@ def _vocab_ok(vocab: Dict[str, int], allowed) -> np.ndarray:
 
 
 def _combine_columns(cols, n: int) -> np.ndarray:
-    if os.environ.get("KARPENTER_FEASIBILITY_BACKEND", "").strip() == "jax":
-        try:
-            import jax.numpy as jnp
-
-            acc = jnp.ones(n, bool)
-            for c in cols:
-                acc = acc & jnp.asarray(c)
-            return np.asarray(acc)
-        except Exception:
-            FILTER_FALLBACK_TOTAL.inc(reason="jax-backend-unavailable")
+    # Always numpy. The old KARPENTER_FEASIBILITY_BACKEND=jax leg — which
+    # re-transferred every column host→device per call and was strictly
+    # slower than this AND-reduce — folded into the device-resident fused
+    # filter (ops/device_filter.py): the env value now aliases to
+    # device_filter.enabled(), where the whole mask is computed FROM
+    # device-resident bit-planes instead of re-shipped columns, and the
+    # "jax-backend-unavailable" fallback counter lives on.
     acc = np.ones(n, bool)
     for c in cols:
         acc &= c
@@ -779,13 +776,27 @@ def gang_feasibility_mask(instance_types, member_keys,
     if hit is not None:
         return hit
     t0 = time.perf_counter()
-    mask: Optional[np.ndarray] = np.ones(len(instance_types), bool)
-    for allowed, required in distinct:
-        col = catalog_feasibility_mask(instance_types, allowed, required)
-        if col is None:
-            mask = None  # catalog not indexable: scalar path
-            break
-        mask = mask & col
+    mask: Optional[np.ndarray] = None
+    if distinct:
+        # device leg first (when on): the member-AND column computed from
+        # the persistent catalog bit-planes in ONE device call
+        # (ops/device_filter.py), instead of one host columnar mask per
+        # distinct member key. None → host/scalar legs below, unchanged;
+        # the all-False self-heal applies to either leg's verdict.
+        try:
+            from karpenter_tpu.ops import device_filter
+
+            mask = device_filter.gang_member_column(instance_types, distinct)
+        except Exception:
+            mask = None
+    if mask is None:
+        mask = np.ones(len(instance_types), bool)
+        for allowed, required in distinct:
+            col = catalog_feasibility_mask(instance_types, allowed, required)
+            if col is None:
+                mask = None  # catalog not indexable: scalar path
+                break
+            mask = mask & col
     if mask is not None and slice_shape is not None:
         mask = mask & _slice_column(instance_types, tokens, slice_shape)
     if mask is None:

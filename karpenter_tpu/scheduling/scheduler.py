@@ -20,6 +20,7 @@ from karpenter_tpu.api.provisioner import Provisioner
 from karpenter_tpu.metrics.filter import FILTER_BATCH_SECONDS
 from karpenter_tpu.ops import feasibility
 from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.solver import adapter
 from karpenter_tpu.scheduling.topology import Topology
 from karpenter_tpu.utils import resources as res
 
@@ -113,6 +114,11 @@ class Scheduler:
             if schedule is None:
                 schedule = schedules[key] = Schedule(
                     constraints=tightened, pods=[], gang=gspec)
+                # warm the allowed-sets memo at window assembly: the solver
+                # (batched and fused device-filter paths alike) reads these
+                # five sets per schedule, and the tighten cache hands back
+                # the same constraints object window after window
+                adapter.allowed_sets_cached(tightened)
             schedule.pods.append(pod)
         # a gang schedule that lost members to validation above is partial;
         # all-or-nothing means the survivors shed with the group rather

@@ -418,6 +418,21 @@ def _allowed_sets(constraints: Constraints) -> tuple:
             reqs.architectures(), reqs.operating_systems())
 
 
+def allowed_sets_cached(constraints: Constraints) -> tuple:
+    """:func:`_allowed_sets` memoized on the constraints object itself,
+    fingerprint-guarded (the CompiledConstraints idiom — feasibility.py):
+    the scheduler's tighten cache hands back the SAME object window after
+    window, so steady-state windows skip the five requirement-list walks.
+    Warmed at window assembly (scheduling/scheduler._get_schedules)."""
+    fp = feasibility._fingerprint(constraints)
+    hit = constraints.__dict__.get("_allowed_sets_memo")
+    if hit is not None and hit[0] == fp:
+        return hit[1]
+    allowed = _allowed_sets(constraints)
+    constraints.__dict__["_allowed_sets_memo"] = (fp, allowed)
+    return allowed
+
+
 def build_packables(
     instance_types: Sequence[InstanceType],
     constraints: Constraints,
@@ -427,7 +442,7 @@ def build_packables(
     """PackablesFor (packable.go:44-91): validate → reserve overhead → pack
     daemons → sort ascending."""
     return _build_packables_from(
-        instance_types, _allowed_sets(constraints),
+        instance_types, allowed_sets_cached(constraints),
         [pod_vector(d) for d in daemons], _required_resources(pods))
 
 
@@ -532,7 +547,7 @@ def build_packables_versioned(
     repeat it. It keys the encoder's catalog tensor cache and, through the
     encoding's catalog token, lets the device ring prove a slot already
     holds these bytes."""
-    allowed = _allowed_sets(constraints)
+    allowed = allowed_sets_cached(constraints)
     daemon_vecs = tuple(pod_vector(d) for d in daemons)
     if required is None:
         required = _required_resources(pods)
@@ -550,6 +565,72 @@ def build_packables_versioned(
             if len(_PACKABLES_CACHE) >= _PACKABLES_CACHE_CAP:
                 _PACKABLES_CACHE.pop(next(iter(_PACKABLES_CACHE)))
             _PACKABLES_CACHE[key] = (packables, sorted_types, version)
+    else:
+        packables, sorted_types, version = hit
+    return [p.copy() for p in packables], list(sorted_types), version
+
+
+# -- universe packables (device filter, ops/device_filter.py) -----------------
+#
+# The fused device filter masks the WHOLE catalog on device, so its type
+# axis must be constraint-independent: every type that survives overhead
+# reservation + daemon packing, in an order that agrees with the host
+# comparator on any feasible subset a fused problem can see. The stable
+# (cpu, memory) key is that order: _gpu_sort_cmp's GPU-equality gate holds
+# uniformly inside any feasible subset with at least one GPU class
+# uniformly zero (classes outside ``required`` must be zero per _validate),
+# where the comparator IS lexicographic (cpu, memory) — and restricting a
+# stable key sort to a subset yields the subset's stable key sort. The one
+# catalog shape with no such class (all three GPU classes required at
+# once) is excluded from the fused path (docs/solver.md §16).
+
+_UNIVERSE_CACHE: dict = {}
+_UNIVERSE_CACHE_CAP = 8
+
+
+def build_universe_packables(
+    instance_types: Sequence[InstanceType],
+    daemons: Sequence[Pod] = (),
+    daemon_vecs: Optional[tuple] = None,
+) -> Tuple[List[Packable], List[InstanceType], int]:
+    """Constraint-independent packables over the whole catalog: overhead
+    reserved + daemons packed (no validators — feasibility arrives later as
+    the device mask), sorted by the stable ``(cpu, memory)`` key. Returns
+    ``(packables, sorted_types, version)`` with the same copy/version
+    contract as :func:`build_packables_versioned`; one cache entry serves
+    every constraint variant in the fleet until the catalog or daemon set
+    changes — that is the point."""
+    if daemon_vecs is None:
+        daemon_vecs = tuple(pod_vector(d) for d in daemons)
+    key = (tuple(_instance_token(it) for it in instance_types), daemon_vecs)
+    with _packables_lock:
+        hit = _UNIVERSE_CACHE.get(key)
+    if hit is None:
+        viable: List[Tuple[Vec, InstanceType, Packable]] = []
+        for it in instance_types:
+            totals = instance_totals(it)
+            p = Packable(index=-1, total=list(totals),
+                         reserved=[0] * NUM_RESOURCES)
+            if not p.reserve(resource_list_vector(it.overhead)):
+                continue
+            if daemon_vecs:
+                r = pack_one(p, list(daemon_vecs),
+                             list(range(len(daemon_vecs))))
+                if r.unpacked:
+                    continue
+            viable.append((totals, it, p))
+        viable.sort(key=lambda v: (v[0][R_CPU], v[0][R_MEMORY]))
+        packables: List[Packable] = []
+        sorted_types: List[InstanceType] = []
+        for i, (_, it, p) in enumerate(viable):
+            p.index = i
+            packables.append(p)
+            sorted_types.append(it)
+        version = next(_packables_version_counter)
+        with _packables_lock:
+            if len(_UNIVERSE_CACHE) >= _UNIVERSE_CACHE_CAP:
+                _UNIVERSE_CACHE.pop(next(iter(_UNIVERSE_CACHE)))
+            _UNIVERSE_CACHE[key] = (packables, sorted_types, version)
     else:
         packables, sorted_types, version = hit
     return [p.copy() for p in packables], list(sorted_types), version

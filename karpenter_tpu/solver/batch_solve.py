@@ -90,13 +90,39 @@ def dispatch_batch(problems: Sequence[Problem],
 
 def _dispatch_batch(problems: Sequence[Problem],
                     config: SolverConfig) -> "BatchHandle":
-    prepared = []
-    for prob in problems:
-        vecs, required, sids = marshal_pods_interned(prob.pods)
+    from karpenter_tpu.ops import device_filter
+
+    marshaled = [marshal_pods_interned(prob.pods) for prob in problems]
+
+    # gate on the cheap signals BEFORE paying for encoding: a batch of tiny
+    # problems is faster on the native/host executors than a device trip
+    total_pods = sum(len(p.pods) for p in problems)
+    device_gate = (config.use_device and len(problems) >= 2
+                   and total_pods >= config.device_min_pods)
+
+    # the fused device filter (ops/device_filter.py) replaces the host
+    # columnar filter + per-constraint packables build for every problem it
+    # admits: members encode against the shared universe type axis and
+    # their valid/last_valid rows arrive as device arrays computed by the
+    # window's mask pjit — the mask never lands on host
+    fused = None
+    if device_gate and config.device_filter and \
+            not solve_module._WATCHDOG.tripped():
+        fused = device_filter.prepare_fused(
+            problems, marshaled, config, resolved_device_max_shapes(config))
+    fused_set = frozenset(fused.batch_idx) if fused is not None \
+        else frozenset()
+
+    prepared: List[Optional[tuple]] = [None] * len(problems)
+    for i, prob in enumerate(problems):
+        if i in fused_set:
+            continue  # fused members skip the host filter entirely; a
+            # (rare) fused fallback rebuilds this lazily at fetch
+        vecs, required, sids = marshaled[i]
         packables, sorted_types, cat_version = build_packables_versioned(
             prob.instance_types, prob.constraints, prob.pods, prob.daemons,
             required=required)
-        prepared.append((packables, sorted_types, vecs, sids, cat_version))
+        prepared[i] = (packables, sorted_types, vecs, sids, cat_version)
 
     def _problem_prices(i: int) -> Optional[list]:
         """Per-problem effective prices for the in-kernel cost tie-break —
@@ -104,10 +130,15 @@ def _dispatch_batch(problems: Sequence[Problem],
         so batched and solo cost-mode solves stay differential. Called only
         for problems that actually join the device batch: solo fallbacks
         build their own, and paying effective_price() for a batch the gate
-        rejects would waste the provisioning hot loop."""
+        rejects would waste the provisioning hot loop. Fused members price
+        the whole universe axis — the kernel only ever compares prices of
+        mask-valid types, so the extra rows are inert."""
         from karpenter_tpu.models.cost import effective_price
 
-        packables, sorted_types = prepared[i][0], prepared[i][1]
+        if i in fused_set:
+            packables, sorted_types = fused.packables, fused.uni_types
+        else:
+            packables, sorted_types = prepared[i][0], prepared[i][1]
         if not (packables and any(it.price for it in sorted_types)):
             return None
         return [
@@ -117,14 +148,13 @@ def _dispatch_batch(problems: Sequence[Problem],
             for p in packables
         ]
 
-    # gate on the cheap signals BEFORE paying for encoding: a batch of tiny
-    # problems is faster on the native/host executors than a device trip
-    total_pods = sum(len(p.pods) for p in problems)
     batch_idx: List[int] = []
     encs = []
     raw_encs: List[Optional[object]] = [None] * len(problems)
-    if config.use_device and len(problems) >= 2 and \
-            total_pods >= config.device_min_pods:
+    if fused is not None:
+        batch_idx = list(fused.batch_idx)
+        encs = list(fused.encs)
+    elif device_gate:
         from karpenter_tpu.ops.encode import pad_encoding
 
         for i, prob in enumerate(problems):
@@ -151,17 +181,26 @@ def _dispatch_batch(problems: Sequence[Problem],
     if len(batch_idx) >= 2 and not solve_module._WATCHDOG.tripped():
         try:
             with trace("karpenter.solve.batch_dispatch"):
-                batch_packables = [prepared[i][0] for i in batch_idx]
+                if fused is not None:
+                    batch_packables = [fused.packables] * len(batch_idx)
+                else:
+                    batch_packables = [prepared[i][0] for i in batch_idx]
                 batch_prices = [
                     _problem_prices(i) if config.cost_tiebreak else None
                     for i in batch_idx]
                 run = _launch_device_batch(
-                    encs, batch_packables, batch_prices, config)
+                    encs, batch_packables, batch_prices, config, fused=fused)
         except Exception:  # device ring: never drop a provisioning loop
             log.exception(
                 "batched device dispatch failed; problems fall back at fetch")
             run = None
-    handle = BatchHandle(problems, config, prepared, raw_encs, batch_idx, run)
+    if run is None and fused is not None:
+        # the fused window never launched: planes slot back to the pool;
+        # members solve on the solo path at fetch (lazy classic prep)
+        fused.release()
+    handle = BatchHandle(problems, config, prepared, raw_encs, batch_idx,
+                         run, marshaled=marshaled,
+                         fused=fused if run is not None else None)
     if run is not None:
         # suppress hedging while this batch is in flight: a duplicate
         # dispatch would queue behind it on the device (solver/hedge.py)
@@ -181,13 +220,16 @@ class BatchHandle:
     The handle counts as "outstanding" for hedge suppression from dispatch
     until its fetch begins."""
 
-    def __init__(self, problems, config, prepared, raw_encs, batch_idx, run):
+    def __init__(self, problems, config, prepared, raw_encs, batch_idx, run,
+                 marshaled=None, fused=None):
         self._problems = list(problems)
         self._config = config
         self._prepared = prepared
         self._raw_encs = raw_encs
         self._batch_idx = batch_idx
         self._run = run
+        self._marshaled = marshaled
+        self._fused = fused
         self._results: Optional[List[SolveResult]] = None
         # the dispatching window's span context rides on the handle so the
         # fetch half — wherever (whichever thread) it runs — re-enters the
@@ -237,13 +279,29 @@ class BatchHandle:
             if host_results is not None:
                 solve_module.record_executor("device-batch",
                                              count=len(self._batch_idx))
+                fused = self._fused
                 for j, i in enumerate(self._batch_idx):
+                    if host_results[j] is None:
+                        continue  # fused verification rejected this member:
+                        # scalar wins, the solo loop below re-solves it
+                    sorted_types = fused.uni_types if fused is not None \
+                        else prepared[i][1]
                     results[i] = materialize(
-                        host_results[j], problems[i].pods, prepared[i][1],
+                        host_results[j], problems[i].pods, sorted_types,
                         problems[i].constraints, config)
 
         for i, prob in enumerate(problems):
             if results[i] is None:  # not batched (or batch failed): solo path
+                if prepared[i] is None:
+                    # a fused member falling back: build the classic
+                    # host-filtered packables it skipped at dispatch
+                    vecs, required, sids = self._marshaled[i]
+                    packables, sorted_types, cat_version = \
+                        build_packables_versioned(
+                            prob.instance_types, prob.constraints,
+                            prob.pods, prob.daemons, required=required)
+                    prepared[i] = (packables, sorted_types, vecs, sids,
+                                   cat_version)
                 packables, sorted_types, vecs, sids, cat_version = prepared[i]
                 results[i] = solve_with_packables(
                     prob.constraints, prob.pods, packables, sorted_types,
@@ -253,10 +311,12 @@ class BatchHandle:
 
 
 def _launch_device_batch(encs, packables_list, prices_list,
-                         config: SolverConfig) -> "_DeviceBatchRun":
+                         config: SolverConfig,
+                         fused=None) -> "_DeviceBatchRun":
     """Dispatch-side seam: build the device state and async-launch the first
     chunk. Module-level so tests can spy on batch membership."""
-    return _DeviceBatchRun(encs, packables_list, prices_list, config)
+    return _DeviceBatchRun(encs, packables_list, prices_list, config,
+                           fused=fused)
 
 
 def _finish_device_batch(run: "_DeviceBatchRun"):
@@ -286,7 +346,7 @@ class _DeviceBatchRun:
     the solo path does for an unpriced catalog."""
 
     def __init__(self, encs, packables_list, prices_list,
-                 config: SolverConfig):
+                 config: SolverConfig, fused=None):
         import jax
 
         from karpenter_tpu.parallel.mesh import batch_sharding, solver_mesh
@@ -297,6 +357,7 @@ class _DeviceBatchRun:
         self.encs = encs
         self.packables_list = packables_list
         self.config = config
+        self._fused = fused
         self._jax = jax
         self._pack = pack_batch_sharded_flat
         self._pack_ring = pack_batch_sharded_ring
@@ -321,6 +382,13 @@ class _DeviceBatchRun:
         batch = pad_problems(encs, self.mesh.devices.size)
         (shapes, counts, dropped, totals, reserved0, valid,
          last_valid, pods_unit, _B) = batch
+        if fused is not None and \
+                tuple(fused.mask_d.shape) != tuple(valid.shape):
+            # the device mask and the padded batch must agree on (Bpad, TB)
+            # exactly — a mismatch here means a seam bug, not bad data
+            raise ValueError(
+                f"fused mask shape {tuple(fused.mask_d.shape)} != batch "
+                f"valid shape {tuple(valid.shape)}")
         self.S0 = shapes.shape[1]
         if kernel == "pallas" and self.S0 > config.pallas_max_shapes:
             # padded batch landed above the pallas-validated bucket — the
@@ -367,6 +435,13 @@ class _DeviceBatchRun:
                     "totals": totals, "reserved0": reserved0, "valid": valid,
                     "last_valid": last_valid, "pods_unit": pods_unit,
                     "prices": prices_arr}
+            if fused is not None:
+                # fused mode: valid/last_valid are the mask pjit's device
+                # outputs (ops/device_filter.py) — they never ship from
+                # host, so they are not part of the slot's working set
+                # (and the distinct signature keeps fused and classic
+                # windows on separate slots)
+                del host["valid"], host["last_valid"]
             self._slot = self._ring.acquire(DeviceRing.signature(host))
         try:
             if self._slot is not None:
@@ -397,9 +472,10 @@ class _DeviceBatchRun:
                 self.totals = put("totals", totals, cat("totals"))
                 self.reserved0 = put("reserved0", reserved0,
                                      cat("reserved0"))
-                self.valid = put("valid", valid, cat("valid"))
-                self.last_valid = put("last_valid", last_valid,
-                                      cat("last_valid"))
+                if fused is None:
+                    self.valid = put("valid", valid, cat("valid"))
+                    self.last_valid = put("last_valid", last_valid,
+                                          cat("last_valid"))
                 self.pods_unit = put("pods_unit", pods_unit,
                                      cat("pods_unit"))
                 self.prices_arr = put("prices", prices_arr,
@@ -407,12 +483,18 @@ class _DeviceBatchRun:
                 self.counts_d = put("counts", counts)
                 self.dropped_d = put("dropped", dropped)
             else:
-                (self.shapes_d, self.totals, self.reserved0, self.valid,
-                 self.last_valid, self.pods_unit) = jax.device_put(
-                    (shapes, totals, reserved0, valid, last_valid, pods_unit))
+                if fused is None:
+                    self.valid, self.last_valid = jax.device_put(
+                        (valid, last_valid))
+                (self.shapes_d, self.totals, self.reserved0,
+                 self.pods_unit) = jax.device_put(
+                    (shapes, totals, reserved0, pods_unit))
                 self.prices_arr = jax.device_put(prices_arr)
                 self.counts_d, self.dropped_d = jax.device_put(
                     (counts, dropped))
+            if fused is not None:
+                self.valid = fused.mask_d
+                self.last_valid = fused.last_valid_d
             self._pending = None
             self._pending_lock = threading.Lock()
             self.launch()
@@ -421,11 +503,16 @@ class _DeviceBatchRun:
             raise
 
     def close(self) -> None:
-        """Release the ring slot (idempotent). The buffers stay device-
-        resident in the slot for the next chunk to refill in place."""
+        """Release the ring slot and the fused planes residency
+        (idempotent). The buffers stay device-resident in their slots for
+        the next window to refill (planes: token-skip) in place. Held until
+        here so no later fill can donate away a buffer an in-flight program
+        still reads."""
         slot, self._slot = self._slot, None
         if slot is not None and self._ring is not None:
             self._ring.release(slot)
+        if self._fused is not None:
+            self._fused.release()
 
     # -- dispatch side -------------------------------------------------------
     def _dispatch_chunk(self):
@@ -623,6 +710,13 @@ class _DeviceBatchRun:
         else:
             raise RuntimeError("batched solve did not converge")
 
+        if self._fused is not None:
+            # fused decode: probe columns re-checked against the scalar
+            # oracle, every chosen type re-validated in the option walk;
+            # a diverging member returns None (solo fallback, scalar wins)
+            return self._fused.decode_all(
+                _decode, records, dropped_full,
+                self.config.max_instance_types)
         return [
             _decode(enc, records[b], dropped_full[b], self.packables_list[b],
                     self.config.max_instance_types)

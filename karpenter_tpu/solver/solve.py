@@ -288,6 +288,15 @@ class SolverConfig:
     # zero fresh device allocation. False restores fresh device_puts per
     # chunk (the differential suite pins ring == no-ring node-for-node).
     device_donate: bool = True
+    # device-resident fused feasibility (ops/device_filter.py): a batched
+    # window computes its pods×types feasibility mask ON device (catalog
+    # bit-planes riding token-aware ring slots, one pjit per window) and
+    # feeds it to the pack kernel directly — the mask never crosses PCIe.
+    # The verdict stays a filter: sampled scalar re-verification self-heals
+    # every divergence to the host path. False (or the
+    # KARPENTER_DEVICE_FILTER=0 kill switch, which wins over this flag)
+    # restores the per-problem host columnar filter for batched windows.
+    device_filter: bool = True
     # auto-select the type-SPMD kernel (device_kernel=None) only when the
     # padded type bucket reaches this size AND the mesh has more than one
     # device: below it, the per-node collective round-trips cost more than

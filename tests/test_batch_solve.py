@@ -136,9 +136,9 @@ class TestBatchRouting:
         seen_batches = []
         real = bs._launch_device_batch
 
-        def spying(encs, packables_list, prices_list, config):
+        def spying(encs, packables_list, prices_list, config, **kw):
             seen_batches.append([e.num_shapes for e in encs])
-            return real(encs, packables_list, prices_list, config)
+            return real(encs, packables_list, prices_list, config, **kw)
 
         monkeypatch.setattr(bs, "_launch_device_batch", spying)
         config = SolverConfig(device_min_pods=1, device_max_shapes=32)

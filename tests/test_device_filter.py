@@ -1,0 +1,324 @@
+"""Device-resident fused feasibility (ops/device_filter.py) vs the scalar
+oracle, raw verdict for raw verdict.
+
+Same contract as tests/test_feasibility.py: the fuzz compares the device
+mask's RAW verdicts against ``adapter._validate`` — never the self-healing
+production wrappers — so a divergence cannot hide behind the fallback
+path. The solve-level tests then pin the production wrappers: kill-switch
+parity, mid-window intern rollover, sabotage self-heal (scalar wins and
+the fallback counters move), the universe order proof, and the gang
+column reuse.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.metrics.filter import (
+    FILTER_DEVICE_FALLBACK_TOTAL, FILTER_FALLBACK_TOTAL,
+    FILTER_PLANE_RING_REUSES_TOTAL,
+)
+from karpenter_tpu.ops import device_filter, feasibility
+from karpenter_tpu.solver import adapter
+from karpenter_tpu.utils import resources as res
+from tests.test_feasibility import (
+    _q, _rand_allowed, rand_constraints, rand_instance_type,
+)
+
+_SPECIALS = [res.AWS_POD_ENI, res.NVIDIA_GPU, res.AMD_GPU, res.AWS_NEURON]
+
+
+def _rand_allowed_oov(rng):
+    """_rand_allowed plus occasional out-of-vocab values — label values the
+    catalog never interned must simply never match (not crash, not
+    mis-bucket onto a real value's bit)."""
+    allowed = _rand_allowed(rng)
+    if rng.random() < 0.4:
+        allowed = tuple(
+            (a | frozenset([f"oov-{i}"])) if a is not None
+            and rng.random() < 0.5 else a
+            for i, a in enumerate(allowed))
+    return allowed
+
+
+def _rand_required(rng):
+    return frozenset(rng.sample(_SPECIALS, rng.randint(0, 2)))
+
+
+class TestDeviceMaskOracleFuzz:
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_fuzz_device_mask_matches_scalar_oracle(self, seed):
+        """500 windows across the three seeds, each a batch of schedules
+        over one random catalog: every (schedule, type) device verdict must
+        equal the scalar oracle's. Covers None allowed sets (Go
+        sets.Has(nil) rejects), empty sets, out-of-vocab values, GPU
+        exclusivity both ways, ENI, and offering (ct, zone) pairs."""
+        rng = random.Random(seed)
+        windows = 167 if seed != 42 else 166  # 500 total
+        for case in range(windows):
+            catalog = [rand_instance_type(rng, i)
+                       for i in range(rng.randint(0, 12))]
+            pairs = [(_rand_allowed_oov(rng), _rand_required(rng))
+                     for _ in range(rng.randint(1, 5))]
+            mask = device_filter.compute_mask(catalog, pairs)
+            assert mask is not None
+            assert mask.shape == (len(pairs), len(catalog))
+            for s, (allowed, required) in enumerate(pairs):
+                ref = [adapter._validate(it, allowed, required) is None
+                       for it in catalog]
+                assert list(mask[s]) == ref, \
+                    f"seed {seed} case {case} schedule {s}"
+
+    def test_constraint_derived_pairs_keep_scalar_quirks(self):
+        """Pairs derived from random Requirements objects — the PR 3 scalar
+        quirks (NotIn-without-In collapse, alias-key normalization, Exists
+        rows) collapse into the allowed sets BEFORE either engine, and the
+        device mask must agree with the oracle on the collapsed sets."""
+        rng = random.Random(0xDEF1)
+        for case in range(60):
+            catalog = [rand_instance_type(rng, i)
+                       for i in range(rng.randint(1, 10))]
+            pairs = [(adapter._allowed_sets(rand_constraints(rng)),
+                      _rand_required(rng)) for _ in range(3)]
+            mask = device_filter.compute_mask(catalog, pairs)
+            assert mask is not None
+            for s, (allowed, required) in enumerate(pairs):
+                ref = [adapter._validate(it, allowed, required) is None
+                       for it in catalog]
+                assert list(mask[s]) == ref, f"case {case} schedule {s}"
+
+    def test_none_and_empty_allowed_reject_everything(self):
+        rng = random.Random(2)
+        catalog = [rand_instance_type(rng, i) for i in range(6)]
+        full = (frozenset(["spot", "on-demand"]),
+                frozenset(["us-1a", "us-1b", "eu-9a"]),
+                frozenset(f"it-{j}" for j in range(7)),
+                frozenset(["amd64", "arm64"]),
+                frozenset(["linux", "windows", "bottlerocket"]))
+        for axis in range(5):
+            for hole in (None, frozenset()):
+                allowed = tuple(hole if i == axis else a
+                                for i, a in enumerate(full))
+                mask = device_filter.compute_mask(catalog,
+                                                  [(allowed, frozenset())])
+                assert mask is not None and not mask.any()
+
+    def test_ct_vocab_overflow_falls_back(self):
+        rng = random.Random(3)
+        from karpenter_tpu.cloudprovider.spi import InstanceType, Offering
+
+        its = [InstanceType(
+            name=f"ct-{i}", offerings=[Offering(f"ct-kind-{i}", "us-1a")],
+            architecture="amd64", operating_systems=frozenset(["linux"]),
+            cpu=_q(4), memory=_q(16), pods=_q(110), nvidia_gpus=_q(0),
+            amd_gpus=_q(0), aws_neurons=_q(0), aws_pod_eni=_q(0))
+            for i in range(40)]  # 40 capacity types > the 32-bit row word
+        before = FILTER_DEVICE_FALLBACK_TOTAL.collect().get(
+            (("reason", "ct-vocab-overflow"),), 0.0)
+        assert device_filter.planes_for(its) is None
+        after = FILTER_DEVICE_FALLBACK_TOTAL.collect().get(
+            (("reason", "ct-vocab-overflow"),), 0.0)
+        assert after == before + 1
+        assert device_filter.compute_mask(
+            its, [(_rand_allowed(rng), frozenset())]) is None
+
+
+class TestUniverseOrder:
+    def test_universe_feasible_subsequence_equals_host_order(self):
+        """The §16 order proof, fuzzed: the universe packables' stable
+        (cpu, memory) order restricted to any fused-eligible feasible
+        subset must equal the host comparator's sorted feasible list —
+        including its tie order (rand_instance_type makes every type tie
+        on (cpu, memory), the hardest case)."""
+        rng = random.Random(0xBEEF)
+        for case in range(80):
+            catalog = [rand_instance_type(rng, i)
+                       for i in range(rng.randint(1, 14))]
+            allowed = _rand_allowed(rng)
+            required = _rand_required(rng)
+            if len(required & set(device_filter._GPU_CLASSES)) >= 3:
+                continue  # excluded from the fused path by the same rule
+            host_p, host_types = adapter._build_packables_from(
+                catalog, allowed, (), required)
+            _, uni_types, _ = adapter.build_universe_packables(catalog)
+            feasible = [it for it in uni_types
+                        if adapter._validate(it, allowed, required) is None]
+            assert [id(it) for it in feasible] == \
+                [id(it) for it in host_types], f"case {case}"
+
+
+def _window_problems(seed=0, n=4, n_types=10):
+    from karpenter_tpu.cloudprovider.fake.provider import instance_types
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.solver.batch_solve import Problem
+    from tests.test_pack_parity import make_pod
+
+    rng = random.Random(seed)
+    catalog = instance_types(n_types)
+    constraints = universe_constraints(catalog)
+    problems = []
+    for b in range(n):
+        pods = []
+        for j in range(rng.randint(5, 60)):
+            pods.append(make_pod({
+                "cpu": f"{rng.choice([100, 250, 500, 1000])}m",
+                "memory": f"{rng.choice([64, 256, 1024])}Mi"}))
+            pods[-1].metadata.name = f"df{b}-{j}"
+        problems.append(Problem(constraints=constraints, pods=pods,
+                                instance_types=catalog))
+    return problems
+
+
+class TestFusedSolveParity:
+    def test_kill_switch_parity(self, monkeypatch):
+        """KARPENTER_DEVICE_FILTER=0 (host columnar) and =1 (device fused)
+        must produce identical solve_batch results."""
+        from karpenter_tpu.solver.batch_solve import solve_batch
+        from karpenter_tpu.solver.solve import SolverConfig
+        from tests.test_batch_solve import result_key
+
+        problems = _window_problems(seed=9)
+        cfg = SolverConfig(device_min_pods=1)
+        monkeypatch.setenv("KARPENTER_DEVICE_FILTER", "1")
+        on = solve_batch(problems, cfg)
+        monkeypatch.setenv("KARPENTER_DEVICE_FILTER", "0")
+        off = solve_batch(problems, cfg)
+        for a, b in zip(on, off):
+            assert result_key(a) == result_key(b)
+
+    def test_legacy_backend_env_aliases_on(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_DEVICE_FILTER", raising=False)
+        monkeypatch.setenv("KARPENTER_FEASIBILITY_BACKEND", "jax")
+        assert device_filter.enabled()
+        monkeypatch.setenv("KARPENTER_DEVICE_FILTER", "off")
+        assert not device_filter.enabled()  # kill switch wins over legacy
+        monkeypatch.delenv("KARPENTER_FEASIBILITY_BACKEND")
+        monkeypatch.delenv("KARPENTER_DEVICE_FILTER")
+        assert device_filter.enabled()  # default on
+
+    def test_intern_rollover_mid_window(self, monkeypatch):
+        """A feasibility intern-table generation reset between dispatch and
+        fetch must not disturb the fused window (its planes vocabs are
+        per-catalog, not the global intern table) — results still match the
+        host leg."""
+        from karpenter_tpu.solver.batch_solve import dispatch_batch, \
+            solve_batch
+        from karpenter_tpu.solver.solve import SolverConfig
+        from tests.test_batch_solve import result_key
+
+        problems = _window_problems(seed=13)
+        cfg = SolverConfig(device_min_pods=1)
+        monkeypatch.setenv("KARPENTER_DEVICE_FILTER", "1")
+        handle = dispatch_batch(problems, cfg)
+        feasibility.reset_intern_table()  # mid-window generation rollover
+        got = handle.fetch()
+        monkeypatch.setenv("KARPENTER_DEVICE_FILTER", "0")
+        want = solve_batch(problems, cfg)
+        for a, b in zip(got, want):
+            assert result_key(a) == result_key(b)
+
+    def test_sabotaged_device_mask_self_heals(self, monkeypatch):
+        """Corrupt the device mask algebra; the probe verification must
+        catch it, increment BOTH fallback series, and self-heal to the
+        scalar path — results identical to the host leg (scalar wins)."""
+        from karpenter_tpu.solver.batch_solve import solve_batch
+        from karpenter_tpu.solver.solve import SolverConfig
+        from tests.test_batch_solve import result_key
+
+        problems = _window_problems(seed=17)
+        cfg = SolverConfig(device_min_pods=1)
+        monkeypatch.setenv("KARPENTER_DEVICE_FILTER", "0")
+        want = solve_batch(problems, cfg)
+        monkeypatch.setenv("KARPENTER_DEVICE_FILTER", "1")
+
+        real = device_filter._mask_expr
+
+        def sabotaged(jnp, *args):
+            mask = real(jnp, *args)
+            # flip one real type column for every schedule: feasible types
+            # vanish, infeasible ones appear — the full-row probe (T <= 32
+            # here) must flag it either way
+            return mask.at[:, 0].set(~mask[:, 0])
+
+        monkeypatch.setattr(device_filter, "_mask_expr", sabotaged)
+        device_filter._window_jit.cache_clear()
+        device_filter._rows_jit.cache_clear()
+        key = (("reason", "device-mask-mismatch"),)
+        f_before = FILTER_FALLBACK_TOTAL.collect().get(key, 0.0)
+        d_before = FILTER_DEVICE_FALLBACK_TOTAL.collect().get(key, 0.0)
+        try:
+            got = solve_batch(problems, cfg)
+        finally:
+            monkeypatch.undo()
+            device_filter._window_jit.cache_clear()
+            device_filter._rows_jit.cache_clear()
+        assert FILTER_FALLBACK_TOTAL.collect().get(key, 0.0) > f_before
+        assert FILTER_DEVICE_FALLBACK_TOTAL.collect().get(key, 0.0) > d_before
+        for a, b in zip(got, want):
+            assert result_key(a) == result_key(b)
+
+    def test_plane_ring_reuse_across_windows(self, monkeypatch):
+        """Steady state: the second window's plane fills short-circuit on
+        the catalog content token — reuses move, and the ring does no fresh
+        allocation for the repeat window."""
+        from karpenter_tpu.solver.batch_solve import solve_batch
+        from karpenter_tpu.solver.pipeline import get_ring
+        from karpenter_tpu.solver.solve import SolverConfig
+
+        monkeypatch.setenv("KARPENTER_DEVICE_FILTER", "1")
+        problems = _window_problems(seed=23)
+        cfg = SolverConfig(device_min_pods=1)
+        solve_batch(problems, cfg)  # warmup window (fills + compiles)
+        ring = get_ring()
+        reuses0 = FILTER_PLANE_RING_REUSES_TOTAL.collect().get((), 0.0)
+        allocs0 = ring.allocations
+        solve_batch(problems, cfg)
+        assert FILTER_PLANE_RING_REUSES_TOTAL.collect().get((), 0.0) > reuses0
+        assert ring.allocations == allocs0
+
+
+class TestGangColumn:
+    def test_gang_member_column_matches_host_and_scalar(self, monkeypatch):
+        rng = random.Random(0xC0DE)
+        monkeypatch.setenv("KARPENTER_DEVICE_FILTER", "1")
+        for case in range(40):
+            catalog = [rand_instance_type(rng, i)
+                       for i in range(rng.randint(1, 10))]
+            keys = tuple((_rand_allowed(rng), _rand_required(rng))
+                         for _ in range(rng.randint(1, 4)))
+            col = device_filter.gang_member_column(catalog, keys)
+            assert col is not None
+            host = np.ones(len(catalog), bool)
+            for allowed, required in keys:
+                host &= feasibility.catalog_feasibility_mask(
+                    catalog, allowed, required)
+            assert list(col) == list(host), f"case {case}"
+            scalar = feasibility.gang_scalar_mask(catalog, keys, None)
+            assert list(col) == list(scalar), f"case {case} (scalar)"
+
+    def test_gang_feasibility_mask_uses_device_column(self, monkeypatch):
+        """With the filter on, gang_feasibility_mask's member-AND comes from
+        the device column (spied), and the verdict equals the filter-off
+        host leg."""
+        rng = random.Random(31)
+        catalog = [rand_instance_type(rng, i) for i in range(8)]
+        keys = [(_rand_allowed(rng), frozenset()) for _ in range(3)]
+        feasibility.clear_catalog_caches()
+        monkeypatch.setenv("KARPENTER_DEVICE_FILTER", "1")
+        calls = {"n": 0}
+        real = device_filter.gang_member_column
+
+        def spy(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(device_filter, "gang_member_column", spy)
+        on = feasibility.gang_feasibility_mask(catalog, keys)
+        assert calls["n"] == 1
+        feasibility.clear_catalog_caches()
+        monkeypatch.setenv("KARPENTER_DEVICE_FILTER", "0")
+        off = feasibility.gang_feasibility_mask(catalog, keys)
+        assert list(on) == list(off)

@@ -64,6 +64,19 @@ def _gang_cell(extra: dict) -> str:
     return f"{cfg['speedup']}x/{par}/{cfg.get('placed_gangs', '?')}"
 
 
+def _filter_cell(extra: dict) -> str:
+    """Compressed device-filter column (config_12, round 12+): speedup,
+    verdict (zero divergence AND node parity), steady device allocations —
+    '4.1x/par/a0'. '!par' flags any divergence; '-' when the config never
+    ran."""
+    cfg = extra.get("config_12_device_filter")
+    if not isinstance(cfg, dict) or "speedup" not in cfg:
+        return "-"
+    par = "par" if (cfg.get("verdict_divergence") == 0
+                    and cfg.get("node_parity")) else "!par"
+    return f"{cfg['speedup']}x/{par}/a{cfg.get('steady_allocations', '?')}"
+
+
 def _from_tail(tail: str):
     """Best-effort recovery of the bench JSON line from a captured stdout
     tail: parse from the LAST '{"metric"' occurrence (the line is emitted
@@ -109,7 +122,7 @@ def load_rows(root: str) -> list:
                     "metric": f"(tail truncated, rc={line.get('rc')})",
                     "value": None, "unit": "", "device_count": None,
                     "backend": "?", "degraded": None, "configs": "-",
-                    "marshal": "-", "gang": "-"})
+                    "marshal": "-", "gang": "-", "filter": "-"})
                 continue
             line = inner
         extra = line.get("extra", {}) if isinstance(line, dict) else {}
@@ -125,6 +138,7 @@ def load_rows(root: str) -> list:
             "configs": _config_ids(extra),
             "marshal": _marshal_cell(extra),
             "gang": _gang_cell(extra),
+            "filter": _filter_cell(extra),
         })
     for b in bad:
         print(f"bench-history: skipped {b}", file=sys.stderr)
@@ -135,7 +149,7 @@ def load_rows(root: str) -> list:
 def render(rows: list) -> str:
     headers = ["round", "variant", "metric", "value", "unit",
                "device_count", "backend", "degraded", "configs", "marshal",
-               "gang"]
+               "gang", "filter"]
     table = [headers] + [
         ["" if r[h] is None else str(r[h]) for h in headers] for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(headers))]

@@ -1,0 +1,80 @@
+"""Device-filter verdict: one human-readable line from the bench JSON.
+
+`make bench-filter` pipes bench.py (``--only config_12``) through this
+filter. The bench line passes through UNCHANGED on stdout (so
+`> BENCH_rNN.json` redirects still capture the pure JSON); the verdict
+goes to stderr:
+
+    device filter: 24-schedule windows x 400 types, fused bit-plane \
+filter 4.1x vs host columnar, divergence=0, node_parity=True \
+(10008 pods), plane reuses +40, steady allocations +0 — PASS
+
+PASS needs (the round-12 acceptance gate):
+- device-fused filter stage >= 2x the host columnar leg (p50), cycling
+  more constraint variants than the host mask cache holds;
+- zero verdict divergence — the bit-plane mask equals the host columnar
+  mask bit for bit on every variant;
+- node parity: the full 10k-pod solve_batch produces identical node
+  counts filter-on and filter-off (the device verdict is a filter, never
+  a commit);
+- the steady-state residency claim: plane ring reuses INCREASED during
+  the timed loop and fresh device allocations did NOT (the bit-planes
+  live on device; only the small row stacks cross PCIe).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+GATE_SPEEDUP = 2.0
+
+
+def verdict(line: dict) -> str:
+    extra = line.get("extra", {})
+    cfg = extra.get("config_12_device_filter", {})
+    if "error" in cfg or "speedup" not in cfg:
+        return ("device filter: no config_12_device_filter in bench line "
+                f"({cfg.get('error', 'config_12 not run')}) — NO VERDICT")
+    speedup = cfg.get("speedup")
+    divergence = cfg.get("verdict_divergence")
+    nparity = cfg.get("node_parity")
+    reuses = cfg.get("plane_ring_reuses", 0)
+    allocs = cfg.get("steady_allocations")
+    head = (f"device filter: {cfg.get('schedules_per_window')}-schedule "
+            f"windows x {cfg.get('types')} types, fused bit-plane filter "
+            f"{speedup}x vs host columnar, divergence={divergence}, "
+            f"node_parity={nparity} ({cfg.get('pods')} pods), "
+            f"plane reuses +{reuses:g}, steady allocations +{allocs}")
+    ok = (speedup is not None and speedup >= GATE_SPEEDUP
+          and divergence == 0 and nparity is True
+          and reuses > 0 and allocs == 0)
+    return (f"{head} — {'PASS' if ok else 'FAIL'} "
+            f"(gate >={GATE_SPEEDUP}x, 0 divergence, node parity, "
+            "reuses>0, 0 steady allocations)")
+
+
+def main() -> int:
+    last = None
+    for raw in sys.stdin:
+        sys.stdout.write(raw)  # pass-through: stdout stays the pure JSON
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            line = json.loads(raw)
+            if isinstance(line, dict) and "metric" in line:
+                last = line
+        except ValueError:
+            continue
+    sys.stdout.flush()
+    if last is None:
+        print("device filter: no bench JSON line on stdin — NO VERDICT",
+              file=sys.stderr)
+        return 1
+    print(verdict(last), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
