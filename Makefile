@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-filter bench-replay bench-replay-smoke bench-history replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-overload clean help
+.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-filter bench-replay bench-replay-smoke bench-history bench-regress replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-overload clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -43,14 +43,16 @@ bench-filter: ## Device-resident fused feasibility, bit-plane window filter vs h
 	python bench.py --only config_12 \
 		| python tools/filter_verdict.py
 
-bench-replay: ## Million-pod replay across 4 shards + 100k-object store A/B (config_9); verdict + traceview table on stderr
+bench-replay: ## Million-pod replay across 4 shards + 100k-object store A/B (config_9); verdict + SLO verdict + traceview table on stderr
 	python bench.py --only config_9 \
 		| python tools/replay_verdict.py \
+		| python tools/slo_verdict.py \
 		| python tools/traceview.py --bench
 
-bench-replay-smoke: ## bench-replay at 10k pods / 2 shards (KARPENTER_REPLAY_SMOKE=1); same verdict + traceview chain
+bench-replay-smoke: ## bench-replay at 10k pods / 2 shards (KARPENTER_REPLAY_SMOKE=1); same verdict + SLO verdict + traceview chain
 	KARPENTER_REPLAY_SMOKE=1 python bench.py --only config_9 \
 		| python tools/replay_verdict.py \
+		| python tools/slo_verdict.py \
 		| python tools/traceview.py --bench
 
 replay-smoke: ## 10k-pod 2-shard replay smoke (<60s) with chaos + pressure active
@@ -61,6 +63,9 @@ metrics-lint: ## Every registered metric must carry help text and appear in the 
 
 bench-history: ## Render the BENCH_r*.json trajectory as one table
 	python tools/bench_history.py
+
+bench-regress: ## CI gate: latest BENCH round vs best prior per tracked series; exit 1 on regression
+	python tools/bench_regress.py
 
 native: ## Build the C++ FFD kernel explicitly (normally built lazily)
 	g++ -O3 -std=c++17 -shared -fPIC \

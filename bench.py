@@ -751,22 +751,45 @@ def config_9_million_pod_replay():
     was_tracing = _trace.enabled()
     _trace.enable()
     smoke = _os.environ.get("KARPENTER_REPLAY_SMOKE", "") not in ("", "0")
+    # the smoke leg ALSO keeps exact latency lists so the report carries
+    # the digest-vs-exact quantile parity gate (slo_verdict checks <=1%)
     cfg = ReplayConfig(
         pods_total=10_000, shards=2, tenants=2, seed=7, bound_cohort=200,
         churn_pods=200, max_depth=4_000, ticks=8, tick_sleep_s=0.1,
         burst_ticks=2, chaos=True, settle_s=60.0, flood_pool=128,
-        gang_fraction=0.2) if smoke else ReplayConfig(gang_fraction=0.2)
+        gang_fraction=0.2, slo_exact_check=True) \
+        if smoke else ReplayConfig(gang_fraction=0.2)
     try:
         ab = store_ab(objects=100_000, minority=2_000)
         report = run_replay(cfg)  # 1M / 4-shard default (smoke: 10k / 2)
     finally:
         if not was_tracing:
             _trace.disable()
+    # dump BEFORE the chaos probe below: the probe resets the SLO engine,
+    # and the dump's otherData.slo (traceview's digest columns) must carry
+    # the MAIN leg's digests
     dump = _trace.dump_chrome(
         _os.environ.get("KARPENTER_TRACE_DUMP", "TRACE_replay.json"))
+    # seeded-chaos sentinel probe: a tiny replay under the same FaultPlan
+    # with a deliberately impossible objective — the burn sentinel MUST
+    # trip (band/stage-tagged) and degrade readyz, where the main leg
+    # above must run trip-free
+    probe = run_replay(ReplayConfig(
+        pods_total=1_200, shards=1, tenants=1, seed=7, bound_cohort=80,
+        churn_pods=40, max_depth=600, ticks=3, tick_sleep_s=0.1,
+        burst_ticks=1, chaos=True, settle_s=30.0, flood_pool=32,
+        slo_objectives={"default": 0.001}))
+    slo_chaos = {
+        "trips": probe["slo"]["trips"],
+        "burning": probe["slo"]["burning"],
+        "last_trip": probe["slo"]["burn"]["last_trip"],
+        "readyz_degraded": bool(probe["slo"]["burning"]),
+        "probe_wall_s": probe["wall_s"],
+    }
     return {
         "replay": report,
         "store_ab": ab,
+        "slo_chaos": slo_chaos,
         "smoke": smoke,
         "trace_dump": dump,
         "trace": _trace.state(),
@@ -1187,25 +1210,37 @@ def config_7_control_plane():
     # refill jits and leaves warm ring slots, so neither timed leg pays
     # cold-compile inside its window (the legs share every jit cache —
     # whichever ran first used to eat ~2 s of XLA lowering in 'marshal')
+    from karpenter_tpu.obs import slo as _slo
     from karpenter_tpu.obs import trace as _trace
 
     # the prewarm leg runs TRACED (it is untimed, so the span tax cannot
     # touch the A/B): its span count times the measured ns/span bounds the
-    # tracing tax as a fraction of window wall — the <2% acceptance claim
+    # tracing tax as a fraction of window wall — the <2% acceptance claim.
+    # The SLO stamp tax is bounded the same way: record() calls during the
+    # prewarm × measured ns/call (weighted chunk stamps are one call, so
+    # calls — not samples — is the honest unit).
     _trace.reset()
     _trace.enable()
+    _slo.reset()
+    slo_was_enabled = _slo.enabled()
+    _slo.enable()
     try:
         prewarm = _control_plane_run(pipeline_depth=2, n=4096)
     finally:
-        _trace.disable()
+        if not slo_was_enabled:
+            _slo.disable()
     prewarm_spans = _trace.state()["spans_buffered"]
+    slo_calls = _slo.record_calls()
     _trace.reset()
     overhead = _trace.measure_overhead()
+    slo_over = _slo.measure_overhead()
     on = _control_plane_run(pipeline_depth=2)
     off = _control_plane_run(pipeline_depth=1)
     sps, pps = off["pods_bound_per_sec"], on["pods_bound_per_sec"]
     tax_pct = (prewarm_spans * overhead["enabled_ns_per_span"] / 1e9
                / prewarm["wall_s"] * 100) if prewarm["wall_s"] else None
+    slo_tax_pct = (slo_calls * slo_over["enabled_ns_per_record"] / 1e9
+                   / prewarm["wall_s"] * 100) if prewarm["wall_s"] else None
     return {
         **on,
         "trace_overhead": {
@@ -1214,6 +1249,16 @@ def config_7_control_plane():
             "spans_per_traced_run": prewarm_spans,
             "traced_run_wall_s": round(prewarm["wall_s"], 4),
             "est_tax_pct": round(tax_pct, 4) if tax_pct is not None else None,
+        },
+        "slo_overhead": {
+            "disabled_ns_per_record": round(
+                slo_over["disabled_ns_per_record"], 1),
+            "enabled_ns_per_record": round(
+                slo_over["enabled_ns_per_record"], 1),
+            "record_calls_per_run": slo_calls,
+            "stamped_run_wall_s": round(prewarm["wall_s"], 4),
+            "est_tax_pct": (round(slo_tax_pct, 4)
+                            if slo_tax_pct is not None else None),
         },
         "pipeline_ab": {
             "depth_pipelined": 2,

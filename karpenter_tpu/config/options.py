@@ -85,6 +85,20 @@ class Options:
     trace_jax: bool = False
     # flight recorder dump directory ("" keeps the ring in memory only)
     flight_dir: str = ""
+    # per-pod SLO engine (obs/slo.py, docs/observability.md §7): mergeable
+    # latency digests per (band × stage) + burn-rate sentinel; ~µs/pod
+    # enabled, a no-op branch disabled
+    slo_enabled: bool = True
+    # objective overrides, "band=seconds[:target]" comma-separated — e.g.
+    # "default=30,high=20:0.995"; "" keeps the built-in defaults
+    # (system-critical 30s, high 45s, default 60s, all at 0.99)
+    slo_objectives: str = ""
+    # burn-rate windows and thresholds (multi-window multi-burn alerting:
+    # burning iff fast-window burn >= fast AND slow-window burn >= slow)
+    slo_fast_window_seconds: float = 60.0
+    slo_slow_window_seconds: float = 1800.0
+    slo_fast_burn: float = 6.0
+    slo_slow_burn: float = 1.0
     # AWS provider (options.go:45-49)
     aws_node_name_convention: str = "ip-name"  # ip-name | resource-name
     aws_eni_limited_pod_density: bool = True
@@ -124,10 +138,41 @@ class Options:
         if self.pipeline_chunk_items < 0:
             errs.append("pipeline-chunk-items must be >= 0 (0 disables "
                         f"chunking): {self.pipeline_chunk_items}")
+        if self.slo_fast_window_seconds <= 0 or self.slo_slow_window_seconds <= 0:
+            errs.append("slo-fast/slow-window-seconds must be > 0")
+        if self.slo_fast_burn <= 0 or self.slo_slow_burn <= 0:
+            errs.append("slo-fast/slow-burn must be > 0")
+        if self.slo_objectives:
+            try:
+                self.parse_slo_objectives()
+            except ValueError as e:
+                errs.append(f"slo-objectives invalid: {e}")
         if self.aws_node_name_convention not in ("ip-name", "resource-name"):
             errs.append(
                 f"aws-node-name-convention invalid: {self.aws_node_name_convention}")
         return errs
+
+    def parse_slo_objectives(self) -> dict:
+        """Parse ``slo_objectives`` ("band=seconds[:target]", comma-sep)
+        into ``{band: (threshold_s, target)}``. Raises ValueError on a
+        malformed entry (surfaced by validate())."""
+        out = {}
+        for entry in self.slo_objectives.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if "=" not in entry:
+                raise ValueError(f"expected band=seconds[:target]: {entry!r}")
+            band, _, rest = entry.partition("=")
+            threshold, _, target = rest.partition(":")
+            threshold_s = float(threshold)
+            target_f = float(target) if target else 0.99
+            if threshold_s <= 0:
+                raise ValueError(f"threshold must be > 0: {entry!r}")
+            if not (0.0 < target_f < 1.0):
+                raise ValueError(f"target must be in (0, 1): {entry!r}")
+            out[band.strip()] = (threshold_s, target_f)
+        return out
 
 
 def _env(name: str, default):
@@ -253,6 +298,28 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                    help="flight recorder dump directory for watchdog/"
                         "breaker/pressure-L3/chaos trips (empty = in-memory "
                         "ring only)")
+    p.add_argument("--slo-enabled", action=argparse.BooleanOptionalAction,
+                   default=_env("slo-enabled", defaults.slo_enabled),
+                   help="per-pod SLO engine (obs/slo.py): latency digests "
+                        "per band/stage + burn-rate sentinel")
+    p.add_argument("--slo-objectives",
+                   default=_env("slo-objectives", defaults.slo_objectives),
+                   help="objective overrides, band=seconds[:target] comma-"
+                        "separated (empty keeps built-in defaults)")
+    p.add_argument("--slo-fast-window-seconds", type=float,
+                   default=_env("slo-fast-window-seconds",
+                                defaults.slo_fast_window_seconds),
+                   help="fast burn-rate window")
+    p.add_argument("--slo-slow-window-seconds", type=float,
+                   default=_env("slo-slow-window-seconds",
+                                defaults.slo_slow_window_seconds),
+                   help="slow burn-rate window")
+    p.add_argument("--slo-fast-burn", type=float,
+                   default=_env("slo-fast-burn", defaults.slo_fast_burn),
+                   help="fast-window burn-rate trip threshold")
+    p.add_argument("--slo-slow-burn", type=float,
+                   default=_env("slo-slow-burn", defaults.slo_slow_burn),
+                   help="slow-window burn-rate trip threshold")
     p.add_argument("--aws-node-name-convention",
                    choices=["ip-name", "resource-name"],
                    default=_env("aws-node-name-convention",

@@ -53,6 +53,7 @@ from karpenter_tpu.metrics.gang import (
 )
 from karpenter_tpu.metrics.pressure import WINDOW_SPLITS_TOTAL
 from karpenter_tpu.metrics.registry import HISTOGRAMS
+from karpenter_tpu.obs import slo
 from karpenter_tpu.obs import trace as obtrace
 from karpenter_tpu.runtime.kubecore import (
     AlreadyExists, ApiError, KubeCore, NotFound,
@@ -112,6 +113,10 @@ class _ChunkPrep:
 
     schedules: list
     problems: List[Problem]
+    # the chunk's raw pod list, kept for per-pod SLO stamping in
+    # _observe_chunk (the schedules lists re-group pods per constraint set,
+    # losing the window-meta alignment)
+    pods: list = field(default_factory=list)
     dispatch_s: float = field(default=0.0)
     # gang co-pack half of the chunk: one batched device solve for every
     # complete pod group the scheduler grouped out of this chunk
@@ -279,10 +284,23 @@ class ProvisionerWorker:
         try:
             if not items or self._stop.is_set():
                 return None
+            # window marks: the batcher leaves per-pod (band, intake_s)
+            # aligned index-for-index with items; keyed by pod identity they
+            # follow the window across chunking/regrouping, and use_marks
+            # makes them reachable from every pipeline stage callback (and,
+            # via the BatchHandle capture, from the fetch side too)
+            meta = self.batcher.last_window_meta
+            self.batcher.last_window_meta = None
+            marks = None
+            if meta is not None and len(meta) == len(items):
+                marks = slo.WindowMarks(
+                    t_close=t_wait1,
+                    meta={id(it[1]): m for it, m in zip(items, meta)})
             wid = self._window_id = obtrace.new_window_id()
             shard = self.shard or "0"
             monitor = self.batcher._monitor()
-            with obtrace.window_span("provision", window_id=wid,
+            with slo.use_marks(marks), \
+                 obtrace.window_span("provision", window_id=wid,
                                      shard=shard,
                                      pressure_level=int(monitor.level()),
                                      pods=len(items)):
@@ -416,7 +434,7 @@ class ProvisionerWorker:
                     daemons=self._get_daemons(s.constraints))
                 for s in schedules
             ]
-        prep = _ChunkPrep(schedules=schedules, problems=problems)
+        prep = _ChunkPrep(schedules=schedules, problems=problems, pods=pods)
         if gang_scheds:
             prep.gang_enc, prep.gang_types = self._encode_gangs(gang_scheds)
         return prep
@@ -634,6 +652,40 @@ class ProvisionerWorker:
         HISTOGRAMS.histogram("binpacking_duration_seconds").observe(
             prep.dispatch_s + stats.get("device_s", 0.0),
             provisioner=self._engine().provisioner.metadata.name)
+        if slo.enabled():
+            self._stamp_chunk_slo(prep, stats)
+
+    def _stamp_chunk_slo(self, prep: _ChunkPrep, stats: dict) -> None:
+        """Fold the chunk into the SLO digests, reusing the pipeline's own
+        stage boundaries (stats t_dispatch/t_fetch/t_done, perf_counter)
+        against the window marks' close timestamp — no re-timing, no clock
+        mixing (intake_s is pre-computed by the batcher on its own clock).
+        Stage durations are shared chunk-wide, so they fold via one O(1)
+        weighted record per band; only e2e (intake varies per pod) is
+        per-pod."""
+        marks = slo.current_marks()
+        t_dispatch = stats.get("t_dispatch")
+        t_fetch = stats.get("t_fetch")
+        t_done = stats.get("t_done")
+        if marks is None or not prep.pods or t_dispatch is None \
+                or t_fetch is None or t_done is None:
+            return
+        schedule_s = max(0.0, t_dispatch - marks.t_close)
+        solve_s = max(0.0, t_fetch - t_dispatch)
+        bind_s = max(0.0, t_done - t_fetch)
+        tail_s = max(0.0, t_done - marks.t_close)
+        band_counts: Dict[str, int] = {}
+        for p in prep.pods:
+            m = marks.meta.get(id(p))
+            if m is None:
+                continue
+            band, intake_s = m
+            band_counts[band] = band_counts.get(band, 0) + 1
+            slo.record(band, "e2e", intake_s + tail_s)
+        for band, cnt in band_counts.items():
+            slo.record(band, "schedule", schedule_s, count=cnt)
+            slo.record(band, "solve", solve_s, count=cnt)
+            slo.record(band, "bind", bind_s, count=cnt)
 
     def _is_provisionable(self, candidate: Pod) -> bool:
         """Fresh read per pod to avoid duplicate binds (provisioner.go:

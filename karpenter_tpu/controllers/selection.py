@@ -18,8 +18,9 @@ from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.core import (
     Affinity, NodeAffinity, NodeSelectorRequirement, NodeSelectorTerm, Pod,
 )
+from karpenter_tpu.obs import slo
 from karpenter_tpu.ops import feasibility
-from karpenter_tpu.pressure import get_monitor
+from karpenter_tpu.pressure import classify, get_monitor
 from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
 from karpenter_tpu.utils import clock
 from karpenter_tpu.utils import pod as podutil
@@ -315,7 +316,11 @@ class SelectionController:
         if gate is None:
             # shed at admission (pressure level or depth bound) — already
             # counted by the batcher; the requeue retries once pressure
-            # falls, so a shed is a delay, never a loss
+            # falls, so a shed is a delay, never a loss. It still burns the
+            # band's error budget: a shed pod produces no latency sample,
+            # which would otherwise leave the burn sentinel blind to
+            # exactly the overload it exists to catch.
+            slo.note_shed(classify(pod)[0])
             return (f"shed at intake by provisioner/"
                     f"{chosen.metadata.name} (pressure)")
         if self.gate_timeout > 0:

@@ -136,7 +136,7 @@ def debug_vars() -> dict:
     device-ring counters, tracer and flight-recorder state."""
     import json  # noqa: F401 — callers json.dumps this; keep deps obvious
 
-    from karpenter_tpu.obs import flight, trace
+    from karpenter_tpu.obs import flight, slo, trace
     from karpenter_tpu.solver import pipeline as _pipeline
     from karpenter_tpu.solver.solve import solver_health
 
@@ -148,6 +148,7 @@ def debug_vars() -> dict:
         "ring": ring.counters() if ring is not None else None,
         "trace": trace.state(),
         "flight": flight.state(),
+        "slo": slo.state(),
     }
 
 
@@ -168,12 +169,25 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path in ("/healthz", "/readyz"):
             ok = self.manager is None or self.manager.healthz()
             level = int(pressure.get_monitor().level())
-            if self.path == "/readyz" and level >= 3:
-                # L3 = system-critical only: stop advertising readiness so
-                # load balancers drain non-critical traffic off this replica
-                # (liveness stays green — a restart would only make it worse)
-                ok = False
-            body = (f"{'ok' if ok else 'unhealthy'} level=L{level}").encode()
+            suffix = ""
+            if self.path == "/readyz":
+                if level >= 3:
+                    # L3 = system-critical only: stop advertising readiness
+                    # so load balancers drain non-critical traffic off this
+                    # replica (liveness stays green — a restart would only
+                    # make it worse)
+                    ok = False
+                from karpenter_tpu.obs import slo
+
+                burning = slo.burning()
+                if burning:
+                    # sustained SLO burn degrades readiness the same way:
+                    # the replica is falling behind its latency objectives
+                    # even if the pressure ladder hasn't caught up yet
+                    ok = False
+                    suffix = f" slo-burn={','.join(burning)}"
+            body = (f"{'ok' if ok else 'unhealthy'} "
+                    f"level=L{level}{suffix}").encode()
             self.send_response(200 if ok else 503)
             self.send_header("Content-Type", "text/plain")
         else:
@@ -214,12 +228,23 @@ def main(argv=None) -> int:
         kube = KubeCore()
     # observability wiring before any controller runs: the tracer and
     # flight recorder must see the first window (docs/observability.md)
-    from karpenter_tpu.obs import flight, trace
+    from karpenter_tpu.obs import flight, slo, trace
 
     if options.trace_enabled:
         trace.enable(jax_annotations=options.trace_jax)
     if options.flight_dir:
         flight.configure(dir=options.flight_dir)
+    objectives = None
+    if options.slo_objectives:
+        objectives = {
+            band: slo.Objective(threshold_s=t, target=tgt)
+            for band, (t, tgt) in options.parse_slo_objectives().items()}
+    slo.configure(enabled=options.slo_enabled,
+                  objectives=objectives,
+                  fast_window_s=options.slo_fast_window_seconds,
+                  slow_window_s=options.slo_slow_window_seconds,
+                  fast_burn=options.slo_fast_burn,
+                  slow_burn=options.slo_slow_burn)
     manager = build_manager(kube, options)
     server = serve_observability(manager, options.metrics_port)
     # opt-in XLA device tracing (KARPENTER_PROFILE_PORT, SURVEY.md §5.1);

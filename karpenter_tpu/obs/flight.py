@@ -13,10 +13,16 @@ Triggers (hooked at the source, see ISSUE 9):
   in ``solver/solve.py`` (this is also the instant the breaker opens).
 - ``pressure-l3`` — `PressureMonitor.evaluate()` rising into L3.
 - ``chaos-fault`` — a seeded fault firing in ``chaos/inject.py``.
+- ``slo-burn`` — the burn-rate sentinel in ``obs/slo.py`` finding a
+  band's fast AND slow windows past their burn thresholds; tagged with
+  the offending band, stage, burn rate, and a sample slow window's
+  trace id.
 
 Dumps are rate-limited (``min_interval_s``) because tier-1 tests trip
 watchdogs and fire chaos constantly; with no directory configured the
-recorder never touches the filesystem.
+recorder never touches the filesystem. ``slo-burn`` is limited on its
+own clock: a burn storm produces exactly one dump per interval without
+starving (or being starved by) a concurrent watchdog/chaos dump.
 """
 
 from __future__ import annotations
@@ -39,6 +45,7 @@ _TRIPS: deque = deque(maxlen=256)          # trigger records only
 _DIR: Optional[str] = os.environ.get("KARPENTER_FLIGHT_DIR") or None
 _MIN_INTERVAL_S = 5.0
 _LAST_DUMP = 0.0
+_LAST_DUMP_SLO = 0.0  # independent clock for the slo-burn trigger
 _TRIP_COUNT = 0
 
 
@@ -69,7 +76,7 @@ def trip(trigger: str, **tags: Any) -> Optional[str]:
     configured and the rate limit allows. Returns the dump path (or
     None). The active trace id, if any, rides along automatically so the
     dump names the poisoned window."""
-    global _LAST_DUMP, _TRIP_COUNT
+    global _LAST_DUMP, _LAST_DUMP_SLO, _TRIP_COUNT
     tid = trace.current_trace_id()
     if tid is not None and "trace_id" not in tags:
         tags["trace_id"] = tid
@@ -82,9 +89,14 @@ def trip(trigger: str, **tags: Any) -> Optional[str]:
         if _DIR is None:
             return None
         now = time.monotonic()
-        if now - _LAST_DUMP < _MIN_INTERVAL_S:
-            return None
-        _LAST_DUMP = now
+        if trigger == "slo-burn":
+            if now - _LAST_DUMP_SLO < _MIN_INTERVAL_S:
+                return None
+            _LAST_DUMP_SLO = now
+        else:
+            if now - _LAST_DUMP < _MIN_INTERVAL_S:
+                return None
+            _LAST_DUMP = now
         events = list(_EVENTS)
         seq = _TRIP_COUNT
     return _write_dump(trigger, tags, events, seq)
@@ -133,10 +145,11 @@ def state() -> Dict[str, Any]:
 def reset() -> None:
     """Tests: clear ring, trip history, and rate-limit state (the dump
     directory setting is left alone — pass configure() to change it)."""
-    global _LAST_DUMP, _TRIP_COUNT
+    global _LAST_DUMP, _LAST_DUMP_SLO, _TRIP_COUNT
     with _LOCK:
         _EVENTS.clear()
         _TRIPS.clear()
         _DUMPS.clear()
         _LAST_DUMP = 0.0
+        _LAST_DUMP_SLO = 0.0
         _TRIP_COUNT = 0

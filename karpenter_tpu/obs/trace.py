@@ -66,6 +66,16 @@ _PID_PREFIX = f"{os.getpid() & 0xFFFF:04x}"
 # importing flight (keeps this module a leaf).
 _SINKS: List[Any] = []
 
+# Dump extras let higher layers (obs.slo) ride their state into every
+# chrome dump's otherData without trace importing them (still a leaf).
+_DUMP_EXTRAS: Dict[str, Any] = {}
+
+
+def add_dump_extra(name: str, fn: Any) -> None:
+    """Register a callable whose result is embedded as
+    ``otherData[name]`` in every :func:`dump_chrome` payload."""
+    _DUMP_EXTRAS[name] = fn
+
 
 def new_window_id() -> str:
     """Cheap process-unique window id — available with tracing DISABLED
@@ -358,6 +368,11 @@ def dump_chrome(path: str) -> str:
                "displayTimeUnit": "ms",
                "otherData": {"tracer": "karpenter_tpu.obs.trace",
                              "spans": state()}}
+    for name, fn in list(_DUMP_EXTRAS.items()):
+        try:
+            payload["otherData"][name] = fn()
+        except Exception:
+            pass
     dirname = os.path.dirname(os.path.abspath(path))
     if dirname:
         os.makedirs(dirname, exist_ok=True)

@@ -54,6 +54,7 @@ from karpenter_tpu.cloudprovider.spi import Offering
 from karpenter_tpu.controllers.node import NodeController
 from karpenter_tpu.controllers.provisioning import ProvisioningController
 from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.obs import slo as obslo
 from karpenter_tpu.pressure.monitor import read_rss_bytes
 from karpenter_tpu.runtime.kubecore import KubeCore, NaiveKubeCore, NotFound
 from karpenter_tpu.runtime.manager import Manager
@@ -94,6 +95,15 @@ class ReplayConfig:
     flood_pool: int = 512         # distinct flood pod objects (cycled)
     gang_fraction: float = 0.0    # of the cohort: all-or-nothing pod groups
     gang_size: int = 4            # members per injected gang
+    # burn-sentinel objective overrides for this run, band -> threshold_s
+    # (None keeps whatever obs/slo.py has configured); the bench's seeded-
+    # chaos probe leg uses a deliberately impossible objective to prove
+    # the sentinel trips under fault injection
+    slo_objectives: Optional[Dict[str, float]] = None
+    # ALSO keep exact per-pod latency lists alongside the digests and
+    # report digest-vs-exact quantile parity (smoke runs only — at the
+    # million-pod shape the whole point is NOT materializing the lists)
+    slo_exact_check: bool = False
 
     def validate(self) -> None:
         if self.shards < 1:
@@ -240,6 +250,14 @@ def run_replay(cfg: ReplayConfig) -> dict:
     """
     cfg.validate()
     rng = random.Random(cfg.seed)
+    # fresh SLO ledger per run: digests, burn rings, and trip counters all
+    # start from zero so the report's clean-leg/chaos-leg gates are about
+    # THIS run (the objective map is restored in the finally block)
+    obslo.reset()
+    if cfg.slo_objectives is not None:
+        obslo.configure(objectives={
+            band: obslo.Objective(threshold_s=t)
+            for band, t in cfg.slo_objectives.items()})
     t_run0 = time.perf_counter()
     start_rss = read_rss_bytes()
     monitor = pressure.configure(pressure.PressureConfig(
@@ -274,6 +292,13 @@ def run_replay(cfg: ReplayConfig) -> dict:
     created_at: Dict[str, float] = {}
     band_of: Dict[str, str] = {}
     bound_at: Dict[str, float] = {}
+    # per-band pending→bound latency folds into fixed-memory mergeable
+    # digests AT BIND TIME — the exact per-pod latency lists of the old
+    # report never materialize (O(bands × digest) at any pod count)
+    lat_digest: Dict[str, obslo.Digest] = {b: obslo.Digest()
+                                           for b in COHORT_BANDS}
+    exact_lat: Optional[Dict[str, List[float]]] = (
+        {b: [] for b in COHORT_BANDS} if cfg.slo_exact_check else None)
     peak_level = 0
     peak_rss = start_rss
     churn_deleted = 0
@@ -300,7 +325,14 @@ def run_replay(cfg: ReplayConfig) -> dict:
                 try:
                     if core.read("Pod", name, "default",
                                  lambda p: bool(p.spec.node_name)):
-                        bound_at[name] = time.perf_counter()
+                        now = time.perf_counter()
+                        bound_at[name] = now
+                        band = band_of.get(name)
+                        if band in lat_digest:
+                            lat_s = now - created_at[name]
+                            lat_digest[band].record(lat_s)
+                            if exact_lat is not None:
+                                exact_lat[band].append(lat_s)
                 except NotFound:
                     pass
 
@@ -471,9 +503,45 @@ def run_replay(cfg: ReplayConfig) -> dict:
             for (_, band), n in dict(worker.batcher.shed).items():
                 shed[band] = shed.get(band, 0) + n
         latency = {
-            band: _quantiles([bound_at[n] - created_at[n]
-                              for n in bound_at if band_of[n] == band])
+            band: (lat_digest[band].report()
+                   if lat_digest[band].n else None)
             for band in COHORT_BANDS
+        }
+        digest_parity = None
+        if exact_lat is not None:
+            # smoke-run oracle: the digest quantiles must sit within the
+            # configured relative-error bound of the exact sorted lists
+            digest_parity = {"within_1pct": True}
+            for band in COHORT_BANDS:
+                ex = _quantiles(exact_lat[band])
+                if ex is None:
+                    continue
+                dg = lat_digest[band].report()
+                errs = {
+                    q: abs(dg[q] - ex[q]) / max(ex[q], 1e-9)
+                    for q in ("p50", "p99")}
+                digest_parity[band] = {f"{q}_rel_err": round(e, 5)
+                                       for q, e in errs.items()}
+                if max(errs.values()) > 0.01:
+                    digest_parity["within_1pct"] = False
+        # the SLO engine's bounded-growth claim, asserted at every scale:
+        # cells ≤ bands × stages and bins ≤ cells × max_bins, regardless
+        # of how many pods were offered
+        if obslo.enabled():
+            obslo.evaluate()
+        eng = obslo.engine()
+        slo_section = {
+            "records": eng.records_total(),
+            "cells": eng.cell_count(),
+            "total_bins": eng.total_bins(),
+            "bounded": (
+                eng.cell_count()
+                <= len(COHORT_BANDS + FLOOD_BANDS) * len(obslo.STAGES)
+                and eng.total_bins()
+                <= max(1, eng.cell_count()) * eng.max_bins),
+            "burning": obslo.burning(),
+            "trips": obslo.trips_total(),
+            "burn": obslo.state()["burn"],
         }
         gangs_full = sum(1 for ms in gang_members.values()
                          if all(n in bound_at for n in ms))
@@ -501,6 +569,8 @@ def run_replay(cfg: ReplayConfig) -> dict:
                 "partial_gangs": partial_gangs,
             },
             "store_ops": sampler.report(),
+            "slo": slo_section,
+            "slo_digest_parity": digest_parity,
             "rss_growth_mib": (peak_rss - start_rss) >> 20,
             "chaos_fired": ({f"{b}/{o}/{k}": n for (b, o, k), n
                              in plan.fired_counts().items()}
@@ -518,6 +588,8 @@ def run_replay(cfg: ReplayConfig) -> dict:
         manager.stop()
         core.unwatch(watch_q)
         pressure.set_monitor(None)
+        if cfg.slo_objectives is not None:
+            obslo.configure(objectives=obslo.default_objectives())
 
 
 # ---------------------------------------------------------------------------

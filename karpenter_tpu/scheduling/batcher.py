@@ -46,7 +46,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from karpenter_tpu.metrics.gang import (
     GANG_HOLD_SECONDS, GANGS_UNPLACEABLE_TOTAL)
 from karpenter_tpu.metrics.pressure import INTAKE_QUEUE_DEPTH, PODS_SHED_TOTAL
-from karpenter_tpu.obs import trace
+from karpenter_tpu.obs import slo, trace
 from karpenter_tpu.pressure import bands as _bands
 from karpenter_tpu.pressure.bands import BANDS, RANK
 
@@ -134,6 +134,12 @@ class Batcher:
         self.consumed_total = 0
         self.processed_total = 0
         self.shed: Dict[Tuple[str, str], int] = {}  # (reason, band) → count
+        # SLO side channel: (band, intake_seconds) per item of the LAST
+        # window, aligned index-for-index with wait()'s returned items.
+        # The worker reads it immediately after wait() on the same thread,
+        # before the next window can overwrite it. None while SLO stamping
+        # is disabled.
+        self.last_window_meta: Optional[List[Tuple[str, float]]] = None
 
     # -- pressure plumbing ---------------------------------------------------
     def _monitor(self):
@@ -239,6 +245,9 @@ class Batcher:
             # the displaced pod, not skip it as "already pending"
             self._pending_keys.discard(worst.key)
         self._count_shed_locked("displaced", worst.band)
+        # a displaced pod's latency objective is burning without ever
+        # producing a bind sample — feed the burn sentinel directly
+        slo.note_shed(worst.band)
 
     def contains(self, key: Any) -> bool:
         """True while an item added with ``key`` awaits a window. Returns
@@ -316,6 +325,7 @@ class Batcher:
                 if m.key is not None:
                     self._pending_keys.discard(m.key)
                 self._count_shed_locked(reason, m.band)
+                slo.note_shed(m.band)
             self._gang_first.pop(gkey, None)
             GANGS_UNPLACEABLE_TOTAL.inc(
                 reason="oversize" if reason == "gang-oversize"
@@ -412,6 +422,18 @@ class Batcher:
         self._note_depth(monitor, depth)
         window = now - start
         monitor.note_window(window)
+        # SLO intake stage: enqueue (first_seen, which persists across
+        # sheds so aging waits count) → this window close. The per-item
+        # metadata rides the side channel so the worker can stamp the
+        # downstream stages and e2e without re-deriving bands.
+        meta = None
+        if slo.enabled():
+            meta = []
+            for e in take:
+                intake_s = now - e.first_seen
+                slo.record(e.band, "intake", intake_s)
+                meta.append((e.band, intake_s))
+        self.last_window_meta = meta
         # instant event only (the caller owns the window span and records
         # the intake child retroactively): a trace shows each window close
         # with what the batcher knew — size, leftover depth, pressure rung

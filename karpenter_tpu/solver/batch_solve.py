@@ -50,6 +50,7 @@ from karpenter_tpu.solver.solve import (
     SolveResult, SolverConfig, materialize, resolved_device_max_shapes,
     solve_with_packables,
 )
+from karpenter_tpu.obs import slo as obslo
 from karpenter_tpu.obs import trace as obtrace
 from karpenter_tpu.utils.gcguard import gc_deferred
 from karpenter_tpu.utils.profiling import trace
@@ -233,8 +234,10 @@ class BatchHandle:
         self._results: Optional[List[SolveResult]] = None
         # the dispatching window's span context rides on the handle so the
         # fetch half — wherever (whichever thread) it runs — re-enters the
-        # same trace (obs/trace.py)
+        # same trace (obs/trace.py); the window's SLO marks ride the same
+        # way so digests recorded at fetch merge into the right cells
         self._trace_ctx = obtrace.current_context()
+        self._slo_marks = obslo.current_marks()
 
     @property
     def in_flight(self) -> bool:
@@ -246,6 +249,7 @@ class BatchHandle:
             return self._results
         hedge.note_fetching(self)
         with obtrace.use_context(self._trace_ctx), \
+                obslo.use_marks(self._slo_marks), \
                 obtrace.span("fetch", batched=len(self._batch_idx)):
             with gc_deferred():
                 self._results = self._fetch()

@@ -445,7 +445,7 @@ class SolvePipeline:
                 tp = time.perf_counter()
                 handle = dispatch(prep)
                 t1 = time.perf_counter()
-                stats = {"marshal_s": t1 - t0}
+                stats = {"marshal_s": t1 - t0, "t_dispatch": t1}
                 PIPELINE_STAGE_SECONDS.observe(t1 - t0, stage="marshal",
                                                **self._slabels)
                 trace.add_span("marshal", t0, tp, **self._slabels)
@@ -474,6 +474,10 @@ class SolvePipeline:
         t2 = time.perf_counter()
         stats["device_s"] = t1 - t0
         stats["launch_bind_s"] = t2 - t1
+        # absolute stage boundaries (perf_counter) so the worker's SLO
+        # stamps reuse the pipeline's own measurements instead of re-timing
+        stats["t_fetch"] = t1
+        stats["t_done"] = t2
         PIPELINE_STAGE_SECONDS.observe(t1 - t0, stage="device",
                                        **self._slabels)
         PIPELINE_STAGE_SECONDS.observe(t2 - t1, stage="launch_bind",

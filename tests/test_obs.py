@@ -457,6 +457,47 @@ class TestTraceview:
         assert r["overlap_s"] == pytest.approx(0.0, abs=1e-6)
         assert 0 < r["coverage"] <= 1.0
 
+    def test_render_shows_slo_digest_columns(self, tmp_path):
+        """A dump taken with live SLO digests carries them in otherData;
+        render() must grow slo_p50/slo_p99 columns mapped onto the trace
+        stages plus the cumulative footer."""
+        import io
+
+        from karpenter_tpu.obs import slo
+        from tools.traceview import analyze, render
+
+        slo.reset()
+        slo.enable()
+        try:
+            slo.record("default", "intake", 0.05, count=100)
+            slo.record("default", "solve", 0.2, count=100)
+            trace.enable()
+            wid = trace.new_window_id()
+            with trace.window_span("provision", window_id=wid):
+                with trace.span("intake"):
+                    time.sleep(0.002)
+                with trace.span("device_solve"):
+                    time.sleep(0.002)
+            path = trace.dump_chrome(str(tmp_path / "t.json"))
+            dump = json.loads(open(path).read())
+            assert dump["otherData"]["slo"]["records"] == 200
+            buf = io.StringIO()
+            render(analyze(dump["traceEvents"]), out=buf,
+                   slo=dump["otherData"]["slo"])
+            text = buf.getvalue()
+            assert "slo_p50" in text and "slo_p99" in text
+            assert "slo digests (cumulative" in text
+            # device_solve row maps to the 'solve' digest (~0.2s)
+            solve_row = next(line for line in text.splitlines()
+                             if line.strip().startswith("device_solve"))
+            assert "0.2" in solve_row, solve_row
+            # without a snapshot the table keeps its old shape
+            buf2 = io.StringIO()
+            render(analyze(dump["traceEvents"]), out=buf2)
+            assert "slo_p50" not in buf2.getvalue()
+        finally:
+            slo.reset()
+
 
 class TestDebugVars:
     def test_payload_shape_and_serializable(self):

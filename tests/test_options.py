@@ -83,3 +83,39 @@ class TestValidation:
 
     def test_zero_chunk_items_disables_chunking_and_is_valid(self):
         assert self._errs(pipeline_chunk_items=0) == []
+
+
+class TestSloOptions:
+    def test_defaults(self):
+        o = parse([])
+        assert o.slo_enabled is True
+        assert o.slo_objectives == ""
+        assert o.slo_fast_window_seconds == 60.0
+        assert o.slo_slow_window_seconds == 1800.0
+        assert (o.slo_fast_burn, o.slo_slow_burn) == (6.0, 1.0)
+
+    def test_objectives_parse_with_optional_target(self):
+        o = parse(["--slo-objectives",
+                   "default=30,high=20:0.995, system-critical = 10"])
+        assert o.parse_slo_objectives() == {
+            "default": (30.0, 0.99),
+            "high": (20.0, 0.995),
+            "system-critical": (10.0, 0.99)}
+
+    def test_flags_and_env(self, monkeypatch):
+        assert parse(["--no-slo-enabled"]).slo_enabled is False
+        monkeypatch.setenv("KARPENTER_SLO_OBJECTIVES", "default=45")
+        assert parse([]).parse_slo_objectives() == {"default": (45.0, 0.99)}
+
+    def test_malformed_objectives_fail_validation(self):
+        def errs(**kw):
+            return Options(cluster_name="c", cluster_endpoint="e",
+                           **kw).validate()
+        assert any("slo-objectives" in e
+                   for e in errs(slo_objectives="default=abc"))
+        assert any("slo-objectives" in e
+                   for e in errs(slo_objectives="default=30:1.5"))
+        assert any("slo-objectives" in e
+                   for e in errs(slo_objectives="default=-1"))
+        assert any("slo-fast/slow-window" in e
+                   for e in errs(slo_fast_window_seconds=0.0))

@@ -137,6 +137,49 @@ class TestVerdictCli:
         assert "FAIL" in v and "speedup" in v
 
 
+class TestReplaySlo:
+    def test_clean_leg_zero_trips_bounded_and_parity(self):
+        """Default objectives are generous: a fault-free replay must
+        produce ZERO burn trips (the pressure ladder shedding flood bands
+        is by design, not burn), bounded digest growth, and digest
+        quantiles within 1% of the exact per-pod lists. Chaos stays OFF:
+        an injected fault can legitimately push the ladder to L3, whose
+        objective'd-band sheds ARE burn — that's the probe leg's job."""
+        report = run_replay(ReplayConfig(
+            pods_total=1_200, shards=1, tenants=1, seed=7, bound_cohort=60,
+            churn_pods=60, max_depth=600, ticks=4, tick_sleep_s=0.05,
+            burst_ticks=1, chaos=False, settle_s=30.0, flood_pool=32,
+            slo_exact_check=True))
+        assert report["completed"], report
+        s = report["slo"]
+        assert s["trips"] == 0, f"clean leg tripped the sentinel: {s}"
+        assert s["burning"] == []
+        assert s["bounded"], f"digest growth unbounded: {s}"
+        assert s["records"] > 0, "engine never stamped a pod"
+        parity = report["slo_digest_parity"]
+        assert parity["within_1pct"], parity
+        # the slo verdict tool must accept the harness's own shape
+        from tools.slo_verdict import verdict as slo_verdict
+        v = slo_verdict({"replay": report, "slo_chaos": None})
+        assert "PASS" in v and "FAIL" not in v, v
+
+    def test_chaos_probe_trips_with_band_and_stage(self):
+        """An impossible objective (1ms e2e) is the seeded-chaos stand-in:
+        every bound pod breaches, the sentinel must trip, tagged."""
+        report = run_replay(ReplayConfig(
+            pods_total=800, shards=1, tenants=1, seed=7, bound_cohort=40,
+            churn_pods=40, max_depth=400, ticks=3, tick_sleep_s=0.05,
+            burst_ticks=1, chaos=True, settle_s=30.0, flood_pool=32,
+            slo_objectives={"default": 0.001}))
+        assert report["completed"], report
+        s = report["slo"]
+        assert s["trips"] >= 1, f"sentinel never tripped: {s}"
+        assert "default" in s["burning"]
+        tag = s["burn"]["last_trip"]
+        assert tag["band"] == "default" and tag["stage"] == "e2e"
+        assert tag["objective_s"] == 0.001
+
+
 @pytest.mark.slow
 class TestReplaySmoke:
     def test_10k_smoke_under_60s(self):
@@ -146,14 +189,22 @@ class TestReplaySmoke:
             pods_total=10_000, shards=2, tenants=4, seed=42,
             bound_cohort=200, churn_pods=500, max_depth=2_000, ticks=8,
             tick_sleep_s=0.1, burst_ticks=2, chaos=True, settle_s=60.0,
-            flood_pool=256)
+            flood_pool=256, slo_exact_check=True)
         t0 = time.monotonic()
         report = run_replay(cfg)
         wall = time.monotonic() - t0
         print(f"\nreplay-smoke: {report['offered_total']} pods in {wall:.1f}s "
               f"peak=L{report['peak_level']} "
-              f"recovery={report['recovery_to_l0_s']}s")
+              f"recovery={report['recovery_to_l0_s']}s "
+              f"slo={report['slo']['records']}rec "
+              f"parity={report['slo_digest_parity']['within_1pct']}")
         assert report["completed"], report
         assert report["system_critical_shed"] == 0
         assert report["offered_total"] >= 0.99 * cfg.pods_total
         assert wall < 60.0, f"smoke took {wall:.1f}s (budget 60s)"
+        # at 10k-pod scale the digests must stay bounded, clean, and
+        # within 1% of the exact latency lists
+        assert report["slo"]["trips"] == 0
+        assert report["slo"]["bounded"]
+        assert report["slo_digest_parity"]["within_1pct"], \
+            report["slo_digest_parity"]

@@ -1,0 +1,448 @@
+"""Per-pod SLO engine (karpenter_tpu/obs/slo.py, ISSUE 14).
+
+Covers: digest accuracy under fuzz (seeds 1/7/42), merge associativity
+and the shard/dispatch-fetch merge law, the collapse bound (fixed memory,
+tail fidelity preserved), cross-thread engine recording against a serial
+oracle, the burn sentinel (trip on sustained burn, zero trips on a clean
+run, trip rate-limit, sheds-as-breaches, the multi-window rule), the
+flight recorder's ``slo-burn`` trigger on its independent rate-limit
+clock (exactly one dump), readyz degradation while burning, window-marks
+context carry, and the stamping overhead bound.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import threading
+
+import pytest
+
+from karpenter_tpu.obs import flight, slo
+from karpenter_tpu.obs.slo import BurnSentinel, Digest, Objective, SloEngine
+from tools.slo_verdict import verdict as slo_verdict
+
+
+@pytest.fixture(autouse=True)
+def _clean_slo():
+    slo.reset()
+    slo.configure(enabled=True, objectives=slo.default_objectives(),
+                  fast_window_s=60.0, slow_window_s=1800.0,
+                  fast_burn=6.0, slow_burn=1.0, trip_interval_s=30.0)
+    flight.reset()
+    yield
+    slo.reset()
+    slo.configure(enabled=True, objectives=slo.default_objectives(),
+                  fast_window_s=60.0, slow_window_s=1800.0,
+                  fast_burn=6.0, slow_burn=1.0, trip_interval_s=30.0)
+    flight.reset()
+    flight.configure(dir="", min_interval_s=5.0)
+
+
+def _exact_quantile(vs, q):
+    """The replay report's rank convention — the digest promises to land
+    within alpha relative error of THIS number."""
+    vs = sorted(vs)
+    return vs[min(len(vs) - 1, int(len(vs) * q))]
+
+
+class TestDigest:
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_fuzz_quantiles_within_1pct(self, seed):
+        rng = random.Random(seed)
+        d = Digest()
+        vs = [rng.lognormvariate(0.0, 2.0) for _ in range(20_000)]
+        for v in vs:
+            d.record(v)
+        for q in (0.5, 0.9, 0.99, 0.999):
+            exact = _exact_quantile(vs, q)
+            est = d.quantile(q)
+            assert abs(est - exact) / exact <= 0.01, \
+                f"seed {seed} q{q}: {est} vs exact {exact}"
+        assert d.n == len(vs)
+        top = max(vs)
+        assert abs(d.quantile(1.0) - top) / top <= 0.01
+
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_merge_associative_and_exact(self, seed):
+        rng = random.Random(seed)
+        parts = [[rng.expovariate(1.0) for _ in range(777)] for _ in range(3)]
+        digs = []
+        for part in parts:
+            d = Digest()
+            for v in part:
+                d.record(v)
+            digs.append(d)
+        left = digs[0].copy().merge(digs[1]).merge(digs[2])
+        right = digs[0].copy().merge(digs[1].copy().merge(digs[2]))
+        # bucket counts are integers: merge must be EXACTLY associative
+        # (total is a float sum — order-sensitive — so not compared)
+        assert left.counts == right.counts
+        assert (left.n, left.zero) == (right.n, right.zero)
+        assert (left.vmin, left.vmax) == (right.vmin, right.vmax)
+        # and the merge must equal recording everything into one digest
+        one = Digest()
+        for part in parts:
+            for v in part:
+                one.record(v)
+        assert left.counts == one.counts and left.n == one.n
+
+    def test_record_n_equals_repeated_record(self):
+        a, b = Digest(), Digest()
+        a.record_n(0.125, 5)
+        a.record_n(3.5, 2)
+        for v in (0.125,) * 5 + (3.5,) * 2:
+            b.record(v)
+        assert a.counts == b.counts
+        assert (a.n, a.zero, a.vmin, a.vmax) == (b.n, b.zero, b.vmin, b.vmax)
+
+    def test_collapse_bounds_memory_keeps_tail(self):
+        rng = random.Random(7)
+        d = Digest(max_bins=512)
+        vs = [rng.lognormvariate(0.0, 3.0) for _ in range(50_000)]
+        for v in vs:
+            d.record(v)
+        # ~1500 natural buckets for this spread: the collapse must have
+        # actually fired and held the budget
+        assert d.bins() <= 512, "collapse must hold the bin budget"
+        # low buckets fold upward, so quantiles above the collapsed
+        # region — the tail the SLO reads — keep the accuracy promise
+        for q in (0.99, 0.999):
+            exact = _exact_quantile(vs, q)
+            assert abs(d.quantile(q) - exact) / exact <= 0.01, q
+        # below the fold the estimate may only err HIGH (mass moved up),
+        # never low — a breach can't be hidden by the collapse
+        assert d.quantile(0.05) >= _exact_quantile(vs, 0.05) * 0.99
+
+    def test_zero_bucket_and_empty(self):
+        d = Digest()
+        assert d.report() == {"p50": 0.0, "p99": 0.0, "max": 0.0, "n": 0}
+        for _ in range(10):
+            d.record(0.0)
+        d.record(5.0)
+        assert d.zero == 10
+        assert d.quantile(0.5) == 0.0
+        assert abs(d.quantile(1.0) - 5.0) / 5.0 <= 0.01
+        assert d.report()["max"] == 5.0
+
+    def test_roundtrip_and_alpha_mismatch(self):
+        d = Digest()
+        for v in (0.1, 1.0, 10.0):
+            d.record(v)
+        back = Digest.from_dict(json.loads(json.dumps(d.to_dict())))
+        assert back.counts == d.counts and back.n == d.n
+        with pytest.raises(ValueError):
+            d.merge(Digest(alpha=0.02))
+
+
+class TestEngine:
+    def test_cross_thread_matches_serial_oracle(self):
+        """Four threads hammer the striped engine; the result must be
+        bucket-identical to one thread recording the same samples."""
+        eng = SloEngine()
+        per_thread = 2_000
+
+        def work(tseed):
+            rng = random.Random(tseed)
+            for _ in range(per_thread):
+                band = rng.choice(("default", "high"))
+                eng.record(band, "e2e", rng.expovariate(2.0))
+
+        threads = [threading.Thread(target=work, args=(s,))
+                   for s in (1, 7, 42, 99)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        oracle = {}
+        for s in (1, 7, 42, 99):
+            rng = random.Random(s)
+            for _ in range(per_thread):
+                band = rng.choice(("default", "high"))
+                v = rng.expovariate(2.0)
+                oracle.setdefault(band, Digest()).record(v)
+        for band, d in oracle.items():
+            got = eng.digest(band, "e2e")
+            assert got is not None
+            assert got.counts == d.counts and got.n == d.n, \
+                f"striped recording lost samples for {band}"
+
+    def test_merge_from_is_shard_aggregation(self):
+        a, b = SloEngine(), SloEngine()
+        a.record("default", "e2e", 0.5, count=10)
+        b.record("default", "e2e", 2.0, count=10)
+        b.record("high", "bind", 0.1)
+        a.merge_from(b)
+        assert a.digest("default", "e2e").n == 20
+        assert a.digest("high", "bind").n == 1
+        assert b.digest("high", "bind").n == 1, "source must be untouched"
+
+    def test_growth_invariant(self):
+        eng = SloEngine()
+        rng = random.Random(42)
+        bands = ("system-critical", "high", "default", "low", "besteffort")
+        for _ in range(5_000):
+            eng.record(rng.choice(bands), rng.choice(slo.STAGES),
+                       rng.lognormvariate(0.0, 2.0))
+        assert eng.cell_count() <= len(bands) * len(slo.STAGES)
+        assert eng.total_bins() <= eng.cell_count() * eng.max_bins
+        snap = eng.snapshot()
+        assert snap["records"] == 5_000
+        assert set(snap["stages"]) <= set(slo.STAGES)
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestBurnSentinel:
+    def test_trips_on_sustained_burn_with_tags(self):
+        clk = _Clock()
+        s = BurnSentinel({"default": Objective(1.0, target=0.99)},
+                         trip_interval_s=30.0, timefunc=clk)
+        for _ in range(50):
+            s.observe("default", 5.0)
+        burn = s.evaluate()
+        assert burn["default"]["burning"]
+        assert burn["default"]["fast_burn"] >= 6.0
+        assert s.burning() == ["default"]
+        assert s.trips_total() == 1
+        tags = s.last_trip_tags()
+        assert tags["band"] == "default" and tags["stage"] == "e2e"
+        assert tags["objective_s"] == 1.0
+
+    def test_clean_run_never_trips(self):
+        clk = _Clock()
+        s = BurnSentinel({"default": Objective(1.0)}, timefunc=clk)
+        for _ in range(500):
+            s.observe("default", 0.01)
+        assert not s.evaluate()["default"]["burning"]
+        assert s.trips_total() == 0 and s.burning() == []
+
+    def test_trip_rate_limit_and_rearm(self):
+        clk = _Clock()
+        s = BurnSentinel({"default": Objective(1.0)},
+                         trip_interval_s=30.0, timefunc=clk)
+        for _ in range(50):
+            s.observe("default", 5.0)
+        s.evaluate()
+        clk.t += 5.0
+        s.observe("default", 5.0)
+        s.evaluate()
+        assert s.trips_total() == 1, "re-trip inside the interval"
+        clk.t += 31.0
+        s.observe("default", 5.0)
+        s.evaluate()
+        assert s.trips_total() == 2, "interval elapsed: sentinel re-arms"
+
+    def test_shed_counts_as_breach(self):
+        clk = _Clock()
+        s = BurnSentinel({"default": Objective(60.0)}, timefunc=clk)
+        for _ in range(20):
+            s.observe("default", shed=True)
+        assert s.breaches_total() == 20
+        assert s.evaluate()["default"]["burning"], \
+            "sheds burn budget without ever producing a latency sample"
+
+    def test_bands_without_objective_ignored(self):
+        s = BurnSentinel({"default": Objective(1.0)}, timefunc=_Clock())
+        for _ in range(100):
+            s.observe("besteffort", 999.0)
+            s.observe("low", shed=True)
+        assert s.evaluate() == {}
+        assert s.breaches_total() == 0, \
+            "pressure-ladder sheds of flood bands must not read as burn"
+
+    def test_multi_window_rule_fast_spike_ages_out(self):
+        """Breaches older than the fast window stop the fast burn even
+        though the slow window still remembers them — no lingering alert."""
+        clk = _Clock()
+        s = BurnSentinel({"default": Objective(1.0)},
+                         fast_window_s=60.0, slow_window_s=1800.0,
+                         timefunc=clk)
+        for _ in range(50):
+            s.observe("default", 5.0)
+        assert s.evaluate()["default"]["burning"]
+        clk.t += 120.0  # spike ages past the fast window
+        for _ in range(50):
+            s.observe("default", 0.01)
+        burn = s.evaluate()
+        assert not burn["default"]["burning"]
+        assert burn["default"]["slow_burn"] > burn["default"]["fast_burn"]
+        assert s.burning() == []
+
+
+class TestFlightIntegration:
+    def test_slo_burn_trips_exactly_one_dump(self, tmp_path):
+        """Regression: the slo-burn trigger rides an INDEPENDENT
+        rate-limit clock — a prior watchdog dump must not swallow it,
+        and rapid re-evaluation must not double-dump."""
+        flight.configure(dir=str(tmp_path), min_interval_s=5.0)
+        flight.trip("watchdog-trip", reason="warm-up-the-shared-clock")
+        slo.configure(objectives={"default": Objective(0.001)},
+                      trip_interval_s=0.0)
+        for _ in range(50):
+            slo.record("default", "e2e", 1.0)
+        slo.evaluate()
+        slo.evaluate()  # immediate re-trip: dump must be rate-limited
+        dumps = [p for p in flight.recent_dumps() if "slo-burn" in p]
+        assert len(dumps) == 1, f"expected exactly one slo-burn dump: {dumps}"
+        payload = json.loads(open(dumps[0]).read())
+        assert payload["trigger"] == "slo-burn"
+        assert payload["tags"]["band"] == "default"
+        assert payload["tags"]["stage"] == "e2e"
+        assert payload["tags"]["burn_rate"] >= 6.0
+        assert flight.state()["last_trigger"] == "slo-burn"
+
+    def test_readyz_degrades_while_burning(self):
+        """A burning band flips /readyz to 503 with the band named;
+        /healthz (liveness) stays green — a restart would only hurt."""
+        from http.server import HTTPServer
+
+        from karpenter_tpu.main import _Handler
+
+        slo.configure(objectives={"default": Objective(0.001)})
+        for _ in range(50):
+            slo.record("default", "e2e", 1.0)
+        slo.evaluate()
+        assert slo.burning() == ["default"]
+
+        _Handler.manager = None
+        srv = HTTPServer(("127.0.0.1", 0), _Handler)
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        try:
+            def get(path):
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", srv.server_address[1], timeout=5.0)
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                body = resp.read().decode()
+                conn.close()
+                return resp.status, body
+
+            status, body = get("/readyz")
+            assert status == 503
+            assert "slo-burn=default" in body
+            status, body = get("/healthz")
+            assert status == 200 and body.startswith("ok")
+
+            slo.reset()
+            status, body = get("/readyz")
+            assert status == 200, "recovered burn must restore readiness"
+        finally:
+            srv.shutdown()
+            thread.join(timeout=5.0)
+
+    def test_debug_vars_carries_slo_state(self):
+        from karpenter_tpu.main import debug_vars
+
+        slo.record("default", "e2e", 0.25)
+        dv = debug_vars()
+        assert dv["slo"]["enabled"] is True
+        assert dv["slo"]["engine"]["records"] >= 1
+        assert "objectives" in dv["slo"]["burn"]
+
+
+class TestModuleApi:
+    def test_disabled_records_nothing(self):
+        slo.disable()
+        before = slo.record_calls()
+        slo.record("default", "e2e", 1.0)
+        slo.note_shed("default")
+        assert slo.record_calls() == before
+        assert slo.engine().records_total() == 0
+        assert slo.sentinel().breaches_total() == 0
+
+    def test_marks_carry_across_threads(self):
+        pod = object()
+        marks = slo.WindowMarks(t_close=12.5, meta={id(pod): ("high", 0.3)})
+        seen = {}
+
+        def fetch_side():
+            with slo.use_marks(marks):
+                seen["marks"] = slo.current_marks()
+            seen["after"] = slo.current_marks()
+
+        t = threading.Thread(target=fetch_side)
+        t.start()
+        t.join()
+        assert seen["marks"] is marks
+        assert seen["marks"].meta[id(pod)] == ("high", 0.3)
+        assert seen["after"] is None
+        with slo.use_marks(None):  # no-op carry must not clobber
+            assert slo.current_marks() is None
+
+    def test_overhead_is_bounded(self):
+        """The pipeline verdict gates measured-calls × ns/call at < 1% of
+        the stamped wall; here pin the per-call costs to sane ceilings so
+        a 100× stamping regression fails fast in tier-1."""
+        over = slo.measure_overhead(n=5_000)
+        assert over["disabled_ns_per_record"] < 5_000, over
+        assert over["enabled_ns_per_record"] < 100_000, over
+        # ~20 stamp calls per provisioning window (bands × stages + e2e):
+        # even a 10ms window keeps the tax well under the 1% gate
+        assert 20 * over["enabled_ns_per_record"] / 1e9 < 0.01 * 0.010, over
+
+
+class TestSloVerdict:
+    def _line(self, **kw):
+        replay = {
+            "pending_to_bound_s": {"default": {"p50": 0.1, "p99": 0.7,
+                                               "max": 1.0, "n": 100}},
+            "slo": {"records": 100, "cells": 5, "total_bins": 50,
+                    "bounded": True, "burning": [], "trips": 0,
+                    "burn": {"objectives": {"default": {
+                        "threshold_s": 60.0, "target": 0.99,
+                        "stage": "e2e"}}}},
+            "slo_digest_parity": {"within_1pct": True,
+                                  "default": {"p50_rel_err": 0.004,
+                                              "p99_rel_err": 0.006}},
+        }
+        chaos = {"trips": 1, "readyz_degraded": True,
+                 "last_trip": {"band": "default", "stage": "e2e"}}
+        line = {"replay": replay, "slo_chaos": chaos}
+        line.update(kw)
+        return line
+
+    def test_pass_shape(self):
+        v = slo_verdict(self._line())
+        assert "PASS" in v and "FAIL" not in v, v
+        assert "parity=0.60%" in v and "chaos trips=1" in v
+
+    def test_clean_trip_fails(self):
+        line = self._line()
+        line["replay"]["slo"]["trips"] = 2
+        v = slo_verdict(line)
+        assert "FAIL" in v and "clean leg" in v
+
+    def test_unbounded_growth_fails(self):
+        line = self._line()
+        line["replay"]["slo"]["bounded"] = False
+        assert "UNBOUNDED" in slo_verdict(line)
+
+    def test_p99_over_objective_fails(self):
+        line = self._line()
+        line["replay"]["pending_to_bound_s"]["default"]["p99"] = 61.0
+        v = slo_verdict(line)
+        assert "FAIL" in v and "objective" in v
+
+    def test_chaos_never_tripping_fails(self):
+        line = self._line()
+        line["slo_chaos"] = {"trips": 0, "readyz_degraded": False,
+                             "last_trip": None}
+        v = slo_verdict(line)
+        assert "FAIL" in v and "never tripped" in v
+
+    def test_absent_probe_and_parity_are_na(self):
+        line = self._line()
+        line["slo_chaos"] = None
+        del line["replay"]["slo_digest_parity"]
+        v = slo_verdict(line)
+        assert "PASS" in v and "parity=n/a" in v and "chaos n/a" in v

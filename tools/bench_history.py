@@ -77,6 +77,33 @@ def _filter_cell(extra: dict) -> str:
     return f"{cfg['speedup']}x/{par}/a{cfg.get('steady_allocations', '?')}"
 
 
+def _slo_cell(extra: dict) -> str:
+    """Compressed SLO column (config_9 replay + chaos probe, round 14+):
+    clean-leg sentinel trips, chaos-probe trips, worst digest-parity
+    relative error — 't0/c1/p0.58%'. '!' flags a clean-leg trip or broken
+    parity; '-' when the SLO engine never reported."""
+    cfg = extra.get("config_9_million_pod_replay")
+    if not isinstance(cfg, dict):
+        return "-"
+    slo = (cfg.get("replay") or {}).get("slo") if isinstance(
+        cfg.get("replay"), dict) else None
+    if not isinstance(slo, dict):
+        return "-"
+    trips = slo.get("trips", "?")
+    trip_s = f"t{trips}" + ("!" if trips not in (0, "?") else "")
+    chaos = cfg.get("slo_chaos")
+    chaos_s = (f"/c{chaos.get('trips', '?')}"
+               if isinstance(chaos, dict) else "")
+    parity = (cfg.get("replay") or {}).get("slo_digest_parity")
+    parity_s = ""
+    if isinstance(parity, dict):
+        worst = max((e for band in parity.values() if isinstance(band, dict)
+                     for e in band.values()), default=0.0)
+        parity_s = (f"/p{worst * 100:.2f}%"
+                    + ("" if parity.get("within_1pct") else "!"))
+    return f"{trip_s}{chaos_s}{parity_s}"
+
+
 def _from_tail(tail: str):
     """Best-effort recovery of the bench JSON line from a captured stdout
     tail: parse from the LAST '{"metric"' occurrence (the line is emitted
@@ -122,7 +149,8 @@ def load_rows(root: str) -> list:
                     "metric": f"(tail truncated, rc={line.get('rc')})",
                     "value": None, "unit": "", "device_count": None,
                     "backend": "?", "degraded": None, "configs": "-",
-                    "marshal": "-", "gang": "-", "filter": "-"})
+                    "marshal": "-", "gang": "-", "filter": "-",
+                    "slo": "-"})
                 continue
             line = inner
         extra = line.get("extra", {}) if isinstance(line, dict) else {}
@@ -139,6 +167,7 @@ def load_rows(root: str) -> list:
             "marshal": _marshal_cell(extra),
             "gang": _gang_cell(extra),
             "filter": _filter_cell(extra),
+            "slo": _slo_cell(extra),
         })
     for b in bad:
         print(f"bench-history: skipped {b}", file=sys.stderr)
@@ -149,7 +178,7 @@ def load_rows(root: str) -> list:
 def render(rows: list) -> str:
     headers = ["round", "variant", "metric", "value", "unit",
                "device_count", "backend", "degraded", "configs", "marshal",
-               "gang", "filter"]
+               "gang", "filter", "slo"]
     table = [headers] + [
         ["" if r[h] is None else str(r[h]) for h in headers] for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(headers))]

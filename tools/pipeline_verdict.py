@@ -20,6 +20,7 @@ import sys
 
 GATE_SPEEDUP = 1.2
 GATE_DEVICES = 2
+GATE_SLO_TAX_PCT = 1.0
 
 
 def verdict(line: dict) -> str:
@@ -43,16 +44,26 @@ def verdict(line: dict) -> str:
                  f"{ring.get('refills', '?')} refills")
     tax = (cfg.get("trace_overhead") or {}).get("est_tax_pct")
     tax_note = f" trace_tax={tax}%" if tax is not None else ""
+    slo_tax = (cfg.get("slo_overhead") or {}).get("est_tax_pct")
+    slo_note = f" slo_tax={slo_tax}%" if slo_tax is not None else ""
     head = (f"pipeline A/B: {speedup}x (depth {ab.get('depth_pipelined')} "
             f"vs {ab.get('depth_serial')}) devices={devices} "
             f"nodes_equal={nodes_equal} fallbacks={fallbacks} "
-            f"{ring_note}{tax_note}")
+            f"{ring_note}{tax_note}{slo_note}")
+    # enabled-path SLO stamping must stay under 1% of the stamped run's
+    # wall — gated whenever the bench measured it, even at 1 device
+    slo_fail = (slo_tax is not None and slo_tax > GATE_SLO_TAX_PCT)
     if devices is None or devices < GATE_DEVICES:
+        if slo_fail:
+            return (f"{head} — FAIL (slo_tax {slo_tax}% > "
+                    f"{GATE_SLO_TAX_PCT}%)")
         return (f"{head} — GATE N/A (needs device_count >= {GATE_DEVICES}; "
                 "rerun with --devices 2)")
     ok = (speedup is not None and speedup > GATE_SPEEDUP
-          and nodes_equal and fallbacks == "none")
-    return f"{head} — {'PASS' if ok else 'FAIL'} (gate >{GATE_SPEEDUP}x)"
+          and nodes_equal and fallbacks == "none" and not slo_fail)
+    tail = (f" (slo_tax {slo_tax}% > {GATE_SLO_TAX_PCT}%)"
+            if slo_fail else f" (gate >{GATE_SPEEDUP}x)")
+    return f"{head} — {'PASS' if ok else 'FAIL'}{tail}"
 
 
 def main() -> int:

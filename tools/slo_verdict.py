@@ -1,0 +1,129 @@
+"""SLO verdict: one human-readable gate line for the per-pod SLO engine.
+
+``make bench-replay`` pipes bench.py (``--only config_9``) through
+tools/replay_verdict.py and then through this filter. The JSON passes
+through UNCHANGED on stdout (redirects still capture it); the verdict
+goes to stderr:
+
+    slo: clean trips=0 p99 ok (3 bands) parity=0.58% bounded \
+chaos trips=1 band=default/e2e readyz=degraded — PASS
+
+PASS needs (the per-pod SLO engine acceptance gates):
+- clean leg: per-band pending→bound p99 within the band's configured
+  objective, ZERO burn-sentinel trips, and the engine's bounded-growth
+  invariant held (cells ≤ bands × stages, bins ≤ cells × max_bins);
+- digest parity (smoke runs): digest p50/p99 within 1% relative error
+  of the exact per-pod latency lists (absent on full-scale runs, where
+  the lists never materialize — gate N/A, labelled);
+- seeded-chaos probe leg: ≥ 1 sentinel trip, tagged with the offending
+  band and stage, and readyz degraded while burning (absent probe leg →
+  gate N/A, labelled).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+GATE_PARITY_REL_ERR = 0.01
+
+
+def _extract(line: dict):
+    """(replay report, slo_chaos) from either accepted shape: the bench
+    line (config_9 under extra) or tools/replay.py's direct output."""
+    if "replay" in line:
+        return line.get("replay"), line.get("slo_chaos")
+    cfg = line.get("extra", {}).get("config_9_million_pod_replay", {})
+    return cfg.get("replay"), cfg.get("slo_chaos")
+
+
+def verdict(line: dict) -> str:
+    replay, chaos = _extract(line)
+    if not replay:
+        return "slo: no replay report in input — NO VERDICT"
+    slo = replay.get("slo") or {}
+    burn = slo.get("burn") or {}
+    objectives = burn.get("objectives") or {}
+    latency = replay.get("pending_to_bound_s") or {}
+    problems = []
+
+    # clean leg: per-band p99 within the configured objective
+    bands_checked = 0
+    for band, obj in objectives.items():
+        rep = latency.get(band)
+        if not rep or not rep.get("n"):
+            continue
+        bands_checked += 1
+        if rep["p99"] > obj["threshold_s"]:
+            problems.append(f"{band} p99 {rep['p99']}s > objective "
+                            f"{obj['threshold_s']}s")
+
+    # clean leg: zero sentinel trips, bounded digest growth
+    trips = slo.get("trips", 0)
+    if trips != 0:
+        problems.append(f"{trips} burn trip(s) on the clean leg")
+    if not slo.get("bounded", False):
+        problems.append(f"digest growth unbounded (cells={slo.get('cells')} "
+                        f"bins={slo.get('total_bins')})")
+
+    # digest-vs-exact parity (smoke legs only)
+    parity = replay.get("slo_digest_parity")
+    parity_cell = "n/a"
+    if parity is not None:
+        worst = max((e for band in parity.values() if isinstance(band, dict)
+                     for e in band.values()), default=0.0)
+        parity_cell = f"{worst * 100:.2f}%"
+        if not parity.get("within_1pct", False):
+            problems.append(f"digest parity {parity_cell} > "
+                            f"{GATE_PARITY_REL_ERR:.0%} of exact quantiles")
+
+    # seeded-chaos probe leg: the sentinel must trip, tagged, and degrade
+    chaos_cell = "n/a"
+    if chaos is not None:
+        ctrips = chaos.get("trips", 0)
+        tag = chaos.get("last_trip") or {}
+        chaos_cell = (f"trips={ctrips} band={tag.get('band')}/"
+                      f"{tag.get('stage')} readyz="
+                      + ("degraded" if chaos.get("readyz_degraded")
+                         else "ok"))
+        if ctrips < 1:
+            problems.append("chaos probe never tripped the sentinel")
+        elif not tag.get("band") or not tag.get("stage"):
+            problems.append(f"chaos trip untagged: {tag}")
+        if ctrips >= 1 and not chaos.get("readyz_degraded"):
+            problems.append("sentinel tripped but readyz never degraded")
+
+    head = (f"slo: clean trips={trips} p99 ok ({bands_checked} bands) "
+            f"parity={parity_cell} "
+            f"{'bounded' if slo.get('bounded') else 'UNBOUNDED'} "
+            f"chaos {chaos_cell}")
+    if problems:
+        return f"{head} — FAIL ({'; '.join(problems)})"
+    return f"{head} — PASS"
+
+
+def main() -> int:
+    last = None
+    for raw in sys.stdin:
+        sys.stdout.write(raw)  # pass-through: stdout stays the pure JSON
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            line = json.loads(raw)
+            if isinstance(line, dict) and ("metric" in line
+                                           or "replay" in line):
+                last = line
+        except ValueError:
+            continue
+    sys.stdout.flush()
+    if last is None:
+        print("slo: no JSON line on stdin — NO VERDICT", file=sys.stderr)
+        return 1
+    out = verdict(last)
+    print(out, file=sys.stderr)
+    return 0 if "FAIL" not in out and "NO VERDICT" not in out else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,14 @@ from typing import Any, Dict, List, Optional, Tuple
 # Window-root span names emitted by the controllers/bench.
 WINDOW_KINDS = ("provision", "consolidate", "replay")
 
+# Trace stage span name -> SLO engine stage (karpenter_tpu.obs.slo.STAGES).
+# The trace decomposes the window finer than the SLO engine stamps it, so
+# several spans share one digest column (schedule = close->dispatch covers
+# feasibility, marshal, and dispatch).
+_SLO_STAGE = {"intake": "intake", "feasibility": "schedule",
+              "marshal": "schedule", "dispatch": "schedule",
+              "device_solve": "solve", "launch_bind": "bind", "bind": "bind"}
+
 
 def _spans(events: List[dict]) -> List[dict]:
     return [e for e in events if e.get("ph") == "X" and "dur" in e]
@@ -110,10 +118,12 @@ def analyze(events: List[dict]) -> List[Dict[str, Any]]:
     return reports
 
 
-def render(reports: List[Dict[str, Any]], out=sys.stdout) -> None:
+def render(reports: List[Dict[str, Any]], out=sys.stdout,
+           slo: Optional[Dict[str, Any]] = None) -> None:
     if not reports:
         print("traceview: no window traces in dump", file=out)
         return
+    slo_stages = (slo or {}).get("stages") or {}
     print(f"traceview: {len(reports)} window(s)", file=out)
     for r in reports:
         tags = r["tags"]
@@ -124,18 +134,32 @@ def render(reports: List[Dict[str, Any]], out=sys.stdout) -> None:
         print(f"\nwindow {r['window']} ({r['kind']}) "
               f"wall={r['wall_s']:.4f}s overlap={r['overlap_s']:.4f}s "
               f"coverage={r['coverage']:.1%}{extra}", file=out)
-        print(f"  {'stage':<16}{'total_s':>10}{'% wall':>9}{'critical_s':>12}",
-              file=out)
+        slo_head = (f"{'slo_p50':>10}{'slo_p99':>10}" if slo_stages else "")
+        print(f"  {'stage':<16}{'total_s':>10}{'% wall':>9}"
+              f"{'critical_s':>12}{slo_head}", file=out)
         wall = r["wall_s"] or 1.0
         crit = r["critical_path"]
         for name in sorted(r["stages"], key=lambda n: r["first_ts"][n]):
             tot = r["stages"][name]
+            slo_cols = ""
+            if slo_stages:
+                rep = slo_stages.get(_SLO_STAGE.get(name, ""))
+                slo_cols = (f"{rep['p50']:>10.4f}{rep['p99']:>10.4f}"
+                            if rep and rep.get("n") else f"{'-':>10}{'-':>10}")
             print(f"  {name:<16}{tot:>10.4f}{tot / wall:>8.1%}"
-                  f"{crit.get(name, 0.0):>12.4f}", file=out)
+                  f"{crit.get(name, 0.0):>12.4f}{slo_cols}", file=out)
         path = " -> ".join(
             f"{n}({crit[n]:.3f}s)"
             for n in sorted(crit, key=lambda n: r["first_ts"].get(n, 0.0)))
         print(f"  critical path: {path}", file=out)
+    if slo_stages:
+        # Digest columns are PROCESS-CUMULATIVE (every pod since the last
+        # engine reset), unlike the per-window span totals above them.
+        summary = "  ".join(
+            f"{s}: p50={rep['p50']:.4f}s p99={rep['p99']:.4f}s n={rep['n']}"
+            for s, rep in slo_stages.items() if rep.get("n"))
+        print(f"\nslo digests (cumulative, all bands merged): {summary}",
+              file=out)
 
 
 def _find_key(obj: Any, key: str) -> Optional[Any]:
@@ -189,12 +213,13 @@ def _bench_mode() -> int:
         return 1
     try:
         with open(dump_path) as f:
-            events = json.load(f).get("traceEvents", [])
+            dump = json.load(f)
     except OSError as e:
         print(f"traceview: cannot read {dump_path}: {e}", file=sys.stderr)
         return 1
-    reports = analyze(events)
-    render(reports, out=sys.stderr)
+    reports = analyze(dump.get("traceEvents", []))
+    render(reports, out=sys.stderr,
+           slo=(dump.get("otherData") or {}).get("slo"))
     return 0 if reports else 1
 
 
@@ -210,9 +235,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.dump:
         p.error("a dump path is required outside --bench mode")
     with open(args.dump) as f:
-        events = json.load(f).get("traceEvents", [])
-    reports = analyze(events)
-    render(reports)
+        dump = json.load(f)
+    reports = analyze(dump.get("traceEvents", []))
+    render(reports, slo=(dump.get("otherData") or {}).get("slo"))
     return 0 if reports else 1
 
 
