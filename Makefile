@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-filter bench-replay bench-replay-smoke bench-history bench-regress replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-overload clean help
+.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-filter bench-policy bench-replay bench-replay-smoke bench-history bench-regress replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-overload clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -27,8 +27,8 @@ bench-pipeline: ## Pipeline A/B at DEVICES virtual devices (DEVICES=N); prints v
 	python bench.py --only config_7 --devices $(DEVICES) \
 		| python tools/pipeline_verdict.py
 
-bench-consolidate: ## Batched what-if consolidation window (config_5); prints verdict line on stderr
-	python bench.py --only config_5 \
+bench-consolidate: ## Batched what-if consolidation window (config_5), diurnal trace leg when TRACE_replay.json exists (bench-replay); prints verdict line on stderr
+	python bench.py --only config_5 --trace TRACE_replay.json \
 		| python tools/consolidate_verdict.py
 
 bench-marshal: ## Steady-state window replay, cold vs delta marshal+encode A/B (config_10); prints verdict line on stderr
@@ -42,6 +42,10 @@ bench-gang: ## Batched gang co-pack window, one device solve vs per-gang host lo
 bench-filter: ## Device-resident fused feasibility, bit-plane window filter vs host columnar A/B (config_12); prints verdict line on stderr
 	python bench.py --only config_12 \
 		| python tools/filter_verdict.py
+
+bench-policy: ## Device-vectorized policy scoring vs per-cell host loop + spot repack frontier (config_13); prints verdict line on stderr
+	python bench.py --only config_13 \
+		| python tools/policy_verdict.py
 
 bench-replay: ## Million-pod replay across 4 shards + 100k-object store A/B (config_9); verdict + SLO verdict + traceview table on stderr
 	python bench.py --only config_9 \

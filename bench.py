@@ -118,7 +118,7 @@ def run_timed(fn, max_iters=ITERS, budget_s=BUDGET_S):
     return times
 
 
-def make_catalog(n_types, zones=3, price_base=0.05):
+def make_catalog(n_types, zones=3, price_base=0.05, spot_rate=None):
     from karpenter_tpu.cloudprovider.fake.provider import make_instance_type
     from karpenter_tpu.cloudprovider.spi import Offering
 
@@ -130,7 +130,9 @@ def make_catalog(n_types, zones=3, price_base=0.05):
         cpu = cpus[i % len(cpus)]
         ratio = ratios[(i // len(cpus)) % len(ratios)]
         offerings = [
-            Offering(ct, f"bench-zone-{z + 1}")
+            Offering(ct, f"bench-zone-{z + 1}",
+                     interruption_rate=(spot_rate(i, z) if spot_rate
+                                        and ct == "spot" else 0.0))
             for z in range(zones) for ct in ("on-demand", "spot")
         ]
         catalog.append(make_instance_type(
@@ -408,7 +410,8 @@ def config_5_consolidation():
             "node_parity_vs_per_pod_go_oracle": f"{oracle_label} — re-pack forward solve",
             "cost_before_per_hour": round(plan.current_cost_per_hour, 2),
             "cost_after_per_hour": round(plan.planned_cost_per_hour, 2),
-            "consolidation_window": _consolidation_window_bench()}
+            "consolidation_window": _consolidation_window_bench(),
+            "trace_leg": _trace_shaped_window_bench()}
 
 
 def _consolidation_window_bench():
@@ -552,6 +555,148 @@ def _consolidation_window_bench():
             "ffd_cost": round(relax.ffd_cost, 4)
             if relax.ffd_cost != float("inf") else None,
             "planned_nodes": rplan.planned_nodes},
+    }
+
+
+def _trace_shaped_window_bench():
+    """`bench.py --only config_5 --trace TRACE_replay.json`: feed a
+    RECORDED diurnal load shape into the scale-down window instead of the
+    synthetic steady state. The replay's trace dump (bench-replay,
+    obs/trace.dump_chrome) carries one ``window-close`` event per
+    provisioning window with its item count; bucketing those into K
+    phases recovers the offered-load curve the replay actually ran. Each
+    phase then drives one what-if window: candidate occupancy scales with
+    the phase's load (peak ⇒ 3 movable pods pinned per candidate, trough
+    ⇒ 1) against a fixed scarce receiver tail, so the drainable fraction
+    the batched solve finds must move INVERSELY with the recorded curve —
+    scale-down capacity appears exactly when the diurnal trough does.
+    Per phase: host place_onto parity and an independent commit-replay
+    re-verification (zero unverified drains), the same contract as the
+    synthetic window. No --trace (or a missing file) skips the leg."""
+    import json as _json
+
+    from karpenter_tpu.api import wellknown
+    from karpenter_tpu.api.core import (
+        Node, NodeSpec, NodeStatus, ObjectMeta, OwnerReference,
+    )
+    from karpenter_tpu.models.consolidate import node_bin, place_onto
+    from karpenter_tpu.ops.whatif import encode_window
+    from karpenter_tpu.solver.whatif import (
+        WhatIfConfig, plan_window, solve_window,
+    )
+    from karpenter_tpu.utils.resources import parse_resource_list
+
+    path = os.environ.get("KARPENTER_BENCH_TRACE", "").strip()
+    if not path:
+        return {"skipped": "no --trace"}
+    try:
+        with open(path) as f:
+            dump = _json.load(f)
+    except (OSError, ValueError) as e:
+        return {"skipped": f"trace unreadable: {type(e).__name__}: {e}"}
+    events = [e for e in dump.get("traceEvents", [])
+              if e.get("name") == "window-close" and "ts" in e]
+    if len(events) < 2:
+        return {"skipped": "trace has no window-close events"}
+
+    # the recorded curve: bucket window item counts into K phases
+    K = 6
+    t0 = min(e["ts"] for e in events)
+    t1 = max(e["ts"] for e in events)
+    span = max(t1 - t0, 1e-9)
+    load = [0.0] * K
+    for e in events:
+        k = min(K - 1, int((e["ts"] - t0) / span * K))
+        load[k] += float((e.get("args") or {}).get("items", 1))
+    peak = max(load) or 1.0
+    weights = [round(v / peak, 4) for v in load]
+
+    W, RECV = 128, 8
+    catalog = make_catalog(100)
+    big = max(catalog, key=lambda it: it.cpu.nano)
+    ds = OwnerReference(api_version="apps/v1", kind="DaemonSet",
+                       name="filler", uid="ds")
+    cand_fill_m = (big.cpu.nano - 850 * 10**6) // 10**6
+
+    def mk_node(name, cpu, memory, pods):
+        return Node(
+            metadata=ObjectMeta(name=name, namespace="", labels={
+                wellknown.LABEL_INSTANCE_TYPE: big.name,
+                wellknown.LABEL_CAPACITY_TYPE: "on-demand",
+                wellknown.PROVISIONER_NAME_LABEL: "bench"}),
+            spec=NodeSpec(),
+            status=NodeStatus(allocatable=parse_resource_list({
+                "cpu": cpu, "memory": memory, "pods": pods})))
+
+    phases = []
+    cfg = WhatIfConfig(device_min_cells=0)
+    warm = False
+    for k, w in enumerate(weights):
+        # recorded load -> pinned movable occupancy: 1 (trough) .. 3 (peak)
+        mv = 1 + round(2 * w)
+        nodes, pods_by = [], {}
+        for i in range(W):
+            n = mk_node(f"tc{k}-{i}", str(big.cpu), str(big.memory),
+                        str(big.pods))
+            nodes.append(n)
+            fill = make_pods(1, [(cand_fill_m, 128)])[0]
+            fill.metadata.name = f"tcf{k}-{i}"
+            fill.metadata.owner_references = [ds]
+            movable = make_pods(mv, [(250, 256)])
+            for j, p in enumerate(movable):
+                p.metadata.name = f"tmv{k}-{i}-{j}"
+            pods_by[n.metadata.name] = [fill] + movable
+        for i in range(RECV):
+            # scarce fixed tail: 2 cpu / 16 pods each — peak-phase load
+            # cannot fully evacuate, trough-phase load can
+            n = mk_node(f"tr{k}-{i}", "2", "8Gi", "16")
+            nodes.append(n)
+            pods_by[n.metadata.name] = []
+
+        bins = [node_bin(n, pods_by[n.metadata.name]) for n in nodes]
+        cand_idx = list(range(W))
+        cand_movable = [pods_by[f"tc{k}-{i}"][1:] for i in range(W)]
+        host_feas = [
+            place_onto(cand_movable[i], bins[:i] + bins[i + 1:]) is not None
+            for i in cand_idx]
+        if not warm:
+            solve_window(encode_window(bins, cand_idx, cand_movable), cfg)
+            warm = True
+        t_start = time.perf_counter()
+        enc = encode_window(bins, cand_idx, cand_movable)
+        feas, _, executor = solve_window(enc, cfg)
+        t_bat = time.perf_counter() - t_start
+        plan = plan_window(enc, feas, [big.price] * W, max_drains=W)
+        vbins = [node_bin(n, pods_by[n.metadata.name]) for n in nodes]
+        drained, unverified = set(), 0
+        for action in plan.actions:
+            surviving = [b for j, b in enumerate(vbins)
+                         if j != action.bin and j not in drained]
+            if place_onto(cand_movable[action.cand], surviving,
+                          commit=True) is None:
+                unverified += 1
+            else:
+                drained.add(action.bin)
+        phases.append({
+            "weight": w, "movable_per_candidate": mv,
+            "drains": len(plan.actions),
+            "parity": [bool(f) for f in feas] == host_feas,
+            "unverified_drains": unverified,
+            "batched_s": round(t_bat, 4), "executor": executor,
+            "reclaimed_per_hour": round(plan.reclaimed_per_hour, 2),
+        })
+
+    trough = min(range(K), key=lambda k: weights[k])
+    peak_k = max(range(K), key=lambda k: weights[k])
+    return {
+        "source": path, "windows": len(events), "phases": phases,
+        "weights": weights,
+        # the recorded shape must drive scale-down: the trough phase
+        # drains at least as much as the peak phase
+        "shape_consistent": phases[trough]["drains"]
+                            >= phases[peak_k]["drains"],
+        "drains_trough": phases[trough]["drains"],
+        "drains_peak": phases[peak_k]["drains"],
     }
 
 
@@ -1184,6 +1329,228 @@ def config_12_device_filter():
     }
 
 
+def config_13_policy_scoring():
+    """Round-13 gate: device-vectorized packing-policy scoring
+    (docs/solver.md §17). A 24-schedule fused window over a 400-type
+    priced catalog — every spot offering carrying its own interruption
+    rate — is scored two ways under the interruption-priced policy:
+
+    - leg A, host per-cell: one policy.score() per (schedule, packable),
+      a Python loop over offerings inside every call — the pre-§17 prices
+      seam (batch_solve._problem_prices), and still the fallback leg;
+    - leg B, device: ops/policy.score_fused_window — ONE jit scores every
+      (schedule × type × capacity-type) cell of the window; the probe
+      re-verification against the numpy mirror is timed INSIDE the leg,
+      so the speedup is net of the filter contract's cost.
+
+    Three correctness gates ride along: default-policy row parity
+    (the device row must equal encode_prices of the host scores bit for
+    bit on every member — the differential guarantee the default policy
+    rides on), full-solve node parity (10k pods, device scoring on vs
+    KARPENTER_POLICY_DEVICE=0, identical node counts AND launch picks),
+    and a repack-cost frontier sweep asserting spot is selected exactly
+    when ``rate x repack < price x (1 - spot_factor)`` — the
+    interruption-priced policy's documented break-even. `make
+    bench-policy` gates >= 5x at zero unverified placements via
+    tools/policy_verdict.py."""
+    import numpy as _np
+
+    from karpenter_tpu.api import wellknown as _wk
+    from karpenter_tpu.api.core import NodeSelectorRequirement as _Req
+    from karpenter_tpu.cloudprovider.fake.provider import make_instance_type
+    from karpenter_tpu.cloudprovider.spi import Offering
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.metrics.policy import (
+        POLICY_FALLBACK_TOTAL, POLICY_CELLS_SCORED_TOTAL,
+        POLICY_SPOT_SELECTED_TOTAL,
+    )
+    from karpenter_tpu.models.ffd import encode_prices
+    from karpenter_tpu.ops import device_filter
+    from karpenter_tpu.ops import policy as ops_policy
+    from karpenter_tpu.solver import policy as policy_registry
+    from karpenter_tpu.solver.adapter import marshal_pods_interned
+    from karpenter_tpu.solver.batch_solve import Problem, solve_batch
+    from karpenter_tpu.solver.policy import PolicyContext
+    from karpenter_tpu.solver.solve import (
+        SolverConfig, resolved_device_max_shapes,
+    )
+
+    if not ops_policy.enabled():
+        return {"skipped": "KARPENTER_POLICY_DEVICE=0"}
+    if not device_filter.enabled():
+        return {"skipped": "KARPENTER_DEVICE_FILTER=0 (no fused window)"}
+
+    T, S = 400, 24
+    # per-type, per-zone spot volatility: 0.01..0.106 reclaims/h, varied
+    # so the kernel's min-over-allowed-zones actually has work to do
+    catalog = make_catalog(
+        T, spot_rate=lambda i, z: round(0.01 + 0.004 * ((i * 7 + z) % 25), 6))
+    constraints = universe_constraints(catalog)
+
+    per = 10_000 // S
+    problems = []
+    for b in range(S):
+        tightened = constraints.deepcopy()
+        tightened.requirements = tightened.requirements.add(_Req(
+            key=_wk.LABEL_TOPOLOGY_ZONE, operator="In",
+            values=[f"bench-zone-{1 + b % 3}"]))
+        pods = make_pods(per, MIXED_SHAPES[b % len(MIXED_SHAPES):]
+                         + MIXED_SHAPES[:b % len(MIXED_SHAPES)])
+        for j, p in enumerate(pods):
+            p.metadata.name = f"p{b}-{j}"
+        problems.append(Problem(constraints=tightened, pods=pods,
+                                instance_types=catalog))
+
+    ctx = PolicyContext(repack_cost_per_hour=2.0)
+    cfg = SolverConfig(device_min_pods=1,
+                       packing_policy="interruption-priced",
+                       policy_context=ctx)
+    priced = policy_registry.get("interruption-priced")
+    cheapest = policy_registry.get("cheapest")
+
+    # full-solve node parity: device scoring on vs the kill switch, same
+    # policy — identical node counts AND identical launch picks (the
+    # device verdict is a filter, never a commit)
+    fb_before = dict(POLICY_FALLBACK_TOTAL.collect())
+    cells0 = POLICY_CELLS_SCORED_TOTAL.collect().get((), 0.0)
+    prev = os.environ.get("KARPENTER_POLICY_DEVICE")
+    try:
+        os.environ["KARPENTER_POLICY_DEVICE"] = "1"
+        on = solve_batch(problems, cfg)
+        os.environ["KARPENTER_POLICY_DEVICE"] = "0"
+        off = solve_batch(problems, cfg)
+    finally:
+        if prev is None:
+            os.environ.pop("KARPENTER_POLICY_DEVICE", None)
+        else:
+            os.environ["KARPENTER_POLICY_DEVICE"] = prev
+
+    def nodes(rs):
+        return [sum(p.node_quantity for p in r.packings) for r in rs]
+
+    def picks(rs):
+        return [[p.instance_type_options[0].name if p.instance_type_options
+                 else None for p in r.packings] for r in rs]
+
+    nodes_on, nodes_off = nodes(on), nodes(off)
+    node_parity = nodes_on == nodes_off
+    pick_parity = picks(on) == picks(off)
+
+    # the timed scoring-stage A/B over one real fused window
+    marshaled = [marshal_pods_interned(p.pods) for p in problems]
+    fused = device_filter.prepare_fused(problems, marshaled, cfg,
+                                        resolved_device_max_shapes(cfg))
+    if fused is None:
+        return {"error": "window not fused — scoring A/B needs the "
+                         "bit-plane window (config_12's stage)"}
+    try:
+        planes = device_filter.planes_for(fused.uni_types)
+        TB = planes.TB
+
+        def host_leg():
+            rows = []
+            for i in fused.batch_idx:
+                reqs = problems[i].constraints.requirements
+                rows.append(encode_prices(
+                    [priced.score(fused.uni_types[p.index], reqs,
+                                  cfg.cost_config, ctx)[0]
+                     for p in fused.packables], TB))
+            return rows
+
+        def device_leg():
+            rows = ops_policy.score_fused_window(
+                fused, priced, cfg.cost_config, ctx)
+            assert rows is not None, "device scoring fell back mid-bench"
+            return rows
+
+        # default-policy differential: penalty-free algebra must make the
+        # device row bit-identical to encode_prices of the host scores
+        rows_cheap_d = ops_policy.score_fused_window(
+            fused, cheapest, cfg.cost_config, PolicyContext())
+        row_divergence = -1
+        if rows_cheap_d is not None:
+            row_divergence = 0
+            for b, i in enumerate(fused.batch_idx):
+                reqs = problems[i].constraints.requirements
+                row_h = encode_prices(
+                    [cheapest.score(fused.uni_types[p.index], reqs,
+                                    cfg.cost_config, PolicyContext())[0]
+                     for p in fused.packables], TB)
+                row_divergence += int(_np.sum(rows_cheap_d[b] != row_h))
+
+        host_leg()
+        device_leg()  # warm tables + jit before the clock starts
+        host_times = run_timed(host_leg, budget_s=30.0)
+        device_times = run_timed(device_leg, budget_s=15.0)
+    finally:
+        fused.release()
+    st_host = _stats(host_times)
+    st_device = _stats(device_times)
+    speedup = round(st_host["p50_ms"] / (st_device["p50_ms"] or 1e-9), 2)
+
+    # frontier sweep: one type at price P with a single spot offering at
+    # rate r — spot must win exactly while rate x repack < P x (1 - f)
+    f = cfg.cost_config.spot_price_factor
+    P, r = 1.0, 0.5
+    threshold = P * (1.0 - f) / r
+    mini = [make_instance_type(
+        name="frontier-4x", cpu="4", memory="8Gi", pods="16", price=P,
+        offerings=[Offering("on-demand", "bench-zone-1"),
+                   Offering("spot", "bench-zone-1", interruption_rate=r)])]
+    mini_cons = universe_constraints(mini)
+    frontier = []
+    for mult in (0.0, 0.25, 0.5, 0.9, 1.1, 2.0, 4.0):
+        v = round(threshold * mult, 6)
+        pcfg = SolverConfig(
+            device_min_pods=1, packing_policy="interruption-priced",
+            policy_context=PolicyContext(repack_cost_per_hour=v))
+        probs = []
+        for k in range(2):
+            pods = make_pods(40, [(500, 512)])
+            for j, p in enumerate(pods):
+                p.metadata.name = f"fr{mult}-{k}-{j}"
+            probs.append(Problem(constraints=mini_cons.deepcopy(),
+                                 pods=pods, instance_types=mini))
+        before = sum(POLICY_SPOT_SELECTED_TOTAL.collect().values())
+        rs = solve_batch(probs, pcfg)
+        placed = sum(sum(p.node_quantity for p in res.packings)
+                     for res in rs)
+        chose_spot = sum(POLICY_SPOT_SELECTED_TOTAL.collect().values()) \
+            - before > 0
+        frontier.append({
+            "repack_cost_per_hour": v, "nodes": int(placed),
+            "spot_expected": bool(r * v < P * (1.0 - f)),
+            "spot_selected": bool(chose_spot),
+        })
+    frontier_ok = all(pt["nodes"] > 0
+                      and pt["spot_expected"] == pt["spot_selected"]
+                      for pt in frontier)
+
+    fb_after = dict(POLICY_FALLBACK_TOTAL.collect())
+    fallbacks = {dict(k).get("reason", "?"): fb_after[k] - fb_before.get(k, 0)
+                 for k in fb_after
+                 if fb_after[k] - fb_before.get(k, 0.0) > 0}
+    return {
+        "pods": per * S, "types": T, "schedules_per_window": S,
+        "policy": "interruption-priced",
+        "host_p50_ms": st_host["p50_ms"], "host_p99_ms": st_host["p99_ms"],
+        "device_p50_ms": st_device["p50_ms"],
+        "device_p99_ms": st_device["p99_ms"],
+        "speedup": speedup,
+        "row_divergence_default": row_divergence,
+        "node_parity": bool(node_parity),
+        "pick_parity": bool(pick_parity),
+        "nodes": int(sum(nodes_on)),
+        "unverified": int(fallbacks.get("score-mismatch", 0)),
+        "cells_scored": POLICY_CELLS_SCORED_TOTAL.collect().get(
+            (), 0.0) - cells0,
+        "spot_frontier": frontier,
+        "frontier_ok": bool(frontier_ok),
+        "frontier_threshold": round(threshold, 6),
+        "policy_fallbacks": fallbacks,
+    }
+
+
 def jax_devices_first():
     import jax
 
@@ -1597,6 +1964,7 @@ def run_all(degraded: bool, probe_note: str = ""):
         ("config_10_marshal_delta", config_10_marshal_delta),
         ("config_11_gang_copack", config_11_gang_copack),
         ("config_12_device_filter", config_12_device_filter),
+        ("config_13_policy_scoring", config_13_policy_scoring),
     ):
         if not _selected(key, only):
             continue
@@ -1693,10 +2061,19 @@ def _parse_args(argv):
     """`--only config_N ...` and `--devices N`, in either order. Both are
     carried in the environment so the supervisor's child processes (and
     their degraded re-execs) inherit the selection without re-parsing."""
-    usage = "usage: bench.py [--only config_N ...] [--devices N]"
+    usage = ("usage: bench.py [--only config_N ...] [--devices N] "
+             "[--trace TRACE.json]")
     i = 0
     while i < len(argv):
-        if argv[i] == "--devices":
+        if argv[i] == "--trace":
+            if i + 1 >= len(argv) or argv[i + 1].startswith("--"):
+                print(usage, file=sys.stderr)
+                return False
+            # env, not a global: the supervisor's children inherit it the
+            # same way they inherit --only (config_5's trace leg reads it)
+            os.environ["KARPENTER_BENCH_TRACE"] = argv[i + 1]
+            i += 2
+        elif argv[i] == "--devices":
             if i + 1 >= len(argv) or not argv[i + 1].isdigit():
                 print(usage, file=sys.stderr)
                 return False

@@ -31,7 +31,17 @@ kube       watch          ``drop`` (a Pod MODIFIED event vanishes;
                           dropped — see :class:`_DroppingWatch`)
 provider   create         ``ice`` (launch refused), ``crash-before-
                           bind`` (capacity launched, controller dies
-                          before the Node write — the GC leak case)
+                          before the Node write — the GC leak case),
+                          ``spot-interruption`` (the oldest running
+                          spot instance is reclaimed through the
+                          capacity ledger concurrently with this
+                          launch, which itself proceeds — ghost Node
+                          for GC, pods repack)
+provider   reclaim        ``spot-interruption`` again, drawn once per
+                          tick by the replay harness's own plan
+                          (replay.py --spot-fraction) rather than by a
+                          provider shim — fires → oldest spot instance
+                          reclaimed mid-run
 ec2        create_fleet   ``ice``, ``throttle``, ``partial`` (one
                           unit ICEs, the rest launch),
                           ``crash-before-bind`` (fleet launched,

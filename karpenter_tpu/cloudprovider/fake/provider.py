@@ -131,8 +131,16 @@ class FakeCloudProvider(CloudProvider):
                     zone, capacity_type = o.zone, o.capacity_type
                     break
             # one fault draw per unit of capacity: ICE prevents the launch,
-            # crash-before-bind leaks it (see below)
+            # crash-before-bind leaks it (see below), spot-interruption
+            # reclaims running spot capacity out-of-band
             fault = inject.active_fault("provider", "create")
+            if fault == "spot-interruption":
+                # an interruption lands concurrently with provisioning: the
+                # oldest spot instance vanishes from the ledger (its Node
+                # survives as a ghost for GC; its pods must repack) while
+                # THIS launch proceeds normally — the fault is about the
+                # fleet already running, not the unit being created
+                self.reclaim_spot(1)
             if ((instance.name, zone, capacity_type) in self.insufficient_capacity
                     or fault == "ice"):
                 errs.append(f"insufficient capacity for {instance.name} in {zone}")
@@ -147,6 +155,7 @@ class FakeCloudProvider(CloudProvider):
                     created_unix=clock.now(),
                     zone=zone,
                     instance_type=instance.name,
+                    capacity_type=capacity_type,
                 )
             if fault == "crash-before-bind":
                 # controller dies between the launch and the node write:
@@ -206,6 +215,24 @@ class FakeCloudProvider(CloudProvider):
             if self._capacity.pop(instance_id, None) is not None:
                 self.deleted.append(instance_id)
         return None  # not-found is success: the capacity is gone either way
+
+    def reclaim_spot(self, limit: int = 1) -> List[str]:
+        """Out-of-band termination of up to ``limit`` spot instances — the
+        fake analog of an EC2 spot interruption. The ledger entry vanishes
+        (exactly what DescribeInstances would stop returning) while any Node
+        object survives as a ghost for GC to reap; pods on it must repack.
+        Returns the reclaimed instance ids, oldest launches first so soaks
+        are deterministic under a fixed creation order."""
+        with self._lock:
+            spot = sorted(
+                (r for r in self._capacity.values()
+                 if r.capacity_type == wellknown.CAPACITY_TYPE_SPOT),
+                key=lambda r: (r.created_unix, r.instance_id))
+            victims = [r.instance_id for r in spot[:max(0, limit)]]
+            for iid in victims:
+                self._capacity.pop(iid, None)
+                self.deleted.append(iid)
+        return victims
 
     def get_instance_types(self, constraints: Constraints) -> List[InstanceType]:
         if self.catalog is not None:

@@ -20,10 +20,16 @@ from karpenter_tpu.utils.resources import Quantity, ResourceList
 @dataclass(frozen=True)
 class Offering:
     """A (capacity type, zone) pair an instance type is available in
-    (types.go:73-76)."""
+    (types.go:73-76).
+
+    ``interruption_rate`` is the provider's expected reclaims/hour for this
+    offering (0 for on-demand; spot offerings carry the published pool
+    volatility). It is advisory pricing input for the interruption-priced
+    scoring policy (solver/policy.py) — feasibility never consults it."""
 
     capacity_type: str  # "spot" | "on-demand"
     zone: str
+    interruption_rate: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -50,6 +56,10 @@ class CapacityRecord:
     created_unix: float = 0.0
     zone: str = ""
     instance_type: str = ""
+    # capacity type the launch drew from ("spot" | "on-demand"); lets the
+    # spot-interruption chaos boundary and reclaim tooling target spot
+    # capacity without consulting Node labels (which may not exist yet).
+    capacity_type: str = ""
 
 
 @dataclass

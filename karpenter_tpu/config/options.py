@@ -60,6 +60,14 @@ class Options:
     solver_donate: bool = True
     # pre-compile the (shape × type) bucket ladder at boot (solver/warmup.py)
     solver_warmup: bool = False
+    # packing policy (solver/policy.py registry): cheapest |
+    # interruption-priced | throughput-per-dollar. The default preserves
+    # today's cheapest-feasible ordering/tiebreak bit-for-bit.
+    packing_policy: str = "cheapest"
+    # pins the interruption-priced policy's repack price ($/h) instead of the
+    # per-chunk what-if estimate; 0 = let the what-if engine price each chunk.
+    # Also the consolidation keep-cost premium on spot nodes (rate x this).
+    policy_repack_cost: float = 0.0
     # JAX persistent compilation cache dir ("" disables): restarts re-load
     # compiled programs instead of re-lowering them
     solver_compile_cache_dir: str = ""
@@ -147,6 +155,14 @@ class Options:
                 self.parse_slo_objectives()
             except ValueError as e:
                 errs.append(f"slo-objectives invalid: {e}")
+        from karpenter_tpu.solver import policy as packing_policies
+
+        if self.packing_policy not in packing_policies.available():
+            errs.append(f"packing-policy invalid: {self.packing_policy} "
+                        f"(available: {packing_policies.available()})")
+        if self.policy_repack_cost < 0:
+            errs.append(
+                f"policy-repack-cost invalid: {self.policy_repack_cost}")
         if self.aws_node_name_convention not in ("ip-name", "resource-name"):
             errs.append(
                 f"aws-node-name-convention invalid: {self.aws_node_name_convention}")
@@ -249,6 +265,19 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                    default=_env("solver-warmup", defaults.solver_warmup),
                    help="pre-compile the solver bucket ladder at boot on a "
                         "background thread (solver/warmup.py)")
+    p.add_argument("--packing-policy",
+                   default=_env("packing-policy", defaults.packing_policy),
+                   help="packing-policy scoring (solver/policy.py): "
+                        "cheapest (default, preserves cheapest-feasible "
+                        "exactly) | interruption-priced (spot taxed by "
+                        "reclaim-rate x what-if repack cost) | "
+                        "throughput-per-dollar (heterogeneous accelerator "
+                        "catalogs)")
+    p.add_argument("--policy-repack-cost", type=float,
+                   default=_env("policy-repack-cost",
+                                defaults.policy_repack_cost),
+                   help="pin the interruption-priced policy's repack price "
+                        "($/h); 0 lets the what-if engine price each chunk")
     p.add_argument("--solver-compile-cache-dir",
                    default=_env("solver-compile-cache-dir",
                                 defaults.solver_compile_cache_dir),

@@ -141,13 +141,17 @@ class ConsolidationController:
                  max_actions_per_pass: int = 8,
                  window_size: int = 512,
                  whatif_config: Optional[WhatIfConfig] = None,
-                 cost_config: CostConfig = CostConfig()):
+                 cost_config: CostConfig = CostConfig(),
+                 repack_cost_per_hour: float = 0.0):
         self.kube = kube
         self.provider = provider
         self.max_actions_per_pass = max_actions_per_pass
         self.window_size = window_size
         self.whatif_config = whatif_config or WhatIfConfig()
         self.cost_config = cost_config
+        # interruption-priced handoff: spot nodes' keep-cost carries their
+        # reclaim tax, so savings rank risk as well as discount
+        self.repack_cost_per_hour = repack_cost_per_hour
 
     def kind(self) -> str:
         return "Provisioner"
@@ -185,7 +189,9 @@ class ConsolidationController:
 
         catalog = self.provider.get_instance_types(
             provisioner.spec.constraints) if self.provider is not None else []
-        prices, unknown = fleet_prices(fleet, catalog, self.cost_config)
+        prices, unknown = fleet_prices(
+            fleet, catalog, self.cost_config,
+            repack_cost_per_hour=self.repack_cost_per_hour)
         if unknown and catalog:
             # once per window, not per node — the counter carries cardinality
             CONSOLIDATION_UNKNOWN_TYPE_TOTAL.inc(len(unknown))

@@ -35,7 +35,7 @@ import logging
 import threading
 import time
 import zlib
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -67,6 +67,7 @@ from karpenter_tpu.solver.gang import (
 )
 from karpenter_tpu.solver.pipeline import PipelineConfig, SolvePipeline
 from karpenter_tpu.solver.solve import SolveResult, SolverConfig
+from karpenter_tpu.utils import node as nodeutil
 from karpenter_tpu.utils import pod as podutil
 
 log = logging.getLogger("karpenter.provisioning")
@@ -124,6 +125,9 @@ class _ChunkPrep:
     gang_types: list = field(default_factory=list)  # type idx → (schedule, it)
     gang_handle: Optional[object] = None
     gang_nodes: Dict[int, str] = field(default_factory=dict)  # bin → node
+    # chunk-scoped SolverConfig override: the interruption-priced policy's
+    # what-if repack context is priced per chunk (None → worker config)
+    solver_config: Optional[SolverConfig] = None
 
 
 class ProvisionerEngine:
@@ -437,7 +441,50 @@ class ProvisionerWorker:
         prep = _ChunkPrep(schedules=schedules, problems=problems, pods=pods)
         if gang_scheds:
             prep.gang_enc, prep.gang_types = self._encode_gangs(gang_scheds)
+        prep.solver_config = self._chunk_solver_config(prep)
         return prep
+
+    def _chunk_solver_config(self, prep: _ChunkPrep) -> Optional[SolverConfig]:
+        """What-if pricing handoff: when the interruption-priced policy is
+        active and the operator left repack_cost_per_hour unpinned (0), price
+        this chunk's spot-loss cost through solver/policy.whatif_repack_cost
+        — ~0 when the chunk's pods would refit on the fleet's existing free
+        capacity (losing a spot node is then nearly free, so spot's discount
+        wins), else the cheapest on-demand replacement $/h (spot must now
+        beat its reclaim tax). Returns a chunk-scoped SolverConfig carrying
+        the priced PolicyContext, or None to use the worker config as-is."""
+        cfg = self.solver_config
+        if cfg.packing_policy != "interruption-priced":
+            return None
+        if cfg.policy_context.repack_cost_per_hour > 0.0:
+            return None  # operator-pinned: respect the explicit price
+        if not prep.problems:
+            return None
+        from karpenter_tpu.models.consolidate import free_capacity_vector
+        from karpenter_tpu.solver.adapter import pod_vector
+        from karpenter_tpu.solver.policy import (
+            PolicyContext, whatif_repack_cost,
+        )
+        free_vecs = []
+        for node in self.kube.list("Node"):
+            if node.metadata.deletion_timestamp is not None:
+                continue
+            if not nodeutil.is_ready(node):
+                continue
+            free_vecs.append(free_capacity_vector(
+                node, self.kube.pods_on_node(node.metadata.name)))
+        # price the dearest schedule group of the chunk: conservative —
+        # spot is only chosen when even the worst-case repack is cheap
+        repack = 0.0
+        for problem in prep.problems:
+            repack = max(repack, whatif_repack_cost(
+                [pod_vector(p) for p in problem.pods], free_vecs,
+                problem.instance_types,
+                problem.constraints.requirements,
+                cfg.cost_config))
+        return replace(cfg, policy_context=PolicyContext(
+            repack_cost_per_hour=repack,
+            throughput=cfg.policy_context.throughput))
 
     def _encode_gangs(self, gang_scheds):
         """Marshal every gang schedule of the chunk into ONE window
@@ -488,7 +535,8 @@ class ProvisionerWorker:
         (provisioner.go:109-120). Async: returns the in-flight BatchHandle
         for the pipeline to fetch; fallbacks resolve at fetch time."""
         t0 = time.perf_counter()
-        handle = dispatch_batch(prep.problems, config=self.solver_config)
+        handle = dispatch_batch(prep.problems,
+                                config=prep.solver_config or self.solver_config)
         if prep.gang_enc is not None and prep.gang_enc.g > 0:
             # same round trip: the gang window rides the dispatch stage
             # alongside the per-schedule batch, fetch resolves both

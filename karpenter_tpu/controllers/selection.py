@@ -62,10 +62,17 @@ def validate(p: Pod) -> Optional[str]:
     """Supported-feature validation (controller.go:123-174)."""
     errs: List[str] = []
     if p.spec.affinity is not None:
-        if p.spec.affinity.pod_affinity is not None:
-            errs.append("pod affinity is not supported")
-        if p.spec.affinity.pod_anti_affinity is not None:
-            errs.append("pod anti-affinity is not supported")
+        # required hostname-keyed pod-(anti-)affinity is compiled into the
+        # columnar filter (scheduling/affinity.py); anything else still sheds
+        for side, what in ((p.spec.affinity.pod_affinity, "pod affinity"),
+                           (p.spec.affinity.pod_anti_affinity,
+                            "pod anti-affinity")):
+            if side is None:
+                continue
+            for term in side.required:
+                if term.topology_key != wellknown.LABEL_HOSTNAME:
+                    errs.append(f"{what} topology key "
+                                f"{term.topology_key!r} is not supported")
         na = p.spec.affinity.node_affinity
         if na is not None:
             terms = list(na.required or [])

@@ -81,8 +81,12 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
     from karpenter_tpu.solver.pipeline import PipelineConfig
 
     solver_warmup.configure_compilation_cache(options.solver_compile_cache_dir)
+    from karpenter_tpu.solver.policy import PolicyContext
     solver_config = SolverConfig(use_device=options.solver_use_device,
-                                 device_donate=options.solver_donate)
+                                 device_donate=options.solver_donate,
+                                 packing_policy=options.packing_policy,
+                                 policy_context=PolicyContext(
+                                     repack_cost_per_hour=options.policy_repack_cost))
     if options.solver_warmup:
         solver_warmup.start_warmup(solver_config,
                                    include_ring=options.solver_donate)
@@ -118,7 +122,13 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
             kube, cloud_provider,
             interval_seconds=options.gc_interval_seconds,
             grace_seconds=options.gc_grace_seconds))
-    manager.register(ConsolidationController(kube, provider=cloud_provider))
+    manager.register(ConsolidationController(
+        kube, provider=cloud_provider,
+        # spot keep-cost premium (models/consolidate.fleet_prices): only the
+        # interruption-priced policy charges reclaim risk into the ranking
+        repack_cost_per_hour=(
+            options.policy_repack_cost
+            if options.packing_policy == "interruption-priced" else 0.0)))
     manager.register(PVCController(kube))
     manager.register(NodeMetricsController(kube))
     manager.register(PodMetricsController(kube))

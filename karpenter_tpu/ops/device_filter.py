@@ -680,11 +680,135 @@ def prepare_fused(problems, marshaled, config, max_shapes: int):
         return None
 
 
+# --------------------------------------------------------------------------
+# Pod-pod affinity: the selectors × peers match matrix from pair bit-planes
+# --------------------------------------------------------------------------
+#
+# Peers (distinct pod-label signatures) intern their (key, value) pairs
+# into dense bit positions; each peer becomes one row of uint32 words with
+# its pair bits set. Every supported selector clause then reduces to ANY /
+# NONE over a clause bitmask against that plane — match_labels and In are
+# ANY over the named pair bits, NotIn is NONE over them, Exists/DoesNotExist
+# are ANY/NONE over all pair bits of the key — and the whole (S, P) matrix
+# is one device call: per-clause hits, then a segment-sum of violations per
+# selector. The matrix is a FILTER like every device verdict here: the
+# caller (ops/feasibility.affinity_match_matrix) probe-checks cells against
+# the scalar matches() oracle and self-heals to scalar on divergence.
+
+_AFFINITY_MATRIX_CACHE: dict = {}
+_AFFINITY_MATRIX_CACHE_CAP = 64
+
+
+@functools.lru_cache(maxsize=8)
+def _affinity_jit(S: int):
+    import jax
+    import jax.numpy as jnp
+
+    def body(peer_plane, cmask, ckind, csel):
+        # (C, P): does any clause-mask bit intersect the peer's pair bits?
+        hit = ((peer_plane[None, :, :] & cmask[:, None, :]) != 0).any(-1)
+        ok = jnp.where(ckind[:, None] == 0, hit, ~hit)
+        viol = jax.ops.segment_sum((~ok).astype(jnp.int32), csel,
+                                   num_segments=S)
+        return viol == 0
+
+    return jax.jit(body)
+
+
+def affinity_matrix(sel_sigs: tuple, peer_sigs: tuple) -> Optional[np.ndarray]:
+    """(S, P) match matrix for pre-validated selector signatures (the
+    feasibility layer's selector_signature tuples — only In/NotIn/Exists/
+    DoesNotExist reach here) against peer label signatures. None → the
+    caller's host columnar leg runs unchanged."""
+    if not enabled() or not sel_sigs or not peer_sigs:
+        return None
+    ckey = (sel_sigs, peer_sigs)
+    with _LOCK:
+        hit = _AFFINITY_MATRIX_CACHE.get(ckey)
+    if hit is not None:
+        return hit
+    t0 = time.perf_counter()
+    try:
+        pair_vocab: Dict[tuple, int] = {}
+        key_bits: Dict[str, list] = {}
+        for sig in peer_sigs:
+            for kv in sig:
+                if kv not in pair_vocab:
+                    pair_vocab[kv] = len(pair_vocab)
+                    key_bits.setdefault(kv[0], []).append(pair_vocab[kv])
+        W = _words(len(pair_vocab))
+        P = len(peer_sigs)
+        Ppad = max(8, 1 << (P - 1).bit_length())
+        peer_plane = np.zeros((Ppad, W), np.uint32)
+        for p, sig in enumerate(peer_sigs):
+            for kv in sig:
+                b = pair_vocab[kv]
+                peer_plane[p, b // 32] |= np.uint32(1 << (b % 32))
+
+        def clause_mask(bits) -> np.ndarray:
+            row = np.zeros((W,), np.uint32)
+            for b in bits:
+                row[b // 32] |= np.uint32(1 << (b % 32))
+            return row
+
+        masks: List[np.ndarray] = []
+        kinds: List[int] = []   # 0 = ANY-of, 1 = NONE-of
+        sel_of: List[int] = []
+        for s, (match_labels, exprs) in enumerate(sel_sigs):
+            for kv in match_labels:
+                b = pair_vocab.get(kv)
+                # an unseen pair can match no peer: the empty ANY mask
+                # makes the clause (and the row's cells) False, exactly
+                # like the scalar oracle
+                masks.append(clause_mask([] if b is None else [b]))
+                kinds.append(0)
+                sel_of.append(s)
+            for key, op, values in exprs:
+                if op in ("In", "NotIn"):
+                    bits = [pair_vocab[(key, v)] for v in values
+                            if (key, v) in pair_vocab]
+                    masks.append(clause_mask(bits))
+                    kinds.append(0 if op == "In" else 1)
+                else:  # Exists / DoesNotExist: ANY/NONE over the key's pairs
+                    masks.append(clause_mask(key_bits.get(key, [])))
+                    kinds.append(0 if op == "Exists" else 1)
+                sel_of.append(s)
+        S = len(sel_sigs)
+        C = len(masks)
+        if C == 0:
+            # every selector is empty: matches() returns True everywhere
+            mat = np.ones((S, P), bool)
+        else:
+            Cpad = -(-C // 8) * 8
+            while len(masks) < Cpad:
+                # padding clauses: NONE over the empty mask — always ok,
+                # charged to selector 0, never a violation
+                masks.append(np.zeros((W,), np.uint32))
+                kinds.append(1)
+                sel_of.append(0)
+            out = _affinity_jit(S)(
+                peer_plane, np.stack(masks),
+                np.asarray(kinds, np.int32), np.asarray(sel_of, np.int32))
+            mat = np.asarray(out)[:, :P]
+    except Exception:
+        FILTER_DEVICE_FALLBACK_TOTAL.inc(reason="jax-backend-unavailable")
+        return None
+    mat = np.asarray(mat, bool)
+    mat.flags.writeable = False
+    FILTER_DEVICE_SECONDS.observe(time.perf_counter() - t0, stage="affinity")
+    with _LOCK:
+        if len(_AFFINITY_MATRIX_CACHE) >= _AFFINITY_MATRIX_CACHE_CAP:
+            _AFFINITY_MATRIX_CACHE.pop(next(iter(_AFFINITY_MATRIX_CACHE)))
+        _AFFINITY_MATRIX_CACHE[ckey] = mat
+    return mat
+
+
 def clear_caches() -> None:
     """Tests only."""
     with _LOCK:
         _PLANES_CACHE.clear()
         _ROW_CACHE.clear()
+        _AFFINITY_MATRIX_CACHE.clear()
     try:
         from karpenter_tpu.solver import adapter
 

@@ -819,6 +819,144 @@ def gang_feasibility_mask(instance_types, member_keys,
     return mask
 
 
+# -- pod-pod affinity: per-signature peer columns ----------------------------
+#
+# Required pod-(anti-)affinity on the hostname topology key compiles to a
+# selectors × peers boolean match matrix: S distinct LabelSelector
+# signatures evaluated against P distinct pod-label signatures as numpy
+# column algebra (one interned value-id column per key), instead of S×P
+# scalar LabelSelector.matches calls. The device twin
+# (ops/device_filter.affinity_matrix) computes the same matrix from packed
+# (key, value) pair bit-planes in one call. Either leg's verdict stays a
+# FILTER: sampled cells are re-checked against the scalar matches() oracle
+# and any divergence recomputes the whole matrix scalar — counted as
+# filter_fallback_total{reason="affinity-mismatch"}.
+# KARPENTER_POLICY_COLUMNAR=0 is the kill switch (scalar matrix outright).
+
+_AFFINITY_ENV = "KARPENTER_POLICY_COLUMNAR"
+_AFFINITY_OPS = frozenset({"In", "NotIn", "Exists", "DoesNotExist"})
+_AFFINITY_PROBE_K = 32
+
+
+def affinity_columnar_enabled() -> bool:
+    return os.environ.get(_AFFINITY_ENV, "").strip() != "0"
+
+
+def labels_signature(labels: Dict[str, str]) -> tuple:
+    """Hashable identity of one pod's label set — the peer axis is deduped
+    by this, so a 10k-replica deployment is ONE peer column."""
+    return tuple(sorted(labels.items()))
+
+
+def selector_signature(sel) -> Optional[tuple]:
+    """Hashable identity of a LabelSelector, or None when it carries an
+    operator outside {In, NotIn, Exists, DoesNotExist} — such selectors
+    send the whole matrix to the scalar path (matches() silently skips
+    unknown operators; the columnar mirror refuses to guess instead)."""
+    for e in sel.match_expressions:
+        if e.operator not in _AFFINITY_OPS:
+            return None
+    return (tuple(sorted(sel.match_labels.items())),
+            tuple((e.key, e.operator, tuple(e.values))
+                  for e in sel.match_expressions))
+
+
+def _affinity_scalar(selectors, peer_sigs) -> np.ndarray:
+    """The scalar oracle: LabelSelector.matches per cell — the reference
+    semantics both columnar legs must reproduce exactly."""
+    out = np.zeros((len(selectors), len(peer_sigs)), bool)
+    dicts = [dict(sig) for sig in peer_sigs]
+    for s, sel in enumerate(selectors):
+        for p, labels in enumerate(dicts):
+            out[s, p] = sel.matches(labels)
+    return out
+
+
+def _affinity_columnar(selectors, peer_sigs) -> np.ndarray:
+    """Host columnar leg: per-key (presence, value-id) peer columns, one
+    vector op per selector clause. Mirrors matches() clause by clause:
+    an absent key fails match_labels and In, passes NotIn."""
+    P = len(peer_sigs)
+    key_cols: Dict[str, tuple] = {}
+
+    def cols_for(key: str):
+        ent = key_cols.get(key)
+        if ent is None:
+            has = np.zeros(P, bool)
+            vid = np.full(P, -1, np.int64)
+            vocab: Dict[str, int] = {}
+            for p, sig in enumerate(peer_sigs):
+                for k, v in sig:
+                    if k == key:
+                        has[p] = True
+                        vid[p] = vocab.setdefault(v, len(vocab))
+                        break
+            ent = key_cols[key] = (has, vid, vocab)
+        return ent
+
+    out = np.zeros((len(selectors), P), bool)
+    for s, sel in enumerate(selectors):
+        acc = np.ones(P, bool)
+        for k, v in sel.match_labels.items():
+            _has, vid, vocab = cols_for(k)
+            i = vocab.get(v)
+            acc &= (vid == i) if i is not None else np.zeros(P, bool)
+        for e in sel.match_expressions:
+            has, vid, vocab = cols_for(e.key)
+            if e.operator == "In":
+                ids = [vocab[v] for v in e.values if v in vocab]
+                acc &= np.isin(vid, ids) if ids else np.zeros(P, bool)
+            elif e.operator == "NotIn":
+                ids = [vocab[v] for v in e.values if v in vocab]
+                if ids:
+                    acc &= ~np.isin(vid, ids)
+            elif e.operator == "Exists":
+                acc &= has
+            else:  # DoesNotExist (signature gate excludes everything else)
+                acc &= ~has
+        out[s] = acc
+    return out
+
+
+def affinity_match_matrix(selectors, peer_sigs) -> np.ndarray:
+    """(S, P) bool: ``selectors[s].matches(dict(peer_sigs[p]))`` for every
+    cell, computed columnar (device bit-planes when available, numpy
+    columns otherwise) with the probe-verified scalar self-heal described
+    above. ``peer_sigs`` are :func:`labels_signature` tuples."""
+    if not selectors or not peer_sigs:
+        return np.zeros((len(selectors), len(peer_sigs)), bool)
+    if not affinity_columnar_enabled():
+        return _affinity_scalar(selectors, peer_sigs)
+    sigs = tuple(selector_signature(s) for s in selectors)
+    if any(sig is None for sig in sigs):
+        FILTER_FALLBACK_TOTAL.inc(reason="unsupported-operator")
+        return _affinity_scalar(selectors, peer_sigs)
+    t0 = time.perf_counter()
+    mat: Optional[np.ndarray] = None
+    try:
+        from karpenter_tpu.ops import device_filter
+
+        mat = device_filter.affinity_matrix(sigs, tuple(peer_sigs))
+    except Exception:
+        mat = None
+    if mat is None:
+        mat = _affinity_columnar(selectors, peer_sigs)
+    # probe self-heal: sampled cells against the scalar oracle; one
+    # divergence condemns the whole matrix (scalar wins)
+    S, P = mat.shape
+    rng = np.random.default_rng(S * 73856093 + P * 19349663 + 1)
+    k = min(_AFFINITY_PROBE_K, S * P)
+    cells = rng.choice(S * P, size=k, replace=False)
+    for c in cells:
+        s, p = int(c) // P, int(c) % P
+        if bool(mat[s, p]) != selectors[s].matches(dict(peer_sigs[p])):
+            FILTER_FALLBACK_TOTAL.inc(reason="affinity-mismatch")
+            mat = _affinity_scalar(selectors, peer_sigs)
+            break
+    FILTER_BATCH_SECONDS.observe(time.perf_counter() - t0, stage="affinity")
+    return mat
+
+
 def clear_catalog_caches() -> None:
     """Tests only."""
     with _CATALOG_LOCK:

@@ -1,0 +1,305 @@
+"""Device-vectorized packing-policy scoring for fused windows.
+
+The host cost tie-break prices one (packable, constraints) cell at a time —
+``policy.score()`` per packable per problem, a Python loop over offerings
+inside each call. A fused window (ops/device_filter.py) already holds the
+catalog's offering structure on device as bit-planes; this module scores
+EVERY feasible (schedule × type × capacity-type) cell of the window in one
+jit and hands the per-problem int32 micro-$ rows straight to the pack
+kernel's existing ``prices`` seam.
+
+Table algebra (host-built, cached per (planes, policy, cost config, ctx)):
+
+- ``price_ct (TB, C) int32``: the policy's base score of type t at capacity
+  type c, in micro-$ — encoded with models/ffd.encode_prices' exact
+  truncation (``min(int(p * 1e6), INT32_MAX)``). Encoding is monotone, so
+  min-over-offerings commutes with it: for penalty-free policies the device
+  row is bit-for-bit ``encode_prices([policy.score(...)])`` (the default
+  policy's differential guarantee rides on this).
+- ``rate_tz (TB, Z) float32``: spot interruption rate per (type, zone),
+  +inf where the type has no spot offering in the zone. Only built for the
+  interruption-priced policy.
+
+Device kernel per window: the offering viability product
+``zc & ct_allowed`` (the same algebra as device_filter._mask_expr), plus —
+for interruption-priced — the reclaim tax ``round(float32(min allowed-zone
+rate) × float32(repack micro-$))`` added to the spot column with a
+saturating int32 add (a saturated cell never beats a real price; a zero
+penalty leaves the cell bit-identical to the base price). ``best(b, t)`` is
+the min over viable capacity types, INT32_MAX where none.
+
+The device verdict stays a FILTER: every window's score rows are
+spot-checked at the fused probe columns against a numpy mirror of the same
+tables; a diverging member's whole row is re-derived on host (scalar wins,
+``karpenter_policy_fallback_total{reason="score-mismatch"}``), and any
+backend failure falls back to the per-cell host loop for the whole window.
+``KARPENTER_POLICY_DEVICE=0`` is the kill switch (the bench A/B lever).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.metrics.policy import (
+    POLICY_CELLS_SCORED_TOTAL, POLICY_FALLBACK_TOTAL, POLICY_SCORE_SECONDS,
+)
+
+_ENV = "KARPENTER_POLICY_DEVICE"
+_INT32_MAX = np.int32(np.iinfo(np.int32).max)
+
+_LOCK = threading.Lock()
+_TABLE_CACHE: dict = {}
+_TABLE_CACHE_CAP = 16
+
+
+def enabled() -> bool:
+    """Kill switch: KARPENTER_POLICY_DEVICE=0/false/off forces the per-cell
+    host loop (the bench A/B baseline); default ON."""
+    return os.environ.get(_ENV, "").strip().lower() not in ("0", "false", "off")
+
+
+def _encode_micro(p: float) -> np.int32:
+    """EXACTLY models/ffd.encode_prices' per-entry truncation, so the
+    device row and the host loop's encode_prices output agree bit-for-bit
+    for penalty-free policies."""
+    if p != float("inf"):
+        return np.int32(min(int(p * 1e6), int(_INT32_MAX)))
+    return _INT32_MAX
+
+
+class _Tables:
+    __slots__ = ("price_ct", "rate_tz", "spot_idx", "use_pen", "repack_micro")
+
+
+def _build_tables(planes, policy, cost_config, ctx) -> Optional[_Tables]:
+    """Host-side score tables over the planes' type axis. None when the
+    policy's algebra doesn't factor into (type, ct) base + spot penalty —
+    such policies keep the host loop."""
+    from karpenter_tpu.solver.policy import (
+        CheapestFeasible, InterruptionPriced, ThroughputPerDollar,
+    )
+
+    if not isinstance(policy, (CheapestFeasible, InterruptionPriced,
+                               ThroughputPerDollar)):
+        return None
+    C = max(1, len(planes.ct_vocab))
+    Z = max(1, len(planes.zone_vocab))
+    t = _Tables()
+    t.spot_idx = planes.ct_vocab.get(wellknown.CAPACITY_TYPE_SPOT, -1)
+    t.use_pen = (isinstance(policy, InterruptionPriced) and t.spot_idx >= 0
+                 and ctx.repack_cost_per_hour > 0.0)
+    t.repack_micro = np.float32(ctx.repack_cost_per_hour * 1e6)
+    t.price_ct = np.full((planes.TB, C), _INT32_MAX, np.int32)
+    t.rate_tz = np.full((planes.TB, Z), np.inf, np.float32) if t.use_pen \
+        else None
+    # resolve the planes axis back to instance types via the catalog key —
+    # callers pass the same uni_types list the planes were built from
+    return t
+
+
+def _fill_tables(t: _Tables, planes, uni_types, policy, cost_config, ctx):
+    from karpenter_tpu.solver.policy import ThroughputPerDollar
+
+    factor = cost_config.spot_price_factor
+    tput = isinstance(policy, ThroughputPerDollar)
+    for i, it in enumerate(uni_types):
+        div = 1.0
+        if tput:
+            div = float(ctx.throughput.get(it.name, 1.0))
+            if div <= 0.0:
+                continue  # zero-throughput types never win: stay INT32_MAX
+        for c, ci in planes.ct_vocab.items():
+            base = it.price * factor \
+                if c == wellknown.CAPACITY_TYPE_SPOT else it.price
+            # same float path as the scalar scorers: multiply/divide in
+            # float64, encode once at the end
+            t.price_ct[i, ci] = _encode_micro(base / div)
+        if t.rate_tz is not None:
+            for o in it.offerings:
+                if o.capacity_type != wellknown.CAPACITY_TYPE_SPOT:
+                    continue
+                z = planes.zone_vocab.get(o.zone)
+                if z is not None:
+                    t.rate_tz[i, z] = min(t.rate_tz[i, z],
+                                          np.float32(o.interruption_rate))
+
+
+def tables_for(planes, uni_types, policy, cost_config, ctx) -> Optional[_Tables]:
+    key = (planes.key, policy.name, cost_config, ctx.token())
+    with _LOCK:
+        hit = _TABLE_CACHE.get(key)
+    if hit is not None:
+        return hit if hit is not False else None
+    t = _build_tables(planes, policy, cost_config, ctx)
+    if t is not None:
+        _fill_tables(t, planes, uni_types, policy, cost_config, ctx)
+        t.price_ct.flags.writeable = False
+        if t.rate_tz is not None:
+            t.rate_tz.flags.writeable = False
+    with _LOCK:
+        if len(_TABLE_CACHE) >= _TABLE_CACHE_CAP:
+            _TABLE_CACHE.pop(next(iter(_TABLE_CACHE)))
+        _TABLE_CACHE[key] = t if t is not None else False
+    return t
+
+
+def _cells_expr(xp, offer_p, price_ct, zone_words, ct_allowed,
+                rate_tz, zone_allowed, repack, spot_idx, use_pen):
+    """The shared (B, TB, C) cell algebra — numpy and jax.numpy run the
+    same expression, so the host mirror IS the device program on xp=np."""
+    zc = ((offer_p[None, :, :, :] & zone_words[:, None, None, :]) != 0).any(-1)
+    viable = zc & ct_allowed[:, None, :]
+    cells = xp.where(viable, price_ct[None, :, :], _INT32_MAX)    # int32
+    if use_pen:
+        rmask = zone_allowed[:, None, :] & xp.isfinite(rate_tz)[None, :, :]
+        minrate = xp.min(
+            xp.where(rmask, rate_tz[None, :, :], xp.float32(xp.inf)),
+            axis=-1)                       # (B, TB), float32 on BOTH sides
+        # (a float64 promotion here would fork the mirror from the device)
+        # reclaim tax in float32, identical mirror ops both sides; the add
+        # saturates in uint32 (max sum (2^31-1) + 2^31 < 2^32, no wrap) so
+        # a saturated spot cell never beats a real price and a zero penalty
+        # leaves the cell bit-identical to the base price
+        penf = xp.where(xp.isfinite(minrate),
+                        xp.round(minrate.astype(xp.float32) * repack),
+                        xp.float32(0.0))
+        pen_u = xp.minimum(penf, xp.float32(2147483648.0)).astype(xp.uint32)
+        spot_u = cells[:, :, spot_idx].astype(xp.uint32)
+        cell_u = xp.minimum(spot_u + pen_u, xp.uint32(_INT32_MAX))
+        spot = cell_u.astype(xp.int32)
+        if xp is np:
+            cells[:, :, spot_idx] = spot
+        else:
+            cells = cells.at[:, :, spot_idx].set(spot)
+    best = xp.min(cells, axis=-1).astype(xp.int32)                # (B, TB)
+    return best, viable
+
+
+@functools.lru_cache(maxsize=8)
+def _score_jit(spot_idx: int, use_pen: bool):
+    import jax
+    import jax.numpy as jnp
+
+    def body(offer_p, price_ct, zone_words, ct_allowed, rate_tz,
+             zone_allowed, repack):
+        best, viable = _cells_expr(jnp, offer_p, price_ct, zone_words,
+                                   ct_allowed, rate_tz, zone_allowed,
+                                   repack, spot_idx, use_pen)
+        return best, jnp.sum(viable)
+
+    return jax.jit(body)
+
+
+def _rows_host(planes, verify) -> tuple:
+    """Per-schedule allowed words/bits for the scoring kernel, unpacked to
+    boolean ct/zone rows (host numpy; B and vocab sizes are small)."""
+    from karpenter_tpu.ops.device_filter import schedule_row
+
+    B = len(verify)
+    C = max(1, len(planes.ct_vocab))
+    Z = max(1, len(planes.zone_vocab))
+    Wz = planes.offer_plane.shape[2]
+    zone_words = np.zeros((B, Wz), np.uint32)
+    ct_allowed = np.zeros((B, C), bool)
+    zone_allowed = np.zeros((B, Z), bool)
+    for b, (allowed, required) in enumerate(verify):
+        _, _, _, zr, ct_bits, _ = schedule_row(planes, allowed, required)
+        zone_words[b] = zr
+        ct_allowed[b] = [(int(ct_bits) >> c) & 1 for c in range(C)]
+        zone_allowed[b] = [(int(zr[z // 32]) >> (z % 32)) & 1
+                           for z in range(Z)]
+    return zone_words, ct_allowed, zone_allowed
+
+
+def _host_best(t: _Tables, planes, zone_words, ct_allowed, zone_allowed,
+               cols: Optional[np.ndarray] = None) -> np.ndarray:
+    """Numpy mirror of the device program (optionally restricted to the
+    probe type columns) — the scalar-oracle leg of the filter contract."""
+    offer_p = planes.offer_plane
+    price_ct = t.price_ct
+    rate_tz = t.rate_tz
+    if cols is not None:
+        offer_p = offer_p[cols]
+        price_ct = price_ct[cols]
+        rate_tz = rate_tz[cols] if rate_tz is not None else None
+    if rate_tz is None:
+        rate_tz = np.zeros((price_ct.shape[0], zone_allowed.shape[1]),
+                           np.float32)
+    best, _ = _cells_expr(np, offer_p, price_ct, zone_words, ct_allowed,
+                          rate_tz.copy(), zone_allowed, t.repack_micro,
+                          t.spot_idx, t.use_pen)
+    return best
+
+
+def score_fused_window(fused, policy, cost_config, ctx) -> Optional[List[np.ndarray]]:
+    """Score every member of a fused batch on device: one jit for the whole
+    window, probe-verified per member. Returns one pre-encoded (TB,) int32
+    micro-$ row per member (aligned with ``fused.batch_idx``, gathered to
+    the member's packable order), or None → the caller runs the per-cell
+    host loop unchanged."""
+    from karpenter_tpu.ops.device_filter import planes_for
+
+    if not enabled():
+        return None
+    planes = planes_for(fused.uni_types)
+    if planes is None:
+        return None
+    tables = tables_for(planes, fused.uni_types, policy, cost_config, ctx)
+    if tables is None:
+        POLICY_FALLBACK_TOTAL.inc(reason="unfactorable-policy")
+        return None
+    t0 = time.perf_counter()
+    zone_words, ct_allowed, zone_allowed = _rows_host(planes, fused.verify)
+    rate_tz = tables.rate_tz if tables.rate_tz is not None else \
+        np.zeros((planes.TB, zone_allowed.shape[1]), np.float32)
+    try:
+        best_d, ncells = _score_jit(tables.spot_idx, tables.use_pen)(
+            planes.offer_plane, tables.price_ct, zone_words, ct_allowed,
+            rate_tz, zone_allowed, tables.repack_micro)
+        best = np.asarray(best_d)
+        POLICY_CELLS_SCORED_TOTAL.inc(amount=float(np.asarray(ncells)))
+    except Exception:
+        POLICY_FALLBACK_TOTAL.inc(reason="jax-backend-unavailable")
+        return None
+    POLICY_SCORE_SECONDS.observe(time.perf_counter() - t0, stage="device")
+
+    # probe verification: the fused window's sampled type columns, device
+    # vs the numpy mirror — exact int equality expected; a diverging
+    # member's row is re-derived fully on host (scalar wins)
+    t1 = time.perf_counter()
+    cols = np.unique(fused.probe_idx[fused.probe_idx < planes.n])
+    ref = _host_best(tables, planes, zone_words, ct_allowed, zone_allowed,
+                     cols=cols)                                # (B, K)
+    got = best[:, cols]
+    for b in range(len(fused.verify)):
+        if not np.array_equal(got[b], ref[b]):
+            POLICY_FALLBACK_TOTAL.inc(reason="score-mismatch")
+            best[b] = _host_best(
+                tables, planes, zone_words[b:b + 1], ct_allowed[b:b + 1],
+                zone_allowed[b:b + 1])[0]
+    POLICY_SCORE_SECONDS.observe(time.perf_counter() - t1, stage="verify")
+
+    # gather the planes axis to each member's packable order and pad to TB
+    # (identical today — universe packables ride the planes' type order —
+    # but the gather keeps the seam correct if packables ever filter)
+    idx = np.fromiter((p.index for p in fused.packables), np.int64,
+                      len(fused.packables))
+    out: List[np.ndarray] = []
+    for b in range(len(fused.batch_idx)):
+        row = np.full((planes.TB,), _INT32_MAX, np.int32)
+        row[:len(idx)] = best[b, idx]
+        out.append(row)
+    return out
+
+
+def clear_caches() -> None:
+    """Tests only."""
+    with _LOCK:
+        _TABLE_CACHE.clear()

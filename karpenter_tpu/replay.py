@@ -95,6 +95,13 @@ class ReplayConfig:
     flood_pool: int = 512         # distinct flood pod objects (cycled)
     gang_fraction: float = 0.0    # of the cohort: all-or-nothing pod groups
     gang_size: int = 4            # members per injected gang
+    # fraction of the default-band cohort pinned to spot capacity
+    # (node_selector capacity-type=spot). spot_fraction > 0 also registers
+    # the termination + capacity-GC controllers and (chaos on) arms seeded
+    # ``spot-interruption`` faults: reclaimed instances leave ghost Nodes,
+    # their pods are evicted, and the harness re-offers them like a
+    # ReplicaSet would — ``completed`` then asserts every one REBOUND
+    spot_fraction: float = 0.0
     # burn-sentinel objective overrides for this run, band -> threshold_s
     # (None keeps whatever obs/slo.py has configured); the bench's seeded-
     # chaos probe leg uses a deliberately impossible objective to prove
@@ -113,6 +120,9 @@ class ReplayConfig:
         if not 0.0 <= self.gang_fraction <= 1.0:
             raise ValueError(
                 f"gang_fraction must be in [0, 1]: {self.gang_fraction}")
+        if not 0.0 <= self.spot_fraction <= 1.0:
+            raise ValueError(
+                f"spot_fraction must be in [0, 1]: {self.spot_fraction}")
         if self.gang_size < 1:
             raise ValueError(f"gang_size must be >= 1: {self.gang_size}")
         overhead = self.bound_cohort + self.churn_pods
@@ -268,7 +278,8 @@ def run_replay(cfg: ReplayConfig) -> dict:
         window_l1_seconds=2.0))
     core = KubeCore()
     kube = inject.ChaosKube(core) if cfg.chaos else core
-    provider = decorate(FakeCloudProvider(catalog=tenant_catalog(cfg.tenants)))
+    fake = FakeCloudProvider(catalog=tenant_catalog(cfg.tenants))
+    provider = decorate(fake)
     provisioning = ProvisioningController(
         kube, provider,
         batcher_factory=functools.partial(
@@ -279,6 +290,15 @@ def run_replay(cfg: ReplayConfig) -> dict:
     manager.register(provisioning, workers=2)
     manager.register(SelectionController(kube, provisioning), workers=16)
     manager.register(NodeController(kube), workers=4)
+    if cfg.spot_fraction > 0.0:
+        # spot runs need the full reclaim loop: termination drains the
+        # ghost Node, GC reaps it (soak-scale grace, as in test_chaos.py)
+        from karpenter_tpu.controllers.gc import GarbageCollection
+        from karpenter_tpu.controllers.termination import TerminationController
+        manager.register(TerminationController(kube, provider), workers=4)
+        manager.register(GarbageCollection(kube, provider,
+                                           interval_seconds=0.5,
+                                           grace_seconds=2.0))
     for t in range(cfg.tenants):
         core.create(tenant_provisioner(t))  # setup bypasses injection
 
@@ -286,6 +306,16 @@ def run_replay(cfg: ReplayConfig) -> dict:
     if cfg.chaos:
         plan = inject.FaultPlan(cfg.seed, REPLAY_SPECS, window=64)
         inject.install(plan)
+    # spot interruptions ride their own seeded stream, drawn once per tick
+    # by the harness itself (ticks 1..T-1, window = draw count, so every
+    # planned interruption is guaranteed to land mid-run — after the spot
+    # cohort had a tick to bind, before the settle phase)
+    reclaim_plan = None
+    if cfg.chaos and cfg.spot_fraction > 0.0 and cfg.ticks > 1:
+        reclaim_plan = inject.FaultPlan(cfg.seed, [
+            inject.FaultSpec("provider", "reclaim", "spot-interruption",
+                             max(1, min(2, cfg.ticks - 1)))],
+            window=cfg.ticks - 1)
     manager.start()
 
     offered: Dict[str, int] = {b: 0 for b in COHORT_BANDS + FLOOD_BANDS}
@@ -304,6 +334,49 @@ def run_replay(cfg: ReplayConfig) -> dict:
     churn_deleted = 0
     sampler = _StoreSampler(core)
     watch_q = core.watch("Pod", meta_only=True)
+    # spot bookkeeping: construction shapes for ReplicaSet-style re-offers,
+    # the ids an interruption reclaimed, and the displaced/rebound ledger
+    cohort_shape: Dict[str, dict] = {}
+    reclaimed_ids: List[str] = []
+    displaced: set = set()
+    spot_offered = 0
+
+    def _shaped_pod(name: str) -> Pod:
+        sh = cohort_shape[name]
+        pod = _pending_pod(name, zone=sh["zone"], requests=sh["requests"],
+                           priority=sh["priority"],
+                           priority_class_name=sh["priority_class_name"])
+        if sh["spot"]:
+            pod.spec.node_selector[wellknown.LABEL_CAPACITY_TYPE] = \
+                wellknown.CAPACITY_TYPE_SPOT
+        return pod
+
+    def _reoffer(name: str) -> None:
+        """A reclaim evicted this bound cohort pod; recreate it with the
+        same shape (what its ReplicaSet would do) and restart its
+        pending→bound clock — ``completed`` then requires the rebind."""
+        displaced.add(name)
+        bound_at.pop(name, None)
+        created_at[name] = time.perf_counter()
+        try:
+            kube.create(_shaped_pod(name))
+        except Exception:
+            pass  # injected fault: _retry_displaced picks it up
+
+    def _retry_displaced() -> None:
+        """Settle-loop sweep: a displaced pod whose re-offer died on an
+        injected apiserver fault is offered again until it exists."""
+        for name in displaced:
+            if name in bound_at:
+                continue
+            try:
+                core.read("Pod", name, "default", lambda p: None)
+            except NotFound:
+                try:
+                    kube.create(_shaped_pod(name))
+                    created_at[name] = time.perf_counter()
+                except Exception:
+                    pass
 
     def _observe():
         nonlocal peak_level, peak_rss
@@ -320,7 +393,13 @@ def run_replay(cfg: ReplayConfig) -> dict:
             except Exception:
                 return
             name = event.obj.metadata.name
-            if (event.type == "MODIFIED" and name in created_at
+            if (event.type == "DELETED" and name in bound_at
+                    and name in cohort_shape):
+                # eviction off a reclaimed spot node — the only path that
+                # deletes a BOUND cohort pod (churn/gang withdrawals are
+                # never in bound_at + cohort_shape)
+                _reoffer(name)
+            elif (event.type == "MODIFIED" and name in created_at
                     and name not in bound_at):
                 try:
                     if core.read("Pod", name, "default",
@@ -355,11 +434,18 @@ def run_replay(cfg: ReplayConfig) -> dict:
                 band, prio, pcn = "high", 100, ""
             else:
                 band, prio, pcn = "default", 0, ""
+            requests = {"cpu": f"{rng.choice([100, 250, 500])}m",
+                        "memory": f"{rng.choice([128, 512])}Mi"}
+            # deterministic spot striping over the default band (no rng
+            # draw, so spot_fraction=0 runs keep their exact rng stream)
+            spot = (band == "default" and cfg.spot_fraction > 0.0
+                    and (i % 10) < round(cfg.spot_fraction * 10))
             pod = _pending_pod(
                 f"cohort-{band}-{i}", zone=tenant_zone(i % cfg.tenants),
-                requests={"cpu": f"{rng.choice([100, 250, 500])}m",
-                          "memory": f"{rng.choice([128, 512])}Mi"},
-                priority=prio, priority_class_name=pcn)
+                requests=requests, priority=prio, priority_class_name=pcn)
+            if spot:
+                pod.spec.node_selector[wellknown.LABEL_CAPACITY_TYPE] = \
+                    wellknown.CAPACITY_TYPE_SPOT
             try:
                 kube.create(pod)
             except Exception:
@@ -368,8 +454,12 @@ def run_replay(cfg: ReplayConfig) -> dict:
                 except Exception:
                     continue
             offered[band] += 1
+            spot_offered += spot
             created_at[pod.metadata.name] = time.perf_counter()
             band_of[pod.metadata.name] = band
+            cohort_shape[pod.metadata.name] = {
+                "zone": tenant_zone(i % cfg.tenants), "requests": requests,
+                "priority": prio, "priority_class_name": pcn, "spot": spot}
 
         # ---- gang cohort: all-or-nothing pod groups (gang_fraction) ----
         # seeded gang workloads ride the same full path as the cohort;
@@ -472,6 +562,12 @@ def run_replay(cfg: ReplayConfig) -> dict:
             _drain_watch()
             sampler.sample(next(iter(created_at), None))
             time.sleep(cfg.tick_sleep_s)
+            # one interruption draw per tick (tick 0 skipped: the spot
+            # cohort needs a tick to land before anything is reclaimable)
+            if (reclaim_plan is not None and tick >= 1
+                    and reclaim_plan.decide("provider", "reclaim")
+                    == "spot-interruption"):
+                reclaimed_ids.extend(fake.reclaim_spot(1))
         for name in pending_churn:  # trailing churn tick
             try:
                 kube.delete("Pod", name, "default")
@@ -487,6 +583,7 @@ def run_replay(cfg: ReplayConfig) -> dict:
         while time.monotonic() < deadline:
             _observe()
             _drain_watch()
+            _retry_displaced()
             level = int(monitor.level())
             if recovery_at is None and level == 0:
                 recovery_at = time.monotonic()
@@ -543,6 +640,24 @@ def run_replay(cfg: ReplayConfig) -> dict:
             "trips": obslo.trips_total(),
             "burn": obslo.state()["burn"],
         }
+        spot_section = None
+        if cfg.spot_fraction > 0.0:
+            live_spot = sum(
+                1 for r in fake.list_instances()
+                if r.capacity_type == wellknown.CAPACITY_TYPE_SPOT)
+            spot_section = {
+                "cohort_spot_pods": spot_offered,
+                # every spot launch is either still in the ledger or was
+                # reclaimed — their sum is the total spot fleet the run saw
+                "spot_instances_live": live_spot,
+                "interruptions": (
+                    reclaim_plan.fired_counts().get(
+                        ("provider", "reclaim", "spot-interruption"), 0)
+                    if reclaim_plan is not None else 0),
+                "instances_reclaimed": len(reclaimed_ids),
+                "displaced": len(displaced),
+                "rebound": sum(1 for n in displaced if n in bound_at),
+            }
         gangs_full = sum(1 for ms in gang_members.values()
                          if all(n in bound_at for n in ms))
         partial_gangs = sum(
@@ -568,12 +683,14 @@ def run_replay(cfg: ReplayConfig) -> dict:
                 "gangs_fully_bound": gangs_full,
                 "partial_gangs": partial_gangs,
             },
+            "spot": spot_section,
             "store_ops": sampler.report(),
             "slo": slo_section,
             "slo_digest_parity": digest_parity,
             "rss_growth_mib": (peak_rss - start_rss) >> 20,
-            "chaos_fired": ({f"{b}/{o}/{k}": n for (b, o, k), n
-                             in plan.fired_counts().items()}
+            "chaos_fired": ({f"{b}/{o}/{k}": n for p in (plan, reclaim_plan)
+                             if p is not None
+                             for (b, o, k), n in p.fired_counts().items()}
                             if plan is not None else None),
             "workers_healthy": manager.healthz(),
             "nproc": _os.cpu_count(),
@@ -675,6 +792,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--gang-fraction", type=float, default=0.0,
                     help="fraction of the cohort offered as gangs")
     ap.add_argument("--gang-size", type=int, default=4)
+    ap.add_argument("--spot-fraction", type=float, default=0.0,
+                    help="fraction of the default-band cohort pinned to "
+                         "spot; > 0 arms seeded spot-interruption reclaims "
+                         "and requires every displaced pod to rebind")
     ap.add_argument("--no-chaos", action="store_true")
     args = ap.parse_args(argv)
     cfg = ReplayConfig(
@@ -684,7 +805,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         max_depth=max(400, args.pods_total // 3), ticks=args.ticks,
         tick_sleep_s=0.1, chaos=not args.no_chaos, settle_s=args.settle_s,
         flood_pool=128, gang_fraction=args.gang_fraction,
-        gang_size=args.gang_size)
+        gang_size=args.gang_size, spot_fraction=args.spot_fraction)
     report = run_replay(cfg)
     print(json.dumps(report, indent=2, default=str))
     return 0 if report["completed"] else 1

@@ -1,0 +1,200 @@
+"""Pod-pod affinity/anti-affinity as just-in-time hostname selectors.
+
+The topology-spread trick (scheduling/topology.py, scheduler.go:69-72)
+carries over: affinity decisions are injected into pods as node selectors
+*before* constraint grouping, so the solver stays oblivious to them.
+Supported surface: **required** podAffinity / podAntiAffinity terms whose
+``topology_key`` is the hostname label, with selector operators In / NotIn /
+Exists / DoesNotExist — exactly what the columnar match engine
+(ops/feasibility.affinity_match_matrix) compiles; SelectionController's
+``validate`` rejects everything else up front.
+
+Because this provisioner only creates NEW nodes (fresh, unique hostnames),
+the peer set of an affinity decision is the provisioning window itself:
+no existing pod runs on a node that doesn't exist yet, so anti-affinity
+against running pods is vacuously satisfied on provisioned capacity and
+positive affinity can only be satisfied by co-provisioned peers. Within
+the window:
+
+- **Affinity** edges (i's required term matches j's labels, same
+  namespace) are symmetric co-location demands: connected components all
+  share ONE fresh hostname domain, so they group into one schedule and
+  pack together. Exact when the component fits a single node; a component
+  the packer must split across nodes keeps only per-node violations the
+  kube scheduler would also have produced — documented limitation
+  (docs/scheduling.md).
+- **Anti-affinity** conflicts (either pod's required anti term matches the
+  other's labels, same namespace, distinct pods) force distinct hostnames:
+  every component touching a conflict gets its OWN fresh domain, which
+  puts the two sides into different schedules — and different schedules
+  launch disjoint node sets, so separation is exact.
+- A conflict INSIDE one co-location component is unsatisfiable: its pods
+  are marked ``_affinity_unsat``, stamped with the empty domain (failing
+  validation exactly like topology's no-domain case), and shed through
+  the band-aware requeue path.
+
+The match matrix itself is columnar with the probe-verified scalar
+self-heal and the ``KARPENTER_POLICY_COLUMNAR=0`` kill switch — a
+divergence is counted as filter_fallback_total{reason="affinity-mismatch"}
+and the scalar matches() verdict wins, so the bitset engine can never
+separate pods the scalar algebra would co-locate (or vice versa).
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.api.core import NodeSelectorRequirement, Pod
+from karpenter_tpu.ops import feasibility
+
+
+def _hostname_terms(pod: Pod, anti: bool) -> list:
+    """Required hostname-keyed terms of one side (affinity / anti)."""
+    aff = pod.spec.affinity
+    if aff is None:
+        return []
+    side = aff.pod_anti_affinity if anti else aff.pod_affinity
+    if side is None:
+        return []
+    return [t for t in side.required
+            if t.topology_key == wellknown.LABEL_HOSTNAME
+            and t.label_selector is not None]
+
+
+def has_affinity(pod: Pod) -> bool:
+    return bool(_hostname_terms(pod, False) or _hostname_terms(pod, True))
+
+
+class _UnionFind:
+    def __init__(self, n: int):
+        self.parent = list(range(n))
+
+    def find(self, i: int) -> int:
+        while self.parent[i] != i:
+            self.parent[i] = self.parent[self.parent[i]]
+            i = self.parent[i]
+        return i
+
+    def union(self, a: int, b: int) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self.parent[rb] = ra
+
+
+class AffinityGroups:
+    """One injection pass per provisioning window (Scheduler.solve)."""
+
+    def inject(self, constraints: Constraints, pods: List[Pod]) -> None:
+        participants = [p for p in pods if has_affinity(p)]
+        if not participants:
+            return
+        for pod in pods:
+            pod.__dict__.pop("_affinity_unsat", None)
+
+        # dedupe both matrix axes: selectors by signature (scalar-sig rows
+        # keep their LabelSelector object for the oracle), peers by
+        # (namespace, labels) — affinity terms scope to the pod's namespace
+        selectors: List = []
+        sel_idx: Dict[tuple, int] = {}
+        peer_sigs: List[tuple] = []
+        peer_idx: Dict[tuple, int] = {}
+        pod_peer: List[int] = []
+
+        def sel_of(sel) -> int:
+            sig = feasibility.selector_signature(sel)
+            key = sig if sig is not None else ("scalar", id(sel))
+            i = sel_idx.get(key)
+            if i is None:
+                i = sel_idx[key] = len(selectors)
+                selectors.append(sel)
+            return i
+
+        for pod in pods:
+            sig = feasibility.labels_signature(pod.metadata.labels)
+            i = peer_idx.get(sig)
+            if i is None:
+                i = peer_idx[sig] = len(peer_sigs)
+                peer_sigs.append(sig)
+            pod_peer.append(i)
+
+        aff_terms: List[List[int]] = []   # pod -> selector rows (affinity)
+        anti_terms: List[List[int]] = []  # pod -> selector rows (anti)
+        for pod in pods:
+            aff_terms.append([sel_of(t.label_selector)
+                              for t in _hostname_terms(pod, False)])
+            anti_terms.append([sel_of(t.label_selector)
+                               for t in _hostname_terms(pod, True)])
+
+        matrix = feasibility.affinity_match_matrix(selectors, peer_sigs)
+
+        def matches(rows: List[int], j: int) -> bool:
+            pj = pod_peer[j]
+            return any(matrix[s, pj] for s in rows)
+
+        n = len(pods)
+        ns = [p.metadata.namespace for p in pods]
+        uf = _UnionFind(n)
+        conflicts: List[Tuple[int, int]] = []
+        lonely: List[int] = []  # required affinity with no peer in window
+        for i in range(n):
+            if not (aff_terms[i] or anti_terms[i]):
+                continue
+            attracted = False
+            for j in range(n):
+                if i == j or ns[i] != ns[j]:
+                    continue
+                if aff_terms[i] and matches(aff_terms[i], j):
+                    uf.union(i, j)
+                    attracted = True
+                if anti_terms[i] and matches(anti_terms[i], j):
+                    conflicts.append((i, j))
+            if aff_terms[i] and not attracted and not matches(aff_terms[i], i):
+                # no window peer matches and the pod can't anchor its own
+                # term (kube-scheduler's first-pod rule needs a self-match);
+                # a fresh node can never satisfy it — shed, don't misplace
+                lonely.append(i)
+
+        comp_pods: Dict[int, List[int]] = {}
+        for i in range(n):
+            comp_pods.setdefault(uf.find(i), []).append(i)
+        needs_domain: Dict[int, bool] = {}
+        unsat: Dict[int, bool] = {}
+        for i in lonely:
+            unsat[uf.find(i)] = True
+        for root, members in comp_pods.items():
+            needs_domain[root] = len(members) > 1 and any(
+                aff_terms[i] or anti_terms[i] for i in members)
+        for i, j in conflicts:
+            ri, rj = uf.find(i), uf.find(j)
+            if ri == rj:
+                unsat[ri] = True  # must co-locate AND must separate
+            else:
+                needs_domain[ri] = True
+                needs_domain[rj] = True
+
+        domains: List[str] = []
+        for root, members in comp_pods.items():
+            if unsat.get(root):
+                for i in members:
+                    pods[i].__dict__["_affinity_unsat"] = True
+                    pods[i].spec.node_selector = {
+                        **pods[i].spec.node_selector,
+                        wellknown.LABEL_HOSTNAME: "",
+                    }
+                continue
+            if not needs_domain.get(root):
+                continue
+            domain = secrets.token_hex(4)
+            domains.append(domain)
+            for i in members:
+                pods[i].spec.node_selector = {
+                    **pods[i].spec.node_selector,
+                    wellknown.LABEL_HOSTNAME: domain,
+                }
+        if domains:
+            # admit the fresh domains exactly like hostname topology spread
+            constraints.requirements.items.append(NodeSelectorRequirement(
+                key=wellknown.LABEL_HOSTNAME, operator="In", values=domains))

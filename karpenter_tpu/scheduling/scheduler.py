@@ -21,6 +21,7 @@ from karpenter_tpu.metrics.filter import FILTER_BATCH_SECONDS
 from karpenter_tpu.ops import feasibility
 from karpenter_tpu.runtime.kubecore import KubeCore
 from karpenter_tpu.solver import adapter
+from karpenter_tpu.scheduling.affinity import AffinityGroups
 from karpenter_tpu.scheduling.topology import Topology
 from karpenter_tpu.utils import resources as res
 
@@ -55,11 +56,16 @@ class Scheduler:
     def __init__(self, kube: KubeCore):
         self.kube = kube
         self.topology = Topology(kube)
+        self.affinity = AffinityGroups()
 
     def solve(self, provisioner: Provisioner, pods: List[Pod]) -> List[Schedule]:
-        """scheduler.go:66-82."""
+        """scheduler.go:66-82. Affinity injects after topology so a pod
+        carrying both a hostname spread and a pod-(anti-)affinity term gets
+        the affinity verdict (the stricter of the two — separation/
+        co-location is a hard constraint, skew is best-effort balance)."""
         constraints = provisioner.spec.constraints.deepcopy()
         self.topology.inject(constraints, pods)
+        self.affinity.inject(constraints, pods)
         return self._get_schedules(constraints, pods)
 
     def _get_schedules(self, constraints: Constraints, pods: List[Pod]) -> List[Schedule]:
@@ -75,6 +81,7 @@ class Scheduler:
         schedules: Dict[tuple, Schedule] = {}
         skipped = 0
         topo_skipped = 0
+        aff_skipped = 0
         gang_skipped = 0
         samples: List[str] = []
         for pod in pods:
@@ -101,6 +108,10 @@ class Scheduler:
                 if pod.__dict__.get("_topology_unsat"):
                     # topology.inject found no satisfiable spread domain
                     topo_skipped += 1
+                elif pod.__dict__.get("_affinity_unsat"):
+                    # affinity.inject proved the pod's required pod-pod
+                    # constraints unsatisfiable within the window
+                    aff_skipped += 1
                 if len(samples) < 5:
                     samples.append(f"{pod.metadata.namespace}/"
                                    f"{pod.metadata.name}: {err}")
@@ -137,9 +148,11 @@ class Scheduler:
                                f"{len(s.pods)}/{s.gang.size} members")
         if skipped:
             log.info("unable to schedule %d/%d pod(s) in window "
-                     "(reason=topology: %d, reason=gang: %d, other: %d): %s",
-                     skipped, len(pods), topo_skipped, gang_skipped,
-                     skipped - topo_skipped - gang_skipped,
+                     "(reason=topology: %d, reason=affinity: %d, "
+                     "reason=gang: %d, other: %d): %s",
+                     skipped, len(pods), topo_skipped, aff_skipped,
+                     gang_skipped,
+                     skipped - topo_skipped - aff_skipped - gang_skipped,
                      "; ".join(samples))
         FILTER_BATCH_SECONDS.observe(time.perf_counter() - t0,
                                      stage="schedule")

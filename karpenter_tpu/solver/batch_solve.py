@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
@@ -125,17 +126,23 @@ def _dispatch_batch(problems: Sequence[Problem],
             required=required)
         prepared[i] = (packables, sorted_types, vecs, sids, cat_version)
 
-    def _problem_prices(i: int) -> Optional[list]:
-        """Per-problem effective prices for the in-kernel cost tie-break —
-        the SAME vector the solo path builds (solve.py solve_with_packables),
-        so batched and solo cost-mode solves stay differential. Called only
-        for problems that actually join the device batch: solo fallbacks
-        build their own, and paying effective_price() for a batch the gate
-        rejects would waste the provisioning hot loop. Fused members price
-        the whole universe axis — the kernel only ever compares prices of
-        mask-valid types, so the extra rows are inert."""
-        from karpenter_tpu.models.cost import effective_price
+    from karpenter_tpu.solver import policy as policy_registry
 
+    policy = policy_registry.get(config.packing_policy)
+    # non-default policies imply the in-kernel tie-break: a policy that
+    # never scored would silently behave as cheapest (solver/policy.py)
+    tiebreak = config.cost_tiebreak or policy.always_tiebreak
+
+    def _problem_prices(i: int) -> Optional[list]:
+        """Per-problem policy scores for the in-kernel cost tie-break —
+        the SAME vector the solo path builds (solve.py solve_with_packables),
+        so batched and solo cost-mode solves stay differential. This is the
+        per-cell HOST loop (one policy.score() per packable per problem):
+        the fallback leg of the device scoring kernel (ops/policy.py) and
+        the classic windows' only leg. Called only for problems that
+        actually join the device batch: solo fallbacks build their own.
+        Fused members price the whole universe axis — the kernel only ever
+        compares prices of mask-valid types, so the extra rows are inert."""
         if i in fused_set:
             packables, sorted_types = fused.packables, fused.uni_types
         else:
@@ -143,9 +150,9 @@ def _dispatch_batch(problems: Sequence[Problem],
         if not (packables and any(it.price for it in sorted_types)):
             return None
         return [
-            effective_price(sorted_types[p.index],
-                            problems[i].constraints.requirements,
-                            config.cost_config)[0]
+            policy.score(sorted_types[p.index],
+                         problems[i].constraints.requirements,
+                         config.cost_config, config.policy_context)[0]
             for p in packables
         ]
 
@@ -186,9 +193,34 @@ def _dispatch_batch(problems: Sequence[Problem],
                     batch_packables = [fused.packables] * len(batch_idx)
                 else:
                     batch_packables = [prepared[i][0] for i in batch_idx]
-                batch_prices = [
-                    _problem_prices(i) if config.cost_tiebreak else None
-                    for i in batch_idx]
+                batch_prices: List = [None] * len(batch_idx)
+                if tiebreak:
+                    # fused windows score every (schedule × type × offering)
+                    # cell in ONE device jit (ops/policy.py) and ride the
+                    # prices seam as pre-encoded int32 rows; classic windows
+                    # (and any device-scoring fallback) pay the per-cell
+                    # host loop
+                    rows = None
+                    if fused is not None and \
+                            any(it.price for it in fused.uni_types):
+                        from karpenter_tpu.ops import policy as ops_policy
+
+                        rows = ops_policy.score_fused_window(
+                            fused, policy, config.cost_config,
+                            config.policy_context)
+                    if rows is not None:
+                        batch_prices = rows
+                    else:
+                        from karpenter_tpu.metrics.policy import (
+                            POLICY_SCORE_SECONDS,
+                        )
+
+                        t_score = time.perf_counter()
+                        batch_prices = [_problem_prices(i)
+                                        for i in batch_idx]
+                        if any(p is not None for p in batch_prices):
+                            POLICY_SCORE_SECONDS.observe(
+                                time.perf_counter() - t_score, stage="host")
                 run = _launch_device_batch(
                     encs, batch_packables, batch_prices, config, fused=fused)
         except Exception:  # device ring: never drop a provisioning loop
@@ -406,14 +438,21 @@ class _DeviceBatchRun:
                 # unreachable behind the 100k batch guard, checked anyway
                 kernel = "xla"
         self.kernel = kernel
-        self.use_cost = config.cost_tiebreak and any(
-            p is not None for p in prices_list)
+        # the dispatch side already resolved WHETHER to tie-break (policy
+        # always_tiebreak folded in): a non-None row here means priced
+        self.use_cost = any(p is not None for p in prices_list)
         T = totals.shape[1]
         if self.use_cost:
             prices_arr = np.full((shapes.shape[0], T),
                                  np.iinfo(np.int32).max, np.int32)
             for b, pr in enumerate(prices_list):
-                if pr is not None:
+                if pr is None:
+                    continue
+                if isinstance(pr, np.ndarray) and pr.dtype == np.int32:
+                    # pre-encoded micro-$ row from the device scoring
+                    # kernel (ops/policy.py) — already on the padded axis
+                    prices_arr[b, :pr.shape[0]] = pr
+                else:
                     prices_arr[b] = encode_prices(pr, T)
         else:
             # an explicit zero row per problem (the kernel's "unpriced"

@@ -21,6 +21,8 @@ from karpenter_tpu.models.cost import (
     CostConfig, effective_price, order_options_by_price,
 )
 from karpenter_tpu.models.ffd import solve_ffd_device
+from karpenter_tpu.solver.policy import PolicyContext
+from karpenter_tpu.solver import policy as policy_registry
 from karpenter_tpu.solver import host_ffd
 from karpenter_tpu.solver.adapter import (
     build_packables_versioned, marshal_pods_interned,
@@ -297,6 +299,17 @@ class SolverConfig:
     # KARPENTER_DEVICE_FILTER=0 kill switch, which wins over this flag)
     # restores the per-problem host columnar filter for batched windows.
     device_filter: bool = True
+    # packing policy (solver/policy.py registry): which score orders each
+    # node's type options and feeds the in-kernel tie-break. "cheapest"
+    # (the default) delegates to models/cost.py and is bit-for-bit the
+    # pre-policy behavior (tests/test_policy.py differential contract);
+    # non-default policies imply the tie-break (always_tiebreak) since a
+    # policy that never scored would silently be cheapest.
+    packing_policy: str = "cheapest"
+    # pricing context for non-default policies: the what-if engine's
+    # repack cost (interruption-priced) and the throughput table
+    # (throughput-per-dollar); inert for "cheapest"
+    policy_context: PolicyContext = field(default_factory=PolicyContext)
     # auto-select the type-SPMD kernel (device_kernel=None) only when the
     # padded type bucket reaches this size AND the mesh has more than one
     # device: below it, the per-node collective round-trips cost more than
@@ -413,13 +426,18 @@ def solve_with_packables(
 
     pod_ids = list(range(len(pods)))
 
-    # per-packable effective $/h for the in-kernel cost tie-break; the SAME
-    # vector feeds every executor so the fallback rings stay differential
+    # per-packable policy score ($/h-shaped, lower wins) for the in-kernel
+    # cost tie-break; the SAME vector feeds every executor so the fallback
+    # rings stay differential. The default policy's score IS
+    # effective_price (structural delegation, solver/policy.py), so
+    # cost-tiebreak solves are unchanged bit-for-bit under "cheapest".
+    policy = policy_registry.get(config.packing_policy)
     prices = None
-    if config.cost_tiebreak and any(it.price for it in sorted_types):
+    if (config.cost_tiebreak or policy.always_tiebreak) and \
+            any(it.price for it in sorted_types):
         prices = [
-            effective_price(sorted_types[p.index], constraints.requirements,
-                            config.cost_config)[0]
+            policy.score(sorted_types[p.index], constraints.requirements,
+                         config.cost_config, config.policy_context)[0]
             for p in packables
         ]
 
@@ -504,9 +522,21 @@ def materialize(result, pods, sorted_types, constraints: Constraints,
         for hp in result.packings
     ]
     if config.cost_aware and any(it.price for it in sorted_types):
+        from karpenter_tpu.api import wellknown
+        from karpenter_tpu.metrics.policy import POLICY_SPOT_SELECTED_TOTAL
+
+        policy = policy_registry.get(config.packing_policy)
         for p in packings:
-            p.instance_type_options = order_options_by_price(
-                p.instance_type_options, constraints.requirements, config.cost_config)
+            p.instance_type_options = policy.order_options(
+                p.instance_type_options, constraints.requirements,
+                config.cost_config, config.policy_context)
+            if p.instance_type_options:
+                _, ct = policy.score(
+                    p.instance_type_options[0], constraints.requirements,
+                    config.cost_config, config.policy_context)
+                if ct == wellknown.CAPACITY_TYPE_SPOT:
+                    POLICY_SPOT_SELECTED_TOTAL.inc(
+                        amount=float(p.node_quantity), policy=policy.name)
     return SolveResult(
         packings=packings,
         unschedulable=[pods[i] for i in result.unschedulable],

@@ -550,6 +550,143 @@ def _run_faulted_soak(specs, window, pods_total, burst_gap_s, settle_s,
         manager.stop()
 
 
+class TestSpotInterruptionSoak:
+    def test_reclaim_repacks_and_leaks_nothing(self):
+        """Seeded ``spot-interruption`` fault: a provisioning-time create
+        draws the fault, the oldest running spot instance vanishes from the
+        capacity ledger, its Node survives as a ghost. Invariants after the
+        dust settles: the ghost is reaped, every pod (including the
+        ReplicaSet-style recreations of the evicted ones) rebinds, and
+        leaked capacity / unbound pods converge to zero."""
+        import functools
+
+        from karpenter_tpu.controllers.counter import CounterController
+        from karpenter_tpu.controllers.gc import GarbageCollection
+        from karpenter_tpu.controllers.node import NodeController
+        from karpenter_tpu.controllers.termination import TerminationController
+
+        seed = CHAOS_SEED
+        print(f"spot soak: seed={seed} "
+              "(replay with KARPENTER_CHAOS_SEED=<seed>)")
+        core = KubeCore()
+        fake = FakeCloudProvider(catalog=instance_types(8))
+        provider = decorate(fake)
+        provisioning = ProvisioningController(
+            core, provider,
+            batcher_factory=functools.partial(
+                Batcher, idle_seconds=0.05, max_seconds=0.5))
+        manager = Manager(core)
+        manager.register(provisioning, workers=2)
+        manager.register(SelectionController(core, provisioning), workers=16)
+        manager.register(NodeController(core), workers=4)
+        manager.register(TerminationController(core, provider), workers=4)
+        manager.register(CounterController(core))
+        manager.register(GarbageCollection(core, provider,
+                                           interval_seconds=0.25,
+                                           grace_seconds=2.0))
+        prov = Provisioner()
+        prov.metadata.name = "chaos"
+        core.create(prov)
+        manager.start()
+
+        def shape(i):
+            # 1500m on the small-types catalog: few pods per node, so the
+            # window launches several nodes and a reclaim displaces pods
+            return {"requests": {"cpu": "1500m", "memory": "512Mi"},
+                    "name": f"spot-{i}"}
+
+        created = []
+        try:
+            # phase A: a steady fleet binds BEFORE any fault is armed, so
+            # the ledger holds reclaimable spot capacity
+            for i in range(6):
+                pod = unschedulable_pod(**shape(i))
+                core.create(pod)
+                created.append(pod.metadata.name)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if all(core.read("Pod", n, "default",
+                                 lambda p: p.spec.node_name)
+                       for n in created):
+                    break
+                time.sleep(0.1)
+            before = {r.instance_id for r in fake.list_instances()}
+            assert before, "phase A launched nothing — soak is vacuous"
+            assert any(r.capacity_type == wellknown.CAPACITY_TYPE_SPOT
+                       for r in fake.list_instances()), (
+                "no spot capacity in the ledger — nothing to interrupt")
+
+            # phase B: arm the plan (window=1 → the very next create unit
+            # draws the fault) and push more pods through provisioning
+            plan = inject.FaultPlan(seed, [
+                inject.FaultSpec("provider", "create",
+                                 "spot-interruption", 1)], window=1)
+            inject.install(plan)
+            try:
+                for i in range(6, 10):
+                    pod = unschedulable_pod(**shape(i))
+                    core.create(pod)
+                    created.append(pod.metadata.name)
+
+                # settle: recreate evicted pods like a ReplicaSet would, so
+                # "unbound stays 0" asserts an actual repack, not attrition
+                deadline = time.monotonic() + 45.0
+                unbound, leaked, ghosts = list(created), [], []
+                while time.monotonic() < deadline:
+                    unbound = []
+                    for name in created:
+                        try:
+                            if not core.read("Pod", name, "default",
+                                             lambda p: p.spec.node_name):
+                                unbound.append(name)
+                        except NotFound:
+                            idx = int(name.rsplit("-", 1)[1])
+                            core.create(unschedulable_pod(**shape(idx)))
+                            unbound.append(name)
+                    records = provider.list_instances()
+                    live = {r.instance_id for r in records}
+                    node_info = core.scan("Node", lambda n: (
+                        n.metadata.name, n.spec.provider_id or "",
+                        n.metadata.deletion_timestamp))
+                    backing = set()
+                    for _, pid, _ in node_info:
+                        backing.update(s for s in pid.split("/") if s)
+                    leaked = [r.instance_id for r in records
+                              if r.instance_id not in backing]
+                    ghosts = [nm for nm, pid, dts in node_info
+                              if pid.startswith("fake://") and dts is None
+                              and not ({s for s in pid.split("/") if s}
+                                       & live)]
+                    if not unbound and not leaked and not ghosts:
+                        break
+                    time.sleep(0.25)
+            finally:
+                inject.uninstall()
+
+            assert plan.fired_counts() == {
+                ("provider", "create", "spot-interruption"): 1}, (
+                f"seed={seed}: the interruption never fired: "
+                f"{plan.fired_counts()}")
+            reclaimed = before - {r.instance_id
+                                  for r in fake.list_instances()}
+            assert reclaimed, (
+                f"seed={seed}: no phase-A spot instance was reclaimed")
+            assert not unbound, (
+                f"seed={seed}: {len(unbound)}/{len(created)} pods never "
+                f"(re)bound after the reclaim (e.g. {unbound[:5]})")
+            assert not leaked, (
+                f"seed={seed}: leaked capacity never reaped: {leaked[:5]}")
+            assert not ghosts, (
+                f"seed={seed}: the reclaimed instance's ghost Node "
+                f"persists: {ghosts[:5]}")
+            assert manager.healthz(), (
+                f"seed={seed}: a reconcile worker died during the soak")
+            print(f"spot soak: seed={seed} reclaimed={sorted(reclaimed)} "
+                  f"fired={plan.fired_counts()}")
+        finally:
+            manager.stop()
+
+
 class TestFaultPlanSoak:
     def test_seeded_smoke_converges(self):
         """Tier-1 smoke: a handful of injected faults across the kube and

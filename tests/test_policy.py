@@ -1,0 +1,282 @@
+"""Packing-policy scoring: scalar registry + device window kernel.
+
+Three contracts under test:
+
+- the DEFAULT policy is bit-for-bit the pre-policy behavior: ``cheapest``
+  delegates structurally to models/cost.py (same floats, same ordering),
+  and a full solve under it is identical with device scoring on and off
+  (differential across seeds 1/7/42);
+- the device window kernel (ops/policy.score_fused_window) produces
+  pre-encoded rows equal to encode_prices over the host per-cell loop for
+  penalty-free policies, honors the KARPENTER_POLICY_DEVICE kill switch,
+  and never lets an unverified score through (zero score-mismatch
+  fallbacks on clean runs);
+- the interruption-priced algebra: spot wins exactly when
+  ``rate x repack < price x (1 - spot_factor)``, with the repack cost
+  priced by the what-if engine (0 when displaced pods refit on free
+  capacity, else the cheapest on-demand replacement).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.core import NodeSelectorRequirement as Req
+from karpenter_tpu.cloudprovider.fake.provider import make_instance_type
+from karpenter_tpu.cloudprovider.spi import Offering
+from karpenter_tpu.controllers.provisioning import universe_constraints
+from karpenter_tpu.metrics.policy import POLICY_FALLBACK_TOTAL
+from karpenter_tpu.models.cost import (
+    CostConfig, effective_price, order_options_by_price,
+)
+from karpenter_tpu.models.ffd import encode_prices
+from karpenter_tpu.ops import device_filter
+from karpenter_tpu.ops import policy as ops_policy
+from karpenter_tpu.solver import policy as policy_registry
+from karpenter_tpu.solver.adapter import marshal_pods_interned
+from karpenter_tpu.solver.batch_solve import Problem, solve_batch
+from karpenter_tpu.solver.policy import (
+    PolicyContext, whatif_repack_cost,
+)
+from karpenter_tpu.solver.solve import (
+    SolverConfig, resolved_device_max_shapes,
+)
+from tests.test_batch_solve import result_key
+from tests.test_pack_parity import make_pod
+
+
+def _catalog(n=12, seed=0):
+    """Priced catalog with spot offerings carrying interruption rates."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        cpu = rng.choice([2, 4, 8, 16, 32])
+        out.append(make_instance_type(
+            name=f"t{i}-{cpu}c", cpu=str(cpu), memory=f"{cpu * 4}Gi",
+            pods=str(cpu * 8), price=round(0.04 * cpu * rng.uniform(0.8, 1.3), 4),
+            offerings=[
+                Offering(ct, f"zone-{z + 1}",
+                         interruption_rate=(round(rng.uniform(0.01, 0.2), 4)
+                                            if ct == "spot" else 0.0))
+                for z in range(2) for ct in ("on-demand", "spot")]))
+    return out
+
+
+def _problems(catalog, seed, n=4):
+    rng = random.Random(seed)
+    constraints = universe_constraints(catalog)
+    problems = []
+    for b in range(n):
+        tightened = constraints.deepcopy()
+        tightened.requirements = tightened.requirements.add(Req(
+            key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In",
+            values=[f"zone-{1 + b % 2}"]))
+        pods = []
+        for j in range(rng.randint(40, 120)):
+            pods.append(make_pod({
+                "cpu": f"{rng.choice([100, 250, 500, 1000])}m",
+                "memory": f"{rng.choice([128, 512, 1024])}Mi"}))
+            pods[-1].metadata.name = f"p{b}-{j}"
+        problems.append(Problem(constraints=tightened, pods=pods,
+                                instance_types=catalog))
+    return problems
+
+
+class TestDefaultDelegation:
+    """``cheapest`` must be the pre-policy float path, not a re-derivation."""
+
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_score_is_effective_price(self, seed):
+        catalog = _catalog(seed=seed)
+        cons = universe_constraints(catalog)
+        policy = policy_registry.get("cheapest")
+        ctx = PolicyContext()
+        cfg = CostConfig()
+        for it in catalog:
+            assert policy.score(it, cons.requirements, cfg, ctx) \
+                == effective_price(it, cons.requirements, cfg)
+
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_ordering_is_order_options_by_price(self, seed):
+        catalog = _catalog(seed=seed)
+        cons = universe_constraints(catalog)
+        policy = policy_registry.get("cheapest")
+        got = policy.order_options(list(catalog), cons.requirements,
+                                   CostConfig(), PolicyContext())
+        want = order_options_by_price(list(catalog), cons.requirements,
+                                      CostConfig())
+        assert [it.name for it in got] == [it.name for it in want]
+
+
+class TestDeviceWindowParity:
+    def _fused(self, problems, config):
+        marshaled = [marshal_pods_interned(p.pods) for p in problems]
+        return device_filter.prepare_fused(
+            problems, marshaled, config, resolved_device_max_shapes(config))
+
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    @pytest.mark.parametrize("name", ["cheapest", "throughput-per-dollar"])
+    def test_penalty_free_rows_bit_for_bit(self, seed, name):
+        """Penalty-free policies: the device row must equal encode_prices
+        of the host per-cell scores exactly — min-over-offerings commutes
+        with the monotone micro-$ encoding."""
+        catalog = _catalog(seed=seed)
+        config = SolverConfig(device_min_pods=1)
+        problems = _problems(catalog, seed)
+        fused = self._fused(problems, config)
+        if fused is None:
+            pytest.skip("no device backend for the fused window")
+        try:
+            policy = policy_registry.get(name)
+            ctx = PolicyContext(throughput={catalog[0].name: 2.0,
+                                            catalog[1].name: 0.5})
+            rows = ops_policy.score_fused_window(
+                fused, policy, config.cost_config, ctx)
+            assert rows is not None
+            planes = device_filter.planes_for(fused.uni_types)
+            for b, i in enumerate(fused.batch_idx):
+                reqs = problems[i].constraints.requirements
+                want = encode_prices(
+                    [policy.score(fused.uni_types[p.index], reqs,
+                                  config.cost_config, ctx)[0]
+                     for p in fused.packables], planes.TB)
+                assert np.array_equal(rows[b], want), \
+                    f"member {i} row diverged from the host loop"
+        finally:
+            fused.release()
+
+    def test_kill_switch_returns_none(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_POLICY_DEVICE", "0")
+        assert not ops_policy.enabled()
+        catalog = _catalog()
+        config = SolverConfig(device_min_pods=1)
+        problems = _problems(catalog, 1)
+        fused = self._fused(problems, config)
+        if fused is None:
+            pytest.skip("no device backend for the fused window")
+        try:
+            assert ops_policy.score_fused_window(
+                fused, policy_registry.get("cheapest"),
+                config.cost_config, PolicyContext()) is None
+        finally:
+            fused.release()
+
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_solve_differential_device_vs_host_scoring(self, seed,
+                                                       monkeypatch):
+        """The whole solve under the default policy with the cost
+        tie-break on: device window scoring vs the per-cell host loop
+        must be result-identical, problem for problem — and the run must
+        not burn a single score-mismatch fallback."""
+        catalog = _catalog(seed=seed)
+        problems = _problems(catalog, seed)
+        config = SolverConfig(device_min_pods=1, cost_tiebreak=True)
+        mm_key = (("reason", "score-mismatch"),)
+        before = POLICY_FALLBACK_TOTAL.collect().get(mm_key, 0.0)
+        monkeypatch.setenv("KARPENTER_POLICY_DEVICE", "1")
+        on = solve_batch(problems, config)
+        monkeypatch.setenv("KARPENTER_POLICY_DEVICE", "0")
+        off = solve_batch(problems, config)
+        assert [result_key(r) for r in on] == [result_key(r) for r in off]
+        assert POLICY_FALLBACK_TOTAL.collect().get(mm_key, 0.0) == before
+
+
+class TestInterruptionPriced:
+    def test_frontier_break_even(self):
+        """ct flips from spot to on-demand exactly at
+        rate x repack = price x (1 - factor)."""
+        P, r = 1.0, 0.5
+        it = make_instance_type(
+            name="fr", cpu="4", memory="8Gi", pods="16", price=P,
+            offerings=[Offering("on-demand", "zone-1"),
+                       Offering("spot", "zone-1", interruption_rate=r)])
+        cons = universe_constraints([it])
+        cfg = CostConfig()
+        policy = policy_registry.get("interruption-priced")
+        threshold = P * (1.0 - cfg.spot_price_factor) / r
+        for mult, want in ((0.0, "spot"), (0.5, "spot"), (0.99, "spot"),
+                           (1.01, "on-demand"), (3.0, "on-demand")):
+            ctx = PolicyContext(repack_cost_per_hour=threshold * mult)
+            _, ct = policy.score(it, cons.requirements, cfg, ctx)
+            assert ct == want, f"mult={mult}: got {ct}"
+
+    def test_requirements_pin_wins_over_price(self):
+        it = make_instance_type(
+            name="pinned", cpu="4", memory="8Gi", pods="16", price=1.0,
+            offerings=[Offering("on-demand", "zone-1"),
+                       Offering("spot", "zone-1", interruption_rate=9.0)])
+        cons = universe_constraints([it])
+        cons.requirements = cons.requirements.add(Req(
+            key=wellknown.LABEL_CAPACITY_TYPE, operator="In",
+            values=[wellknown.CAPACITY_TYPE_SPOT]))
+        policy = policy_registry.get("interruption-priced")
+        # a huge reclaim tax cannot un-pin an explicit spot requirement
+        _, ct = policy.score(it, cons.requirements, CostConfig(),
+                             PolicyContext(repack_cost_per_hour=100.0))
+        assert ct == wellknown.CAPACITY_TYPE_SPOT
+
+
+class TestWhatIfRepackCost:
+    def _vec(self, cpu_n, mem, pods_n=1):
+        from karpenter_tpu.solver.host_ffd import (
+            NUM_RESOURCES, POD_UNIT_NANO, R_CPU, R_MEMORY, R_PODS,
+        )
+        v = [0] * NUM_RESOURCES
+        v[R_CPU], v[R_MEMORY] = cpu_n, mem
+        v[R_PODS] = pods_n * POD_UNIT_NANO
+        return v
+
+    def test_refit_on_free_capacity_is_free(self):
+        catalog = _catalog()
+        cons = universe_constraints(catalog)
+        pod = self._vec(500 * 10**6, 512 << 20)
+        free = self._vec(4 * 10**9, 8 << 30, 10)
+        assert whatif_repack_cost([pod], [free], catalog,
+                                  cons.requirements) == 0.0
+
+    def test_no_refit_prices_cheapest_on_demand(self):
+        catalog = _catalog()
+        cons = universe_constraints(catalog)
+        pod = self._vec(2 * 10**9, 1 << 30)
+        cost = whatif_repack_cost([pod], [], catalog, cons.requirements)
+        want = min(it.price for it in catalog
+                   if any(o.capacity_type == "on-demand"
+                          for o in it.offerings))
+        assert cost == want
+
+    def test_empty_displacement_is_free(self):
+        catalog = _catalog()
+        cons = universe_constraints(catalog)
+        assert whatif_repack_cost([], [], catalog, cons.requirements) == 0.0
+
+
+class TestThroughputPerDollar:
+    def test_orders_by_price_per_throughput(self):
+        a = make_instance_type(name="fast", cpu="8", memory="16Gi",
+                               pods="32", price=2.0)
+        b = make_instance_type(name="slow", cpu="8", memory="16Gi",
+                               pods="32", price=1.0)
+        cons = universe_constraints([a, b])
+        policy = policy_registry.get("throughput-per-dollar")
+        # fast does 4x the work at 2x the price: it must win
+        ctx = PolicyContext(throughput={"fast": 4.0, "slow": 1.0})
+        got = policy.order_options([a, b], cons.requirements, CostConfig(),
+                                   ctx)
+        assert [it.name for it in got] == ["fast", "slow"]
+        # no table: degrades to cheapest-feasible ordering
+        got = policy.order_options([a, b], cons.requirements, CostConfig(),
+                                   PolicyContext())
+        assert [it.name for it in got] == ["slow", "fast"]
+
+    def test_zero_throughput_never_wins(self):
+        a = make_instance_type(name="dead", cpu="8", memory="16Gi",
+                               pods="32", price=0.1)
+        cons = universe_constraints([a])
+        policy = policy_registry.get("throughput-per-dollar")
+        score, ct = policy.score(a, cons.requirements, CostConfig(),
+                                 PolicyContext(throughput={"dead": 0.0}))
+        assert score == float("inf") and ct is None

@@ -86,6 +86,55 @@ class TestTinyReplay:
         assert json.loads(text)["chaos_fired"] is None  # chaos disabled
 
 
+class TestSpotReplay:
+    def test_spot_cohort_reclaim_rebinds(self):
+        """--spot-fraction leg: part of the default-band cohort is pinned
+        to spot capacity, the harness's seeded per-tick interruption stream
+        reclaims running spot instances mid-run, and ``completed`` proves
+        every displaced pod was re-offered and REBOUND. The verdict tool's
+        spot cell must accept the report and gate on it."""
+        report = run_replay(ReplayConfig(
+            pods_total=1_500, shards=2, tenants=2, seed=7, bound_cohort=60,
+            churn_pods=120, max_depth=400, ticks=6, tick_sleep_s=0.1,
+            burst_ticks=2, chaos=True, settle_s=45.0, flood_pool=64,
+            spot_fraction=0.5))
+        assert report["completed"], report
+        assert report["system_critical_shed"] == 0
+        spot = report["spot"]
+        assert spot is not None
+        assert spot["cohort_spot_pods"] > 0, spot
+        # window == draw count: every planned interruption must have fired
+        assert spot["interruptions"] >= 1, spot
+        assert spot["rebound"] == spot["displaced"], spot
+        assert "provider/reclaim/spot-interruption" in report["chaos_fired"]
+        v = verdict({"replay": report, "store_ab": None})
+        assert "PASS" in v and "FAIL" not in v, v
+        assert "spot=" in v
+
+    def test_spot_gates_in_verdict(self):
+        base = {
+            "config": {"pods_total": 100, "shards": 1, "chaos": True,
+                       "spot_fraction": 0.5},
+            "offered_total": 100, "completed": True,
+            "system_critical_shed": 0, "recovery_to_l0_s": 0.5,
+            "peak_level": 1, "pending_to_bound_s": {}}
+        ab = {"scan_speedup": 10.0, "objects": 100_000}
+        ok = dict(base, spot={"cohort_spot_pods": 10, "interruptions": 2,
+                              "instances_reclaimed": 2, "displaced": 4,
+                              "rebound": 4, "spot_instances_live": 3})
+        assert "PASS" in verdict({"replay": ok, "store_ab": ab})
+        stuck = dict(base, spot={"cohort_spot_pods": 10, "interruptions": 2,
+                                 "instances_reclaimed": 2, "displaced": 4,
+                                 "rebound": 3, "spot_instances_live": 3})
+        v = verdict({"replay": stuck, "store_ab": ab})
+        assert "FAIL" in v and "never rebound" in v
+        vacuous = dict(base, spot={"cohort_spot_pods": 10, "interruptions": 0,
+                                   "instances_reclaimed": 0, "displaced": 0,
+                                   "rebound": 0, "spot_instances_live": 3})
+        v = verdict({"replay": vacuous, "store_ab": ab})
+        assert "FAIL" in v and "vacuous" in v
+
+
 class TestStoreAB:
     def test_small_ab_counts_and_speedup(self):
         ab = store_ab(objects=3_000, minority=300, iters=8)

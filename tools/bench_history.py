@@ -77,6 +77,21 @@ def _filter_cell(extra: dict) -> str:
     return f"{cfg['speedup']}x/{par}/a{cfg.get('steady_allocations', '?')}"
 
 
+def _policy_cell(extra: dict) -> str:
+    """Compressed policy-scoring column (config_13, round 13+): speedup,
+    verdict (default-policy row parity AND node parity AND zero unverified
+    AND the spot frontier holding), frontier points held — '37.5x/par/f7'.
+    '!par' flags any break; '-' when the config never ran."""
+    cfg = extra.get("config_13_policy_scoring")
+    if not isinstance(cfg, dict) or "speedup" not in cfg:
+        return "-"
+    par = "par" if (cfg.get("row_divergence_default") == 0
+                    and cfg.get("node_parity")
+                    and cfg.get("unverified") == 0
+                    and cfg.get("frontier_ok")) else "!par"
+    return f"{cfg['speedup']}x/{par}/f{len(cfg.get('spot_frontier') or [])}"
+
+
 def _slo_cell(extra: dict) -> str:
     """Compressed SLO column (config_9 replay + chaos probe, round 14+):
     clean-leg sentinel trips, chaos-probe trips, worst digest-parity
@@ -150,7 +165,7 @@ def load_rows(root: str) -> list:
                     "value": None, "unit": "", "device_count": None,
                     "backend": "?", "degraded": None, "configs": "-",
                     "marshal": "-", "gang": "-", "filter": "-",
-                    "slo": "-"})
+                    "policy": "-", "slo": "-"})
                 continue
             line = inner
         extra = line.get("extra", {}) if isinstance(line, dict) else {}
@@ -167,6 +182,7 @@ def load_rows(root: str) -> list:
             "marshal": _marshal_cell(extra),
             "gang": _gang_cell(extra),
             "filter": _filter_cell(extra),
+            "policy": _policy_cell(extra),
             "slo": _slo_cell(extra),
         })
     for b in bad:
@@ -178,7 +194,7 @@ def load_rows(root: str) -> list:
 def render(rows: list) -> str:
     headers = ["round", "variant", "metric", "value", "unit",
                "device_count", "backend", "degraded", "configs", "marshal",
-               "gang", "filter", "slo"]
+               "gang", "filter", "policy", "slo"]
     table = [headers] + [
         ["" if r[h] is None else str(r[h]) for h in headers] for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(headers))]

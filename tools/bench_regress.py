@@ -79,6 +79,9 @@ SERIES = [
     ("device_filter_speedup",
      lambda l: _dig(l, "extra", "config_12_device_filter", "speedup"),
      "higher", 0.30),
+    ("policy_scoring_speedup",
+     lambda l: _dig(l, "extra", "config_13_policy_scoring", "speedup"),
+     "higher", 0.30),
 ]
 
 # (name, extractor(line) -> bool|None): latest non-None entry must be True
@@ -97,6 +100,17 @@ FLAGS = [
                           "verdict_divergence") == 0
                 and bool(_dig(l, "extra", "config_12_device_filter",
                               "node_parity")))),
+    ("policy_scoring_parity",
+     lambda l: (None if _dig(l, "extra", "config_13_policy_scoring",
+                             "row_divergence_default") is None
+                else _dig(l, "extra", "config_13_policy_scoring",
+                          "row_divergence_default") == 0
+                and bool(_dig(l, "extra", "config_13_policy_scoring",
+                              "node_parity"))
+                and _dig(l, "extra", "config_13_policy_scoring",
+                         "unverified") == 0
+                and bool(_dig(l, "extra", "config_13_policy_scoring",
+                              "frontier_ok")))),
     ("slo_clean_trips_zero",
      lambda l: (None if _dig(l, "extra", "config_9_million_pod_replay",
                              "replay", "slo") is None

@@ -13,6 +13,15 @@ candidate-evaluations/sec >= 10x the host-incremental leg, exact
 feasibility parity, and zero unverified drains (every executed drain
 re-verified by an independent place_onto replay) — the round-9
 acceptance gate.
+
+When the run carried ``--trace TRACE_replay.json`` (bench-replay's
+recorded diurnal shape fed into the scale-down window), the trace leg
+must ALSO hold: per-phase feasibility parity and zero unverified drains
+at every phase of the recorded curve, and shape consistency — the
+diurnal trough phase drains at least as many candidates as the peak
+phase (scale-down capacity appears when the recorded load recedes). A
+skipped trace leg (no --trace, or no trace file yet) leaves the gate
+N/A, labelled in the verdict line.
 """
 
 from __future__ import annotations
@@ -37,19 +46,32 @@ def verdict(line: dict) -> str:
     unverified = w.get("unverified_drains")
     relax = w.get("relax") or {}
     relax_note = relax.get("reason", "not-run")
+    trace = cfg.get("trace_leg") or {}
+    if not trace or "skipped" in trace:
+        trace_cell = f"trace={trace.get('skipped', 'n/a')}"
+        trace_ok = True  # N/A: the leg wasn't requested or has no input yet
+    else:
+        ph = trace.get("phases") or []
+        ph_ok = all(p.get("parity") is True
+                    and p.get("unverified_drains") == 0 for p in ph)
+        trace_ok = bool(ph) and ph_ok and trace.get("shape_consistent") is True
+        trace_cell = (f"trace={len(ph)}ph diurnal drains "
+                      f"{trace.get('drains_trough')}(trough).."
+                      f"{trace.get('drains_peak')}(peak)")
     head = (f"consolidate window: {candidates} candidates, one batched solve "
             f"({w.get('executor')}) {speedup}x vs host-incremental "
             f"({w.get('batched_evals_per_s')} vs "
             f"{w.get('host_incremental_evals_per_s')} evals/s), "
             f"parity={parity}, {w.get('drains')} drains "
             f"({unverified} unverified) reclaiming "
-            f"${w.get('reclaimed_per_hour', 0):.2f}/h, relax={relax_note}")
+            f"${w.get('reclaimed_per_hour', 0):.2f}/h, relax={relax_note}, "
+            f"{trace_cell}")
     ok = (candidates >= GATE_CANDIDATES
           and speedup is not None and speedup >= GATE_SPEEDUP
-          and parity is True and unverified == 0)
+          and parity is True and unverified == 0 and trace_ok)
     return (f"{head} — {'PASS' if ok else 'FAIL'} "
             f"(gate >={GATE_CANDIDATES} candidates, >={GATE_SPEEDUP}x, "
-            "parity, 0 unverified)")
+            "parity, 0 unverified, trace leg parity+shape when run)")
 
 
 def main() -> int:

@@ -37,6 +37,7 @@ REGISTERING_MODULES = [
     "karpenter_tpu.metrics.filter",
     "karpenter_tpu.metrics.gang",
     "karpenter_tpu.metrics.marshal",
+    "karpenter_tpu.metrics.policy",
     "karpenter_tpu.metrics.slo",
     "karpenter_tpu.solver.solve",
     "karpenter_tpu.solver.hedge",

@@ -1,0 +1,92 @@
+"""Policy-scoring verdict: one human-readable line from the bench JSON.
+
+`make bench-policy` pipes bench.py (``--only config_13``) through this
+filter. The bench line passes through UNCHANGED on stdout (so
+`> BENCH_rNN.json` redirects still capture the pure JSON); the verdict
+goes to stderr:
+
+    policy scoring: 24-schedule window x 400 types, device scoring 11.2x \
+vs per-cell host loop, row_divergence=0, node_parity=True pick_parity=True \
+(9984 pods), unverified=0, frontier 7/7 — PASS
+
+PASS needs (the round-13 acceptance gate):
+- device window scoring >= 5x the per-cell host loop (p50), with the
+  probe re-verification's cost timed INSIDE the device leg;
+- zero default-policy row divergence — the device row equals
+  encode_prices of the host scalar scores bit for bit on every member
+  (the default policy's differential guarantee);
+- node parity AND launch-pick parity: the full 10k-pod solve_batch under
+  the interruption-priced policy produces identical node counts and
+  identical first-option types with device scoring on and off (the
+  device score is a filter-verified pricing input, never a commit);
+- zero unverified placements: no score-mismatch fallback fired, i.e.
+  every device row that reached the pack kernel survived the probe
+  check against the scalar mirror;
+- the repack-cost frontier holds at every sweep point: spot selected
+  exactly when rate x repack < price x (1 - spot_factor), with nodes
+  actually placed at each point (no vacuous sweep).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+GATE_SPEEDUP = 5.0
+
+
+def verdict(line: dict) -> str:
+    extra = line.get("extra", {})
+    cfg = extra.get("config_13_policy_scoring", {})
+    if "error" in cfg or "speedup" not in cfg:
+        return ("policy scoring: no config_13_policy_scoring in bench line "
+                f"({cfg.get('error', cfg.get('skipped', 'config_13 not run'))})"
+                " — NO VERDICT")
+    speedup = cfg.get("speedup")
+    div = cfg.get("row_divergence_default")
+    nparity = cfg.get("node_parity")
+    pparity = cfg.get("pick_parity")
+    unverified = cfg.get("unverified")
+    frontier = cfg.get("spot_frontier") or []
+    fok = cfg.get("frontier_ok")
+    f_held = sum(1 for pt in frontier
+                 if pt.get("spot_expected") == pt.get("spot_selected")
+                 and pt.get("nodes", 0) > 0)
+    head = (f"policy scoring: {cfg.get('schedules_per_window')}-schedule "
+            f"window x {cfg.get('types')} types, device scoring {speedup}x "
+            f"vs per-cell host loop, row_divergence={div}, "
+            f"node_parity={nparity} pick_parity={pparity} "
+            f"({cfg.get('pods')} pods), unverified={unverified}, "
+            f"frontier {f_held}/{len(frontier)}")
+    ok = (speedup is not None and speedup >= GATE_SPEEDUP
+          and div == 0 and nparity is True and pparity is True
+          and unverified == 0 and fok is True and len(frontier) > 0)
+    return (f"{head} — {'PASS' if ok else 'FAIL'} "
+            f"(gate >={GATE_SPEEDUP}x, 0 divergence, node+pick parity, "
+            "0 unverified, frontier holds at every repack point)")
+
+
+def main() -> int:
+    last = None
+    for raw in sys.stdin:
+        sys.stdout.write(raw)  # pass-through: stdout stays the pure JSON
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            line = json.loads(raw)
+            if isinstance(line, dict) and "metric" in line:
+                last = line
+        except ValueError:
+            continue
+    sys.stdout.flush()
+    if last is None:
+        print("policy scoring: no bench JSON line on stdin — NO VERDICT",
+              file=sys.stderr)
+        return 1
+    print(verdict(last), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

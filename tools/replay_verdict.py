@@ -16,6 +16,10 @@ PASS needs (the round-9 acceptance gates):
 - ZERO partial gangs when the run injected gang workloads
   (gang_fraction > 0): every all-or-nothing pod group either bound
   whole or stayed wholly Pending;
+- when the run pinned cohort pods to spot (spot_fraction > 0): at least
+  one seeded spot-interruption actually fired (chaos on), some cohort
+  pods really ran on spot, and every pod displaced by a reclaim REBOUND
+  (displaced == rebound) — with zero system-critical sheds throughout;
 - store list-by-kind scan speedup >= 5x vs the naive store at the
   A/B leg's object count (absent A/B leg → gate N/A, labelled).
 """
@@ -55,10 +59,14 @@ def verdict(line: dict) -> str:
     gang_cell = (f"{gangs.get('gangs_fully_bound')}/"
                  f"{gangs.get('offered_gangs')}"
                  if gangs.get("offered_gangs") else "n/a")
+    spot = replay.get("spot") or {}
+    spot_cell = (f"{spot.get('rebound')}/{spot.get('displaced')}rebound"
+                 f"(intr={spot.get('interruptions')})"
+                 if spot else "n/a")
     head = (f"replay: {offered} pods / {cfg.get('shards')} shards "
             f"peak=L{replay.get('peak_level')} crit_shed={crit_shed} "
             f"recovery={recovery}s default_p99={lat.get('p99')}s "
-            f"gangs={gang_cell} "
+            f"gangs={gang_cell} spot={spot_cell} "
             f"store_scan={scan_x if scan_x is not None else 'n/a'}x")
     problems = []
     if not replay.get("completed"):
@@ -74,6 +82,15 @@ def verdict(line: dict) -> str:
     if gangs.get("offered_gangs") and gangs.get("partial_gangs", 0) != 0:
         problems.append(f"{gangs['partial_gangs']} partial gang(s) — "
                         "all-or-nothing invariant broken")
+    if spot:
+        if spot.get("rebound", 0) != spot.get("displaced", 0):
+            problems.append(
+                f"{spot.get('displaced', 0) - spot.get('rebound', 0)} "
+                "reclaimed pod(s) never rebound")
+        if spot.get("cohort_spot_pods", 0) < 1:
+            problems.append("spot leg vacuous: no cohort pod pinned to spot")
+        if cfg.get("chaos") and spot.get("interruptions", 0) < 1:
+            problems.append("spot leg vacuous: no interruption ever fired")
     if ab is None:
         return f"{head} — store GATE N/A (A/B leg not run); replay " + \
             ("PASS" if not problems else f"FAIL ({'; '.join(problems)})")
