@@ -1551,6 +1551,161 @@ def config_13_policy_scoring():
     }
 
 
+def config_14_global_window():
+    """Round-14 gate: the whole-window global solve (docs/solver.md §18).
+    A heterogeneous 12-schedule window over a catalog whose price-per-cpu
+    spreads 4x — so node-count-minimal (FFD's objective) and cost-minimal
+    fleets genuinely diverge — is solved two ways:
+
+    - leg A, per-schedule exact FFD: one host_ffd.pack per schedule over
+      the full catalog — the packing every schedule falls back to;
+    - leg B, the global backend: ONE joint batched proximal solve over
+      all schedules (solver/global_solve.solve_window_global), support ->
+      restricted exact-FFD rounding -> strict int micro-$ verdict.
+
+    The fleet-cost delta is computed per the controller's substitution
+    rule: an accepted schedule contributes its rounded plan, a declined
+    one its untouched FFD plan. Gates (tools/global_verdict.py): fleet
+    >= 5% cheaper (or fewer nodes) at bounded window p99 — the global
+    window rides the dispatch stage CONCURRENT with the per-schedule
+    batch, so the solve p99 is unchanged as long as the global leg fits
+    the 200 ms window budget; exact-FFD parity on every decline (a
+    single-type window where restricted rounding can never win must
+    return all-None results); zero unverified placements; and a live
+    kill switch."""
+    from karpenter_tpu.cloudprovider.fake.provider import make_instance_type
+    from karpenter_tpu.cloudprovider.spi import Offering
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.metrics.global_solve import GLOBAL_FALLBACK_TOTAL
+    from karpenter_tpu.ops.global_solve import encode_window, plan_cost_micro
+    from karpenter_tpu.solver import global_solve as gs
+    from karpenter_tpu.solver import host_ffd
+    from karpenter_tpu.solver.batch_solve import Problem
+    from karpenter_tpu.solver.solve import SolverConfig
+
+    if not gs.enabled():
+        return {"skipped": "KARPENTER_GLOBAL_SOLVE=0"}
+
+    def t(name, cpu, ratio, price):
+        return make_instance_type(
+            name=name, cpu=str(cpu), memory=f"{cpu * ratio}Gi",
+            pods=str(min(110, cpu * 15)),
+            offerings=[Offering("on-demand", f"bench-zone-{z + 1}")
+                       for z in range(3)],
+            price=price)
+
+    # $/cpu: 0.05 on the small end, 0.20-0.22 on the big end — FFD's
+    # max-pods-per-node choice lands on the big types, the cheap fleet
+    # doesn't
+    catalog = [
+        t("gw-small-8", 8, 4, 0.40), t("gw-small-12", 12, 4, 0.66),
+        t("gw-mid-16", 16, 4, 1.92), t("gw-mid-24", 24, 4, 3.36),
+        t("gw-big-32", 32, 4, 6.40), t("gw-big-48", 48, 4, 10.56),
+    ]
+    constraints = universe_constraints(catalog)
+    S = 12
+    shapes = [(1000, 2048), (2000, 4096), (500, 1024), (4000, 8192)]
+    problems = []
+    for b in range(S):
+        n = 10 + (b * 7) % 26
+        pods = make_pods(n, [shapes[b % len(shapes)]])
+        for j, p in enumerate(pods):
+            p.metadata.name = f"gw{b}-{j}"
+        problems.append(Problem(constraints=constraints.deepcopy(),
+                                pods=pods, instance_types=catalog))
+
+    cfg = SolverConfig(window_backend="global")
+    gcfg = gs.GlobalConfig(device_min_cells=0)  # exercise the device path
+    win = encode_window(problems, cfg.cost_config)
+
+    def ffd_leg():
+        out = []
+        for s in win.scheds:
+            out.append(host_ffd.pack(
+                s.pod_vecs, s.pod_ids, s.packables,
+                max_instance_types=cfg.max_instance_types)
+                if s.reason is None else None)
+        return out
+
+    def global_leg():
+        return gs.solve_window_global(problems, cfg, gcfg)
+
+    fb_before = dict(GLOBAL_FALLBACK_TOTAL.collect())
+    ffd_results = ffd_leg()
+    plan = global_leg()  # warm: jit + ring fill before the clock starts
+    ffd_times = run_timed(ffd_leg, budget_s=15.0)
+    global_times = run_timed(global_leg, budget_s=30.0)
+    st_ffd = _stats(ffd_times)
+    st_global = _stats(global_times)
+
+    ffd_micro = [plan_cost_micro(r, s.prices_micro) if r is not None else 0
+                 for s, r in zip(win.scheds, ffd_results)]
+    ffd_nodes = sum(r.node_count for r in ffd_results if r is not None)
+    global_micro, global_nodes = 0, 0
+    for i, (info, result) in enumerate(zip(plan.infos, plan.results)):
+        if result is not None:  # controller substitution rule
+            global_micro += info.relax_cost_micro
+            global_nodes += result.node_count
+        else:
+            global_micro += ffd_micro[i]
+            global_nodes += (ffd_results[i].node_count
+                             if ffd_results[i] is not None else 0)
+    ffd_total = sum(ffd_micro)
+    saving_pct = round(100.0 * (ffd_total - global_micro)
+                       / (ffd_total or 1), 2)
+
+    # decline-parity leg: one type only — restricted rounding can never
+    # beat full FFD, every schedule must decline and keep its FFD plan
+    solo = [t("gw-solo-16", 16, 4, 1.0)]
+    solo_cons = universe_constraints(solo)
+    solo_problems = []
+    for b in range(4):
+        pods = make_pods(12, [shapes[b % len(shapes)]])
+        for j, p in enumerate(pods):
+            p.metadata.name = f"gwsolo{b}-{j}"
+        solo_problems.append(Problem(constraints=solo_cons.deepcopy(),
+                                     pods=pods, instance_types=solo))
+    solo_plan = gs.solve_window_global(solo_problems, cfg, gcfg)
+    decline_parity = (solo_plan.accepted == 0
+                      and all(r is None for r in solo_plan.results)
+                      and all(i.reason.startswith("fallback-")
+                              for i in solo_plan.infos))
+
+    prev = os.environ.get("KARPENTER_GLOBAL_SOLVE")
+    try:
+        os.environ["KARPENTER_GLOBAL_SOLVE"] = "0"
+        killswitch_gate = not gs.enabled()
+    finally:
+        if prev is None:
+            os.environ.pop("KARPENTER_GLOBAL_SOLVE", None)
+        else:
+            os.environ["KARPENTER_GLOBAL_SOLVE"] = prev
+
+    fb_after = dict(GLOBAL_FALLBACK_TOTAL.collect())
+    fallbacks = {dict(k).get("reason", "?"): fb_after[k] - fb_before.get(k, 0)
+                 for k in fb_after
+                 if fb_after[k] - fb_before.get(k, 0.0) > 0}
+    p99_budget_ms = max(TARGET_MS, 5.0 * st_ffd["p99_ms"])
+    return {
+        "schedules": S, "pods": sum(len(p.pods) for p in problems),
+        "types": len(catalog), "executor": plan.executor,
+        "accepted": plan.accepted,
+        "ffd_cost_per_hour": round(ffd_total / 1e6, 6),
+        "global_cost_per_hour": round(global_micro / 1e6, 6),
+        "saving_pct": saving_pct,
+        "ffd_nodes": int(ffd_nodes), "global_nodes": int(global_nodes),
+        "ffd_p50_ms": st_ffd["p50_ms"], "ffd_p99_ms": st_ffd["p99_ms"],
+        "global_p50_ms": st_global["p50_ms"],
+        "global_p99_ms": st_global["p99_ms"],
+        "p99_budget_ms": round(p99_budget_ms, 3),
+        "p99_ok": bool(st_global["p99_ms"] <= p99_budget_ms),
+        "decline_parity": bool(decline_parity),
+        "killswitch_gate": bool(killswitch_gate),
+        "unverified": int(fallbacks.get("unverified", 0)),
+        "global_fallbacks": fallbacks,
+    }
+
+
 def jax_devices_first():
     import jax
 
@@ -1965,6 +2120,7 @@ def run_all(degraded: bool, probe_note: str = ""):
         ("config_11_gang_copack", config_11_gang_copack),
         ("config_12_device_filter", config_12_device_filter),
         ("config_13_policy_scoring", config_13_policy_scoring),
+        ("config_14_global_window", config_14_global_window),
     ):
         if not _selected(key, only):
             continue

@@ -68,6 +68,12 @@ class Options:
     # per-chunk what-if estimate; 0 = let the what-if engine price each chunk.
     # Also the consolidation keep-cost premium on spot nodes (rate x this).
     policy_repack_cost: float = 0.0
+    # provisioning-window packing backend (solver/global_solve.py): ffd |
+    # global. "global" solves the whole window jointly as one batched
+    # ADMM relaxation with FFD as the exact rounding oracle and the
+    # bit-for-bit fallback; pressure L1+ and gang schedules keep FFD, and
+    # KARPENTER_GLOBAL_SOLVE=0 kills the global path regardless.
+    window_backend: str = "ffd"
     # JAX persistent compilation cache dir ("" disables): restarts re-load
     # compiled programs instead of re-lowering them
     solver_compile_cache_dir: str = ""
@@ -163,6 +169,9 @@ class Options:
         if self.policy_repack_cost < 0:
             errs.append(
                 f"policy-repack-cost invalid: {self.policy_repack_cost}")
+        if self.window_backend not in ("ffd", "global"):
+            errs.append(f"window-backend invalid: {self.window_backend} "
+                        "(available: ffd | global)")
         if self.aws_node_name_convention not in ("ip-name", "resource-name"):
             errs.append(
                 f"aws-node-name-convention invalid: {self.aws_node_name_convention}")
@@ -278,6 +287,13 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                                 defaults.policy_repack_cost),
                    help="pin the interruption-priced policy's repack price "
                         "($/h); 0 lets the what-if engine price each chunk")
+    p.add_argument("--window-backend", choices=["ffd", "global"],
+                   default=_env("window-backend", defaults.window_backend),
+                   help="provisioning-window packing backend: ffd "
+                        "(per-schedule greedy batch, the default) | global "
+                        "(whole-window ADMM relaxation with FFD as the "
+                        "exact rounding oracle and bit-for-bit fallback; "
+                        "L1+ pressure and gang schedules keep ffd)")
     p.add_argument("--solver-compile-cache-dir",
                    default=_env("solver-compile-cache-dir",
                                 defaults.solver_compile_cache_dir),

@@ -61,6 +61,7 @@ from karpenter_tpu.runtime.kubecore import (
 from karpenter_tpu.scheduling.batcher import Batcher
 from karpenter_tpu.scheduling.scheduler import Scheduler
 from karpenter_tpu.ops.gang import GangEncoding, encode_gang_window
+from karpenter_tpu.solver import global_solve
 from karpenter_tpu.solver.batch_solve import Problem, dispatch_batch
 from karpenter_tpu.solver.gang import (
     GangConfig, GangPlacement, dispatch_gang_window, plan_gang_window,
@@ -125,6 +126,11 @@ class _ChunkPrep:
     gang_types: list = field(default_factory=list)  # type idx → (schedule, it)
     gang_handle: Optional[object] = None
     gang_nodes: Dict[int, str] = field(default_factory=dict)  # bin → node
+    # whole-window global solve (solver/global_solve.py): the in-flight
+    # handle when window_backend="global" dispatched this chunk jointly;
+    # fetch substitutes only strictly-cheaper host-verified plans, so a
+    # None (or a declined schedule) keeps the FFD result bit-for-bit
+    global_handle: Optional[object] = None
     # chunk-scoped SolverConfig override: the interruption-priced policy's
     # what-if repack context is priced per chunk (None → worker config)
     solver_config: Optional[SolverConfig] = None
@@ -535,8 +541,17 @@ class ProvisionerWorker:
         (provisioner.go:109-120). Async: returns the in-flight BatchHandle
         for the pipeline to fetch; fallbacks resolve at fetch time."""
         t0 = time.perf_counter()
-        handle = dispatch_batch(prep.problems,
-                                config=prep.solver_config or self.solver_config)
+        cfg = prep.solver_config or self.solver_config
+        handle = dispatch_batch(prep.problems, config=cfg)
+        if (cfg.window_backend == "global" and prep.problems
+                and global_solve.enabled()
+                and int(self.batcher._monitor().level()) < 1):
+            # whole-window joint solve rides the same dispatch stage; at
+            # pressure L1+ the window collapses to the FFD backend (chunked
+            # solves must stay p99-bounded), and gang schedules never enter
+            # (they peeled off into their dedicated co-pack window above)
+            prep.global_handle = global_solve.dispatch_global_window(
+                prep.problems, solver_config=cfg)
         if prep.gang_enc is not None and prep.gang_enc.g > 0:
             # same round trip: the gang window rides the dispatch stage
             # alongside the per-schedule batch, fetch resolves both
@@ -550,7 +565,27 @@ class ProvisionerWorker:
         """Launch/bind stage: runs while the NEXT chunk's solve is in
         flight (depth permitting)."""
         last_result = None
-        for schedule, result in zip(prep.schedules, results):
+        global_results: Optional[list] = None
+        if prep.global_handle is not None:
+            try:
+                plan = prep.global_handle.fetch()
+                global_results = plan.results
+                if plan.accepted:
+                    log.info("global window solve: %d/%d schedule(s) "
+                             "strictly cheaper (executor=%s) window_id=%s "
+                             "shard=%s", plan.accepted, len(plan.results),
+                             plan.executor, self._window_id,
+                             self.shard or "0")
+            except Exception:
+                # verdict-is-a-filter: any global-solve failure keeps the
+                # FFD backend's results untouched
+                log.exception("global window fetch failed; keeping FFD "
+                              "plans window_id=%s", self._window_id)
+        for idx, (schedule, result) in enumerate(
+                zip(prep.schedules, results)):
+            if global_results is not None and idx < len(global_results) \
+                    and global_results[idx] is not None:
+                result = global_results[idx]
             last_result = result
             for packing in result.packings:
                 err = self._launch(schedule.constraints, packing)

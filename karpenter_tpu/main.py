@@ -85,6 +85,7 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
     solver_config = SolverConfig(use_device=options.solver_use_device,
                                  device_donate=options.solver_donate,
                                  packing_policy=options.packing_policy,
+                                 window_backend=options.window_backend,
                                  policy_context=PolicyContext(
                                      repack_cost_per_hour=options.policy_repack_cost))
     if options.solver_warmup:

@@ -1,0 +1,304 @@
+"""Whole-window global-solve encoding: one batched relaxation program.
+
+The provisioning hot loop packs each schedule greedily (FFD per schedule,
+batched on device); with a priced heterogeneous catalog the cheapest fleet
+is provably not the per-schedule-greedy one. This module encodes ALL
+schedules of a provisioning window — per-schedule pod-shape segments ×
+priced instance-type columns — into ONE batched tensor program for the
+proximal/ADMM kernel in solver/global_solve.py, and supplies the exact
+integer arithmetic that decides what leaves the solve:
+
+- ``price_micro`` is EXACTLY models/ffd.encode_prices' per-entry
+  truncation (``min(int(p * 1e6), INT32_MAX)``, saturating; the same seam
+  ops/policy._encode_micro rides), so "strictly cheaper" is decided in
+  exact nano-int micro-$ arithmetic, never float.
+- ``plan_cost_micro`` charges a host plan its cheapest viable option per
+  node in python ints — overflow-free, bit-stable across platforms.
+- ``verify_plan`` independently replays every node of a candidate plan
+  through fresh host Packable reservations (exact nano ints) and checks
+  pod conservation — the verdict-is-a-filter half of the contract: no
+  placement reaches a bind without passing it.
+
+The per-schedule type columns already ride the feasibility engine:
+``build_packables_cached`` only yields types the §16 bit-plane /columnar
+filter admits for the schedule, so the relaxation never sees an
+infeasible (schedule × type) cell. Shapes/capacities are float32-
+normalized per schedule (the relax.py discipline) purely for the
+gradient kernel; nothing float ever decides acceptance.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.solver.host_ffd import (
+    NUM_RESOURCES, HostSolveResult, Packable)
+
+log = logging.getLogger("karpenter.ops.global_solve")
+
+# int32 saturation ceiling of the micro-$ price domain (models/ffd.py)
+SAT_MICRO = 2 ** 31 - 1
+
+
+def price_micro(p: float) -> int:
+    """models/ffd.encode_prices' exact per-entry truncation as a scalar:
+    finite prices truncate to int micro-$ saturating at INT32_MAX; inf
+    (no viable offering) saturates outright."""
+    if p != float("inf"):
+        return min(int(p * 1e6), SAT_MICRO)
+    return SAT_MICRO
+
+
+def plan_cost_micro(result: HostSolveResult,
+                    prices_micro: Sequence[int]) -> int:
+    """Exact integer cost of a host plan in micro-$/h, charging each node
+    its cheapest viable option — the int twin of models/cost.plan_cost's
+    convention. Python ints: no overflow, no rounding."""
+    total = 0
+    for p in result.packings:
+        total += min(prices_micro[j] for j in p.instance_type_indices) \
+            * p.node_quantity
+    return total
+
+
+def verify_plan(pod_vecs: Dict[int, Sequence[int]],
+                packables_by_index: Dict[int, Packable],
+                result: HostSolveResult) -> bool:
+    """Independent host re-verification of a candidate plan on exact nano
+    ints: every node's pods must reserve onto a FRESH copy of the node's
+    chosen type (first option — the type the rounding actually packed),
+    and every input pod must appear exactly once across packings and
+    unschedulable. Any failure rejects the whole plan."""
+    seen: set = set()
+    for packing in result.packings:
+        if not packing.instance_type_indices:
+            return False
+        if len(packing.pod_ids) != packing.node_quantity:
+            return False
+        chosen = packables_by_index.get(packing.instance_type_indices[0])
+        if chosen is None:
+            return False
+        for node in packing.pod_ids:
+            fresh = chosen.copy()
+            for pid in node:
+                if pid in seen:
+                    return False
+                seen.add(pid)
+                vec = pod_vecs.get(pid)
+                if vec is None or not fresh.reserve_pod(vec):
+                    return False
+    for pid in result.unschedulable:
+        if pid in seen:
+            return False
+        seen.add(pid)
+    return seen == set(pod_vecs)
+
+
+@dataclass
+class GlobalScheduleEnc:
+    """One schedule's slice of the window: the exact host-side problem
+    (pods ordered descending, viable packables, int micro-$ prices) plus —
+    when encodable — its row in the batched kernel tensors."""
+
+    pos: int                       # position in the window's problem list
+    reason: Optional[str] = None   # early decline (empty|unpriced|unencodable)
+    constraints: Optional[object] = None   # the problem's Constraints
+    pod_vecs: list = field(default_factory=list)   # descending pack order
+    pod_ids: list = field(default_factory=list)    # original pod positions
+    pods: list = field(default_factory=list)       # Pod objects, input order
+    packables: list = field(default_factory=list)
+    sorted_types: list = field(default_factory=list)
+    prices: list = field(default_factory=list)        # $/h per sorted type
+    prices_micro: list = field(default_factory=list)  # int µ$ per sorted type
+    num_shapes: int = 0
+    num_types: int = 0
+    row: int = -1                  # row in the batched tensors (-1 = none)
+
+
+@dataclass
+class GlobalWindowEncoding:
+    """The window: per-schedule host problems + the batched padded float32
+    tensors the kernel consumes. ``b/sb/tb`` are the padded bucket dims."""
+
+    scheds: List[GlobalScheduleEnc]
+    b: int = 0
+    sb: int = 0
+    tb: int = 0
+    d_shapes: Optional[np.ndarray] = None   # (B, SB, R) f32 normalized
+    d_counts: Optional[np.ndarray] = None   # (B, SB)    f32
+    d_caps: Optional[np.ndarray] = None     # (B, TB, R) f32 normalized
+    d_prices: Optional[np.ndarray] = None   # (B, TB)    f32 in [0, 1]
+    d_tmask: Optional[np.ndarray] = None    # (B, TB)    f32 validity
+    d_x0: Optional[np.ndarray] = None       # (B, SB, TB) f32 warm start
+    d_n0: Optional[np.ndarray] = None       # (B, TB)    f32 warm start
+
+    @property
+    def live(self) -> List[GlobalScheduleEnc]:
+        return [s for s in self.scheds if s.row >= 0]
+
+    @property
+    def cells(self) -> int:
+        return self.b * self.sb * self.tb
+
+    @property
+    def device_ready(self) -> bool:
+        return self.d_shapes is not None and self.b > 0
+
+
+def _pow2(n: int, lo: int = 4) -> int:
+    p = lo
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _schedule_tensors(enc_problem, obj_prices: Sequence[float]):
+    """relax.py's per-schedule float32 normalization: shapes/caps divided
+    per-resource, prices scaled into [0, 1], plus the even-spread warm
+    start. Returns (shapes, counts, caps, prices, x0, n0)."""
+    S, T = enc_problem.num_shapes, enc_problem.num_types
+    shapes = np.asarray(enc_problem.shapes[:S], dtype=np.float32)
+    caps = np.asarray(enc_problem.totals[:T], dtype=np.float32)
+    counts = np.asarray(enc_problem.counts[:S], dtype=np.float32)
+    norm = np.maximum(np.maximum(shapes.max(axis=0, initial=1.0),
+                                 caps.max(axis=0, initial=1.0)), 1.0)
+    shapes, caps = shapes / norm, caps / norm
+    prices = np.asarray(obj_prices, dtype=np.float32)
+    pmax = float(prices.max()) or 1.0
+    prices = prices / pmax
+    x0 = np.tile((counts / max(T, 1))[:, None], (1, T)).astype(np.float32)
+    need = np.einsum("s,sr->r", counts, shapes)
+    denom = np.maximum(caps, 1e-6)
+    n0 = (np.max(need[None, :] / denom, axis=1) / max(T, 1)).astype(np.float32)
+    return shapes, counts, caps, prices, x0, n0
+
+
+def encode_window(problems: Sequence, cost_config,
+                  max_schedules: int = 256) -> GlobalWindowEncoding:
+    """Marshal a provisioning window's Problem list into the batched
+    relaxation program. Per schedule: viable packables + sorted catalog
+    (feasibility-filtered, cached), descending pod order, exact int
+    micro-$ prices; schedules that cannot join the relaxation (no pods,
+    no priced type, unencodable ints) carry an early-decline reason and
+    no tensor row — the caller's FFD result stands for them untouched."""
+    from karpenter_tpu.models.cost import effective_price
+    from karpenter_tpu.ops.encode import encode
+    from karpenter_tpu.solver.adapter import (
+        build_packables_cached, marshal_pods_interned)
+
+    scheds: List[GlobalScheduleEnc] = []
+    rows: List[tuple] = []
+    for pos, problem in enumerate(problems):
+        s = GlobalScheduleEnc(pos=pos, pods=list(problem.pods),
+                              constraints=problem.constraints)
+        scheds.append(s)
+        if not problem.pods or pos >= max_schedules:
+            s.reason = "empty" if not problem.pods else "window-cap"
+            continue
+        pod_vecs, required, _ = marshal_pods_interned(problem.pods)
+        packables, sorted_types = build_packables_cached(
+            problem.instance_types, problem.constraints, problem.pods,
+            problem.daemons, required=required)
+        if not packables:
+            s.reason = "empty"
+            continue
+        order = sorted(range(len(problem.pods)),
+                       key=lambda i: (-pod_vecs[i][0], -pod_vecs[i][1]))
+        prices = [effective_price(it, problem.constraints.requirements,
+                                  cost_config)[0] for it in sorted_types]
+        prices = [0.0 if p == float("inf") else p for p in prices]
+        s.pod_vecs = [pod_vecs[i] for i in order]
+        s.pod_ids = order
+        s.packables = packables
+        s.sorted_types = sorted_types
+        s.prices = prices
+        s.prices_micro = [price_micro(p) for p in prices]
+        by_pos = [s.prices_micro[p.index] for p in packables]
+        if not any(0 < m < SAT_MICRO for m in by_pos):
+            s.reason = "unpriced"
+            continue
+        enc = encode(s.pod_vecs, s.pod_ids, packables, pad=False)
+        if enc is None:
+            s.reason = "unencodable"
+            continue
+        # unpriced/saturated types keep the saturated stand-in so the
+        # objective pushes their node count to zero, exactly like the
+        # repack relaxation's discipline
+        obj = [float(m) if 0 < m < SAT_MICRO else float(SAT_MICRO)
+               for m in by_pos]
+        s.num_shapes, s.num_types = enc.num_shapes, enc.num_types
+        s.row = len(rows)
+        rows.append(_schedule_tensors(enc, obj))
+
+    win = GlobalWindowEncoding(scheds=scheds)
+    if not rows:
+        return win
+    R = NUM_RESOURCES
+    win.b = _pow2(len(rows), lo=1)
+    win.sb = _pow2(max(sh.shape[0] for sh, *_ in rows))
+    win.tb = _pow2(max(cp.shape[0] for _, _, cp, *_ in rows))
+    B, SB, TB = win.b, win.sb, win.tb
+    win.d_shapes = np.zeros((B, SB, R), np.float32)
+    win.d_counts = np.zeros((B, SB), np.float32)
+    win.d_caps = np.zeros((B, TB, R), np.float32)
+    win.d_prices = np.ones((B, TB), np.float32)
+    win.d_tmask = np.zeros((B, TB), np.float32)
+    win.d_x0 = np.zeros((B, SB, TB), np.float32)
+    win.d_n0 = np.zeros((B, TB), np.float32)
+    for i, (shapes, counts, caps, prices, x0, n0) in enumerate(rows):
+        S, T = shapes.shape[0], caps.shape[0]
+        win.d_shapes[i, :S] = shapes
+        win.d_counts[i, :S] = counts
+        win.d_caps[i, :T] = caps
+        win.d_prices[i, :T] = prices
+        win.d_tmask[i, :T] = 1.0
+        win.d_x0[i, :S, :T] = x0
+        win.d_n0[i, :T] = n0
+    return win
+
+
+_RHO, _MU, _LR = 8.0, 8.0, 0.05
+
+
+def host_global_support(win: GlobalWindowEncoding,
+                        iters: int) -> np.ndarray:
+    """Numpy mirror of the device kernel: the SAME projected-gradient
+    recurrence (manual gradients of the penalty objective), batched over
+    the window rows. The device answer is only a filter, so the mirror
+    needs mathematical — not bit — equivalence."""
+    B, SB, TB = win.b, win.sb, win.tb
+    out = np.zeros((B, TB), np.float32)
+    for i in range(B):
+        shapes = win.d_shapes[i]          # (SB, R)
+        counts = win.d_counts[i]          # (SB,)
+        caps = win.d_caps[i]              # (TB, R)
+        pr = win.d_prices[i]              # (TB,)
+        tmask = win.d_tmask[i]            # (TB,)
+        x = win.d_x0[i].copy()            # (SB, TB)
+        n = win.d_n0[i].copy()            # (TB,)
+        for _ in range(iters):
+            load = np.einsum("st,sr->tr", x, shapes)
+            over = np.maximum(load - n[:, None] * caps, 0.0)
+            short = x.sum(axis=1) - counts
+            gx = _RHO * np.einsum("tr,sr->st", over, shapes) \
+                + _MU * short[:, None]
+            gn = pr - _RHO * (over * caps).sum(axis=1)
+            x = np.maximum(x - _LR * gx, 0.0) * tmask[None, :]
+            n = np.maximum(n - _LR * gn, 0.0) * tmask
+        out[i] = n
+    return out
+
+
+def support_positions(n_row: np.ndarray, num_types: int) -> List[int]:
+    """relax.py's keep rule over one fetched node-count row: a type
+    carries the support when the optimum provisions a meaningful fraction
+    of a node there (0.4 absorbs rounding noise; n is in nodes)."""
+    n = np.asarray(n_row[:num_types], dtype=np.float64)
+    if n.size == 0 or not np.all(np.isfinite(n)):
+        return []
+    return [t for t in range(num_types)
+            if n[t] >= max(0.4, 0.02 * float(n.max()))]

@@ -1,0 +1,319 @@
+"""Whole-window global solve: the ADMM relaxation as the window backend.
+
+All schedules × priced instance types of a provisioning window solve
+JOINTLY as one batched device proximal/ADMM program (a vmap over the
+window rows of relax.py's projected-gradient recurrence), demoting FFD
+to two exact roles it keeps forever:
+
+1. the ROUNDING ORACLE — each schedule's accepted plan is the exact host
+   FFD restricted to the relaxation's support (which types the optimum
+   uses), never the relaxation's fractional answer;
+2. the bit-for-bit PARITY FALLBACK — whenever the relaxation declines a
+   schedule (or its rounded plan is not STRICTLY cheaper in exact int
+   micro-$ arithmetic), the caller keeps the FFD backend's result object
+   untouched, so fallback parity is structural, not approximate.
+
+Transport discipline is the batch solver's: a non-blocking dispatch half
+marshals the window through the process DeviceRing (signature-keyed
+slots, donation-aliased refills) and launches the jitted kernel async; a
+fetch half materializes under the device watchdog / circuit breaker and
+falls back to a numpy mirror of the same recurrence on any failure —
+the window never stalls provisioning. The device (or mirror) answer is
+only a FILTER: every accepted plan is re-verified on host nano ints
+(ops/global_solve.verify_plan) before anything can bind.
+
+``KARPENTER_GLOBAL_SOLVE=0`` kills the backend regardless of
+``SolverConfig.window_backend``; pressure L1+ and gang schedules keep
+their dedicated paths (controllers/provisioning.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from functools import lru_cache
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.metrics.global_solve import (
+    GLOBAL_FALLBACK_TOTAL, GLOBAL_ITERATIONS, GLOBAL_SOLVE_SECONDS,
+    GLOBAL_USED_TOTAL, GLOBAL_WINDOWS_TOTAL)
+from karpenter_tpu.obs import trace as obtrace
+from karpenter_tpu.ops.global_solve import (
+    GlobalWindowEncoding, encode_window, host_global_support,
+    plan_cost_micro, support_positions, verify_plan)
+from karpenter_tpu.solver import host_ffd
+from karpenter_tpu.solver import solve as solve_module
+from karpenter_tpu.solver.solve import SolveResult, SolverConfig, materialize
+
+log = logging.getLogger("karpenter.solver.global")
+
+_ENV = "KARPENTER_GLOBAL_SOLVE"
+
+
+def enabled() -> bool:
+    """Kill switch: KARPENTER_GLOBAL_SOLVE=0/false/off forces the FFD
+    window backend regardless of --window-backend; default ON."""
+    return os.environ.get(_ENV, "").strip().lower() not in ("0", "false", "off")
+
+
+@dataclass
+class GlobalConfig:
+    use_device: bool = True
+    # projected-gradient iterations (the repack relaxation's default)
+    iters: int = 300
+    # below this many padded cells (B*SB*TB) the jit compile outweighs the
+    # solve — tiny test windows run the numpy mirror directly
+    device_min_cells: int = 1 << 12
+    device_timeout_s: float = 120.0
+    device_breaker_seconds: float = 120.0
+
+
+@lru_cache(maxsize=16)
+def _global_jit(b: int, sb: int, tb: int, iters: int):
+    """One executable per (window, shapes, types) bucket triple: vmap over
+    the window rows of the projected-gradient ADMM splitting — assignment
+    x and node-count n take alternating gradient steps against quadratic
+    penalties on the coupling constraints, projected onto the nonnegative
+    orthant (and the valid-type mask) each iteration."""
+    import jax
+    import jax.numpy as jnp
+
+    rho, mu, lr = 8.0, 8.0, 0.05
+
+    def one(shapes, counts, caps, prices, tmask, x0, n0):
+        def loss(x, n):
+            load = jnp.einsum("st,sr->tr", x, shapes)
+            over = jax.nn.relu(load - n[:, None] * caps)
+            short = jnp.sum(x, axis=1) - counts
+            return (jnp.dot(prices, n)
+                    + rho / 2.0 * jnp.sum(over * over)
+                    + mu / 2.0 * jnp.sum(short * short))
+
+        grad = jax.grad(loss, argnums=(0, 1))
+
+        def body(_, xn):
+            x, n = xn
+            gx, gn = grad(x, n)
+            return (jax.nn.relu(x - lr * gx) * tmask[None, :],
+                    jax.nn.relu(n - lr * gn) * tmask)
+
+        _, n = jax.lax.fori_loop(0, iters, body, (x0, n0))
+        return n
+
+    def kernel(shapes, counts, caps, prices, tmask, x0, n0):
+        return jax.vmap(one)(shapes, counts, caps, prices, tmask, x0, n0)
+
+    return jax.jit(kernel)
+
+
+@dataclass
+class GlobalInfo:
+    """What the global solve did for ONE schedule — every field
+    observable by metrics/bench (relax.py's RelaxInfo discipline)."""
+
+    used: bool
+    reason: str                 # "global" or "fallback-<why>"
+    relax_cost_micro: int = 0   # exact int µ$/h of the accepted plan
+    ffd_cost_micro: int = 0     # exact int µ$/h of the FFD baseline
+    support: int = 0
+    iters: int = 0
+
+
+@dataclass
+class GlobalPlan:
+    """The window's verdict: per-problem accepted SolveResult (None keeps
+    the FFD backend's result untouched — the parity fallback) + per-
+    problem info, and the executor that answered."""
+
+    results: List[Optional[SolveResult]] = field(default_factory=list)
+    infos: List[GlobalInfo] = field(default_factory=list)
+    executor: str = "none"
+    seconds: float = 0.0
+
+    @property
+    def accepted(self) -> int:
+        return sum(1 for r in self.results if r is not None)
+
+
+@dataclass
+class GlobalHandle:
+    """The in-flight half of a window solve. ``fetch()`` blocks (under
+    the watchdog when on device) and is idempotent."""
+
+    win: GlobalWindowEncoding
+    config: GlobalConfig
+    solver_config: SolverConfig
+    problems: Sequence = ()
+    _out: Optional[object] = None    # device future (B, TB) node counts
+    _slot: Optional[object] = None
+    _ring: Optional[object] = None
+    _result: Optional[GlobalPlan] = None
+    _trace_ctx: Optional[object] = None
+    dispatch_seconds: float = 0.0
+    _t0: float = 0.0
+
+    def fetch(self) -> GlobalPlan:
+        if self._result is not None:
+            return self._result
+        with obtrace.use_context(self._trace_ctx), \
+                obtrace.span("global-fetch", schedules=len(self.win.scheds)):
+            self._result = self._fetch()
+        return self._result
+
+    def _fetch(self) -> GlobalPlan:
+        n_rows = None
+        executor = "host-global"
+        if self._out is not None:
+            try:
+                def _materialize():
+                    return np.asarray(self._out)
+
+                if self.config.device_timeout_s > 0:
+                    n_rows = solve_module._WATCHDOG.run(
+                        _materialize, self.config.device_timeout_s,
+                        self.config.device_breaker_seconds)
+                else:
+                    n_rows = _materialize()
+                executor = "device-global"
+            except Exception:
+                log.exception(
+                    "device global-solve fetch failed; host mirror fallback")
+                n_rows = None
+            finally:
+                if self._ring is not None and self._slot is not None:
+                    self._ring.release(self._slot)
+                    self._slot = None
+        if n_rows is None and self.win.device_ready:
+            n_rows = host_global_support(self.win, self.config.iters)
+        plan = _round_window(self.win, n_rows, self.solver_config,
+                             self.config, executor)
+        plan.seconds = time.perf_counter() - self._t0
+        GLOBAL_SOLVE_SECONDS.observe(plan.seconds)
+        return plan
+
+
+def _round_window(win: GlobalWindowEncoding, n_rows: Optional[np.ndarray],
+                  solver_config: SolverConfig, config: GlobalConfig,
+                  executor: str) -> GlobalPlan:
+    """The fetch-side contract, per schedule: support → exact restricted
+    host FFD rounding → strictly-cheaper test in exact int micro-$ →
+    independent host re-verification. Anything short of all four keeps
+    the FFD backend's plan (results[pos] = None)."""
+    plan = GlobalPlan(executor=executor)
+    for s in win.scheds:
+        info = GlobalInfo(used=False, reason="fallback-error",
+                          iters=config.iters)
+        accepted: Optional[SolveResult] = None
+        if s.reason is not None:
+            info.reason = f"fallback-{s.reason}"
+        elif s.row < 0 or n_rows is None:
+            info.reason = "fallback-error"
+        else:
+            keep = support_positions(n_rows[s.row], s.num_types)
+            info.support = len(keep)
+            ffd = host_ffd.pack(s.pod_vecs, s.pod_ids, s.packables,
+                                max_instance_types=solver_config
+                                .max_instance_types)
+            info.ffd_cost_micro = plan_cost_micro(ffd, s.prices_micro) \
+                if ffd.packings else 0
+            if not keep:
+                info.reason = "fallback-no-support"
+            else:
+                restricted = [s.packables[t].copy() for t in keep]
+                rounded = host_ffd.pack(
+                    s.pod_vecs, s.pod_ids, restricted,
+                    max_instance_types=solver_config.max_instance_types)
+                if rounded.unschedulable:
+                    info.reason = "fallback-infeasible"
+                else:
+                    rmicro = plan_cost_micro(rounded, s.prices_micro)
+                    info.relax_cost_micro = rmicro
+                    if ffd.unschedulable == [] \
+                            and rmicro >= info.ffd_cost_micro:
+                        info.reason = "fallback-costlier"
+                    elif not verify_plan(
+                            {pid: vec for pid, vec in
+                             zip(s.pod_ids, s.pod_vecs)},
+                            {p.index: p for p in s.packables}, rounded):
+                        info.reason = "fallback-unverified"
+                    else:
+                        info.used = True
+                        info.reason = "global"
+                        accepted = materialize(
+                            rounded, s.pods, s.sorted_types,
+                            s.constraints, solver_config)
+        if info.used:
+            GLOBAL_USED_TOTAL.inc()
+        else:
+            GLOBAL_FALLBACK_TOTAL.inc(
+                reason=info.reason.replace("fallback-", ""))
+        plan.results.append(accepted)
+        plan.infos.append(info)
+    return plan
+
+
+def dispatch_global_window(
+    problems: Sequence,
+    solver_config: Optional[SolverConfig] = None,
+    config: Optional[GlobalConfig] = None,
+) -> GlobalHandle:
+    """Encode the window and launch the batched kernel WITHOUT blocking
+    (jax async dispatch). Buffers cycle through the process DeviceRing
+    keyed by the padded bucket signature. Any dispatch failure simply
+    leaves the handle deviceless — fetch runs the numpy mirror."""
+    solver_config = solver_config or SolverConfig()
+    config = config or GlobalConfig()
+    t0 = time.perf_counter()
+    GLOBAL_WINDOWS_TOTAL.inc()
+    GLOBAL_ITERATIONS.set(float(config.iters))
+    win = encode_window(problems, solver_config.cost_config)
+    handle = GlobalHandle(win=win, config=config,
+                          solver_config=solver_config, problems=problems,
+                          _trace_ctx=obtrace.current_context(), _t0=t0)
+    if (not config.use_device or not win.device_ready
+            or win.cells < config.device_min_cells
+            or solve_module._WATCHDOG.tripped()):
+        return handle
+    try:
+        from karpenter_tpu.parallel.mesh import (
+            batch_sharding, replicated, solver_mesh)
+        from karpenter_tpu.solver.pipeline import DeviceRing, get_ring
+
+        mesh = solver_mesh()
+        row_sh = batch_sharding(mesh) if win.b % mesh.devices.size == 0 \
+            else replicated(mesh)
+        host = {"gw_shapes": win.d_shapes, "gw_counts": win.d_counts,
+                "gw_caps": win.d_caps, "gw_prices": win.d_prices,
+                "gw_tmask": win.d_tmask, "gw_x0": win.d_x0,
+                "gw_n0": win.d_n0}
+        ring = get_ring()
+        slot = ring.acquire(DeviceRing.signature(host))
+        dev = {}
+        for name, arr in host.items():
+            dev[name] = ring.fill(slot, name, arr, row_sh)
+        fn = _global_jit(win.b, win.sb, win.tb, config.iters)
+        handle._out = fn(dev["gw_shapes"], dev["gw_counts"],
+                         dev["gw_caps"], dev["gw_prices"],
+                         dev["gw_tmask"], dev["gw_x0"], dev["gw_n0"])
+        handle._slot, handle._ring = slot, ring
+    except Exception:
+        log.exception("device global-solve dispatch failed; "
+                      "host mirror fallback")
+        handle._out = handle._slot = handle._ring = None
+    handle.dispatch_seconds = time.perf_counter() - t0
+    obtrace.add_span("global-dispatch", t0, time.perf_counter(),
+                     schedules=len(win.scheds))
+    return handle
+
+
+def solve_window_global(
+    problems: Sequence,
+    solver_config: Optional[SolverConfig] = None,
+    config: Optional[GlobalConfig] = None,
+) -> GlobalPlan:
+    """dispatch + fetch in one call (bench and tests)."""
+    return dispatch_global_window(problems, solver_config, config).fetch()

@@ -22,6 +22,8 @@ suite:
 
 - rounded plan infeasible (any pod unschedulable)  → exact FFD plan
 - rounded plan costlier than the exact FFD plan    → exact FFD plan
+  (decided in exact int micro-$ — ops/global_solve.price_micro, the
+  encode_prices truncation with explicit saturation — never float)
 - anything unencodable / unpriced / jax failure    → exact FFD plan
 
 so every plan that leaves this module is an exact-FFD-verified packing;
@@ -46,12 +48,13 @@ from karpenter_tpu.solver.solve import (
 
 log = logging.getLogger("karpenter.solver.relax")
 
-_BIG = 1e9  # price stand-in for unpriced/unviable types in the objective
-
 
 @dataclass
 class RelaxInfo:
-    """What the relaxation did — every field observable by metrics/bench."""
+    """What the relaxation did — every field observable by metrics/bench.
+    The cost fields are display-domain $/h derived from the exact int
+    micro-$ comparison (ops/global_solve.plan_cost_micro) — the decision
+    itself is never made in float."""
 
     used: bool
     reason: str            # "relaxation" or "fallback-<why>"
@@ -60,16 +63,6 @@ class RelaxInfo:
     support: int = 0       # instance types the relaxation selected
     iters: int = 0
     seconds: float = 0.0
-
-
-def _hsr_cost(result: HostSolveResult, prices: Sequence[float]) -> float:
-    """$/h of a host solve result, charging each node its cheapest viable
-    option — the same convention as models/cost.plan_cost."""
-    total = 0.0
-    for p in result.packings:
-        total += min(prices[j] for j in p.instance_type_indices) \
-            * p.node_quantity
-    return total
 
 
 def _relax_support(enc, prices_by_packable: Sequence[float],
@@ -144,22 +137,30 @@ def relax_pack(
     rounding, cheapest feasible wins. ``pod_vecs`` must be sorted
     descending (host_ffd.pack's contract); ``prices_sorted_types`` is $/h
     per sorted_types position (packable .index domain)."""
+    from karpenter_tpu.ops.global_solve import (
+        SAT_MICRO, plan_cost_micro, price_micro)
+
     t0 = time.perf_counter()
     ffd = host_ffd.pack(pod_vecs, pod_ids, packables,
                         max_instance_types=max_instance_types)
-    ffd_cost = _hsr_cost(ffd, prices_sorted_types) if ffd.packings else 0.0
+    # all cost accounting in exact int micro-$ (encode_prices' truncation,
+    # explicit saturation) — a float objective can mis-rank near-tied fleets
+    micro = [price_micro(p) for p in prices_sorted_types]
+    ffd_micro = plan_cost_micro(ffd, micro) if ffd.packings else 0
 
-    def fallback(reason: str, relax_cost: float = float("inf"),
+    def fallback(reason: str, relax_micro: Optional[int] = None,
                  ) -> Tuple[HostSolveResult, RelaxInfo]:
-        return ffd, RelaxInfo(used=False, reason=f"fallback-{reason}",
-                              relax_cost=relax_cost, ffd_cost=ffd_cost,
-                              iters=iters,
-                              seconds=time.perf_counter() - t0)
+        return ffd, RelaxInfo(
+            used=False, reason=f"fallback-{reason}",
+            relax_cost=(relax_micro / 1e6 if relax_micro is not None
+                        else float("inf")),
+            ffd_cost=ffd_micro / 1e6, iters=iters,
+            seconds=time.perf_counter() - t0)
 
     if not packables or not pod_vecs:
         return fallback("empty")
-    by_pos = [prices_sorted_types[p.index] for p in packables]
-    if not any(0.0 < v < _BIG for v in by_pos):
+    by_pos = [micro[p.index] for p in packables]
+    if not any(0 < m < SAT_MICRO for m in by_pos):
         return fallback("unpriced")  # objective degenerate without prices
 
     from karpenter_tpu.ops.encode import encode
@@ -167,8 +168,12 @@ def relax_pack(
     enc = encode(pod_vecs, pod_ids, packables, pad=False)
     if enc is None:
         return fallback("unencodable")
+    # the gradient objective runs on the int32-truncated micro-$ values
+    # (saturated stand-in for unpriced types), so the optimum it shapes is
+    # ranked by the SAME numbers the exact comparison below uses
     keep = _relax_support(
-        enc, [min(v, _BIG) if v > 0 else _BIG for v in by_pos], iters)
+        enc, [float(m) if 0 < m < SAT_MICRO else float(SAT_MICRO)
+              for m in by_pos], iters)
     if not keep:
         return fallback("no-support" if keep == [] else "jax-error")
     restricted = [packables[t].copy() for t in keep]
@@ -176,12 +181,12 @@ def relax_pack(
                             max_instance_types=max_instance_types)
     if rounded.unschedulable:
         return fallback("infeasible")
-    relax_cost = _hsr_cost(rounded, prices_sorted_types)
-    if ffd.unschedulable == [] and relax_cost >= ffd_cost - 1e-12:
-        return fallback("costlier", relax_cost)
+    relax_micro = plan_cost_micro(rounded, micro)
+    if ffd.unschedulable == [] and relax_micro >= ffd_micro:
+        return fallback("costlier", relax_micro)
     return rounded, RelaxInfo(
-        used=True, reason="relaxation", relax_cost=relax_cost,
-        ffd_cost=ffd_cost, support=len(keep), iters=iters,
+        used=True, reason="relaxation", relax_cost=relax_micro / 1e6,
+        ffd_cost=ffd_micro / 1e6, support=len(keep), iters=iters,
         seconds=time.perf_counter() - t0)
 
 

@@ -310,6 +310,15 @@ class SolverConfig:
     # repack cost (interruption-priced) and the throughput table
     # (throughput-per-dollar); inert for "cheapest"
     policy_context: PolicyContext = field(default_factory=PolicyContext)
+    # provisioning-window packing backend: "ffd" keeps the per-schedule
+    # greedy batch; "global" additionally solves the whole window JOINTLY
+    # as one batched proximal/ADMM relaxation (solver/global_solve.py),
+    # with FFD demoted to the support-restricted rounding oracle and the
+    # bit-for-bit fallback whenever the relaxation declines or is not
+    # strictly cheaper in exact int micro-$. Pressure L1+ and gang
+    # schedules always keep the FFD path; KARPENTER_GLOBAL_SOLVE=0 kills
+    # the global path regardless of this setting.
+    window_backend: str = "ffd"
     # auto-select the type-SPMD kernel (device_kernel=None) only when the
     # padded type bucket reaches this size AND the mesh has more than one
     # device: below it, the per-node collective round-trips cost more than

@@ -1,0 +1,369 @@
+"""Differential suite for the whole-window global solve backend
+(ops/global_solve.py + solver/global_solve.py).
+
+Seeded heterogeneous windows (seeds 1/7/42) pin the backend's contracts:
+
+- VERDICT IS A FILTER: every accepted plan re-verifies bit-exact on host
+  ints (verify_plan replays each node through a fresh Packable) and
+  conserves every pod of its schedule exactly once.
+- STRICTLY CHEAPER: a plan is used only when fully feasible AND strictly
+  cheaper than the exact FFD plan in int micro-$ — the comparison never
+  happens in floats.
+- EXACT-FFD PARITY ON DECLINE: every fallback leaves results[i] None so
+  the controller keeps the untouched FFD plan byte-for-byte; reasons come
+  from the closed vocabulary.
+- LOSES NOTHING: a watchdog trip mid-fetch falls back to the host mirror
+  with zero lost or duplicated pods.
+- KILL SWITCH: KARPENTER_GLOBAL_SOLVE=0 collapses window_backend="global"
+  to the FFD backend — bind groups and node counts identical.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.core import (
+    Container, Pod, PodSpec, ResourceRequirements,
+)
+from karpenter_tpu.cloudprovider.fake.provider import (
+    FakeCloudProvider, make_instance_type,
+)
+from karpenter_tpu.cloudprovider.spi import Offering
+from karpenter_tpu.controllers.provisioning import (
+    ProvisionerWorker, universe_constraints,
+)
+from karpenter_tpu.ops.global_solve import (
+    SAT_MICRO, encode_window, plan_cost_micro, price_micro, verify_plan,
+)
+from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.scheduling.batcher import Batcher
+from karpenter_tpu.solver import global_solve
+from karpenter_tpu.solver import host_ffd
+from karpenter_tpu.solver import solve as solve_mod
+from karpenter_tpu.solver.batch_solve import Problem
+from karpenter_tpu.solver.global_solve import (
+    GlobalConfig, dispatch_global_window, solve_window_global,
+)
+from karpenter_tpu.solver.solve import SolverConfig
+
+from tests.expectations import make_provisioner, unschedulable_pod
+
+SEEDS = (1, 7, 42)
+FALLBACK_REASONS = {
+    "empty", "window-cap", "unpriced", "unencodable", "no-support",
+    "infeasible", "costlier", "unverified", "error",
+}
+# device_min_cells past any window size → the numpy host mirror runs
+MIRROR = GlobalConfig(device_min_cells=1 << 30)
+FORCE_DEVICE = GlobalConfig(device_min_cells=0)
+
+
+@pytest.fixture()
+def fresh_watchdog(monkeypatch):
+    wd = solve_mod._DeviceWatchdog()
+    monkeypatch.setattr(solve_mod, "_WATCHDOG", wd)
+    return wd
+
+
+def mk_type(name, cpu, mem, price):
+    return make_instance_type(
+        name=name, cpu=cpu, memory=mem, pods="110",
+        offerings=[Offering("on-demand", "z1")], price=price)
+
+
+def priced_catalog():
+    """Cheap-small vs expensive-big: the shape where a joint relaxation
+    can strictly beat per-schedule FFD's biggest-first type choice."""
+    return [
+        mk_type("small", "8", "16Gi", 1.0),
+        mk_type("mid", "16", "32Gi", 3.5),
+        mk_type("big", "32", "64Gi", 10.0),
+    ]
+
+
+def req_pod(cpu, mem):
+    return Pod(spec=PodSpec(containers=[Container(
+        resources=ResourceRequirements.make(
+            requests={"cpu": cpu, "memory": mem}))]))
+
+
+def random_window(seed, n_scheds=5, catalog=None):
+    rng = random.Random(seed)
+    catalog = catalog or priced_catalog()
+    constraints = universe_constraints(catalog)
+    problems = []
+    for _ in range(n_scheds):
+        shapes = [("1", "2Gi"), ("2", "4Gi"), ("4", "8Gi"), ("500m", "1Gi")]
+        pods = [req_pod(*rng.choice(shapes))
+                for _ in range(rng.randint(3, 24))]
+        problems.append(Problem(constraints=constraints, pods=pods,
+                                instance_types=catalog))
+    return catalog, problems
+
+
+def assert_conserved(result, pods):
+    """Every pod of the schedule appears exactly once across the plan's
+    packings + unschedulable — nothing lost, nothing duplicated."""
+    placed = [id(p) for packing in result.packings
+              for node in packing.pods for p in node]
+    placed += [id(p) for p in result.unschedulable]
+    assert sorted(placed) == sorted(id(p) for p in pods)
+
+
+class TestVerdictIsAFilter:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_accepted_plans_reverify_on_host_ints(self, seed):
+        catalog, problems = random_window(seed)
+        cfg = SolverConfig()
+        plan = solve_window_global(problems, cfg, MIRROR)
+        assert plan.executor == "host-global"
+        assert len(plan.results) == len(problems)
+        win = encode_window(problems, cfg.cost_config)
+        for s, result, info in zip(win.scheds, plan.results, plan.infos):
+            if result is None:
+                continue
+            assert info.used and info.reason == "global"
+            # independent bit-exact replay on fresh host ints
+            ffd = host_ffd.pack(s.pod_vecs, s.pod_ids, s.packables,
+                                max_instance_types=cfg.max_instance_types)
+            assert result.unschedulable == []
+            assert_conserved(result, problems[s.pos].pods)
+            # strictly cheaper in exact int micro-$, vs the exact FFD plan
+            assert info.relax_cost_micro < info.ffd_cost_micro
+            assert info.ffd_cost_micro == plan_cost_micro(
+                ffd, s.prices_micro)
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_device_matches_host_mirror(self, seed, fresh_watchdog):
+        catalog, problems = random_window(seed)
+        cfg = SolverConfig()
+        dev = solve_window_global(problems, cfg, FORCE_DEVICE)
+        mirror = solve_window_global(problems, cfg, MIRROR)
+        assert dev.executor == "device-global"
+        assert [i.reason for i in dev.infos] == \
+            [i.reason for i in mirror.infos]
+        for a, b in zip(dev.results, mirror.results):
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert a.node_count == b.node_count
+                assert [sorted(id(p) for node in pk.pods for p in node)
+                        for pk in a.packings] == \
+                    [sorted(id(p) for node in pk.pods for p in node)
+                     for pk in b.packings]
+
+
+class TestStrictlyCheaperGate:
+    def test_accepts_only_when_nano_int_cheaper(self):
+        # one type only: the restricted rounding can never beat full FFD,
+        # so the window must decline every schedule with "costlier"
+        catalog = [mk_type("only", "8", "16Gi", 1.0)]
+        _, problems = random_window(3, n_scheds=3, catalog=catalog)
+        plan = solve_window_global(problems, SolverConfig(), MIRROR)
+        assert plan.accepted == 0
+        for info, result in zip(plan.infos, plan.results):
+            assert result is None
+            assert info.reason == "fallback-costlier"
+            assert info.relax_cost_micro >= info.ffd_cost_micro
+
+    def test_accepts_strictly_cheaper_fleet(self):
+        # 8 pods of 2cpu: FFD opens one $10 'big' node; the joint solve
+        # must find three $1 'small' nodes and win in exact micro-$
+        catalog = [mk_type("small", "8", "16Gi", 1.0),
+                   mk_type("big", "32", "64Gi", 10.0)]
+        constraints = universe_constraints(catalog)
+        pods = [req_pod("2", "4Gi") for _ in range(8)]
+        problems = [Problem(constraints=constraints, pods=pods,
+                            instance_types=catalog)]
+        plan = solve_window_global(problems, SolverConfig(), MIRROR)
+        assert plan.accepted == 1
+        info = plan.infos[0]
+        assert info.reason == "global"
+        assert info.relax_cost_micro == 3 * 1_000_000
+        assert info.ffd_cost_micro == 10 * 1_000_000
+        result = plan.results[0]
+        assert result.node_count == 3
+        assert all(pk.instance_type_options[0].name == "small"
+                   for pk in result.packings)
+        assert_conserved(result, pods)
+
+    def test_infeasible_rounding_declines(self):
+        # pods that exceed every type: FFD marks them unschedulable, the
+        # rounded plan can't be fully feasible → never accepted
+        catalog = [mk_type("small", "8", "16Gi", 1.0)]
+        constraints = universe_constraints(catalog)
+        pods = [req_pod("64", "4Gi") for _ in range(2)]
+        problems = [Problem(constraints=constraints, pods=pods,
+                            instance_types=catalog)]
+        plan = solve_window_global(problems, SolverConfig(), MIRROR)
+        assert plan.accepted == 0
+        assert plan.infos[0].reason.startswith("fallback-")
+
+
+class TestFallbackParity:
+    def test_every_fallback_reason_leaves_result_none(self):
+        for seed in SEEDS:
+            _, problems = random_window(seed)
+            plan = solve_window_global(problems, SolverConfig(), MIRROR)
+            for info, result in zip(plan.infos, plan.results):
+                if info.used:
+                    assert result is not None
+                else:
+                    assert result is None, \
+                        "declined schedules must keep the FFD plan"
+                    assert info.reason.startswith("fallback-")
+                    assert info.reason[len("fallback-"):] in FALLBACK_REASONS
+
+    def test_unpriced_window_declines_every_schedule(self):
+        catalog = [mk_type("free", "8", "16Gi", 0.0)]
+        _, problems = random_window(11, n_scheds=2, catalog=catalog)
+        plan = solve_window_global(problems, SolverConfig(), MIRROR)
+        assert plan.accepted == 0
+        assert all(i.reason == "fallback-unpriced" for i in plan.infos)
+
+    def test_empty_schedule_declines(self):
+        catalog = priced_catalog()
+        constraints = universe_constraints(catalog)
+        problems = [Problem(constraints=constraints, pods=[],
+                            instance_types=catalog)]
+        plan = solve_window_global(problems, SolverConfig(), MIRROR)
+        assert plan.results == [None]
+        assert plan.infos[0].reason == "fallback-empty"
+
+    def test_window_cap_declines_overflow_schedules(self):
+        catalog, problems = random_window(5, n_scheds=4)
+        win = encode_window(problems, SolverConfig().cost_config,
+                            max_schedules=2)
+        reasons = [s.reason for s in win.scheds]
+        assert reasons[:2] == [None, None]
+        assert reasons[2:] == ["window-cap", "window-cap"]
+
+
+class TestWatchdogTrip:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_trip_mid_fetch_loses_nothing(self, seed, fresh_watchdog,
+                                          monkeypatch):
+        catalog, problems = random_window(seed)
+        cfg = SolverConfig()
+        mirror = solve_window_global(problems, cfg, MIRROR)
+        handle = dispatch_global_window(problems, cfg, FORCE_DEVICE)
+
+        def tripping_run(fn, timeout_s, breaker_seconds=None, **kw):
+            raise TimeoutError("injected device hang")
+
+        monkeypatch.setattr(solve_mod._WATCHDOG, "run", tripping_run)
+        plan = handle.fetch()
+        # the device fetch tripped → host mirror answered the window
+        assert plan.executor == "host-global"
+        assert [i.reason for i in plan.infos] == \
+            [i.reason for i in mirror.infos]
+        for result, problem in zip(plan.results, problems):
+            if result is not None:
+                assert_conserved(result, problem.pods)
+
+    def test_fetch_is_idempotent(self, fresh_watchdog):
+        _, problems = random_window(7, n_scheds=2)
+        handle = dispatch_global_window(problems, SolverConfig(), MIRROR)
+        first = handle.fetch()
+        assert handle.fetch() is first
+
+
+class TestKillSwitch:
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_GLOBAL_SOLVE", raising=False)
+        assert global_solve.enabled()
+        for off in ("0", "false", "off"):
+            monkeypatch.setenv("KARPENTER_GLOBAL_SOLVE", off)
+            assert not global_solve.enabled()
+        monkeypatch.setenv("KARPENTER_GLOBAL_SOLVE", "1")
+        assert global_solve.enabled()
+
+    def _run_provision(self, seed, backend):
+        kube = KubeCore()
+        catalog = priced_catalog()
+        provider = FakeCloudProvider(catalog=catalog)
+        provisioner = make_provisioner(
+            constraints=universe_constraints(catalog))
+        kube.create(provisioner)
+        worker = ProvisionerWorker(
+            provisioner, kube, provider,
+            solver_config=SolverConfig(window_backend=backend),
+            batcher=Batcher(idle_seconds=0.05, max_seconds=5.0))
+        binds = []
+        orig_bind = worker._bind
+
+        def recording_bind(node, pods):
+            binds.append(tuple(sorted(p.metadata.name for p in pods)))
+            return orig_bind(node, pods)
+
+        worker._bind = recording_bind
+        rng = random.Random(seed)
+        names = []
+        for i in range(40):
+            pod = unschedulable_pod(
+                requests={"cpu": rng.choice(["250m", "500m", "1"]),
+                          "memory": rng.choice(["256Mi", "512Mi"])},
+                name=f"pod-g{seed}-{i:03d}")
+            names.append(pod.metadata.name)
+            kube.create(pod)
+            assert worker.add(
+                pod, key=(pod.metadata.namespace, pod.metadata.name)) \
+                is not None
+        worker.provision()
+        worker.stop()
+        return binds, len(kube.list("Node")), names
+
+    def test_kill_switch_collapses_to_ffd_parity(self, monkeypatch,
+                                                 fresh_watchdog):
+        seed = 42
+        ffd_binds, ffd_nodes, names = self._run_provision(seed, "ffd")
+        monkeypatch.setenv("KARPENTER_GLOBAL_SOLVE", "0")
+        off_binds, off_nodes, _ = self._run_provision(seed, "global")
+        assert off_binds == ffd_binds
+        assert off_nodes == ffd_nodes
+        flat = sorted(n for group in off_binds for n in group)
+        assert flat == sorted(names)
+
+    def test_global_backend_binds_every_pod(self, monkeypatch,
+                                            fresh_watchdog):
+        monkeypatch.setenv("KARPENTER_GLOBAL_SOLVE", "1")
+        binds, nodes, names = self._run_provision(7, "global")
+        flat = sorted(n for group in binds for n in group)
+        assert flat == sorted(names)
+        assert nodes >= 1
+
+
+class TestExactIntSeam:
+    def test_price_micro_truncates_and_saturates(self):
+        assert price_micro(1.0) == 1_000_000
+        assert price_micro(0.0000014) == 1  # truncation, not rounding
+        assert price_micro(float("inf")) == SAT_MICRO
+        assert price_micro(1e30) == SAT_MICRO
+
+    def test_plan_cost_is_python_int(self):
+        catalog = [mk_type("small", "8", "16Gi", 1.0)]
+        constraints = universe_constraints(catalog)
+        pods = [req_pod("1", "1Gi") for _ in range(3)]
+        problems = [Problem(constraints=constraints, pods=pods,
+                            instance_types=catalog)]
+        win = encode_window(problems, SolverConfig().cost_config)
+        s = win.scheds[0]
+        ffd = host_ffd.pack(s.pod_vecs, s.pod_ids, s.packables)
+        cost = plan_cost_micro(ffd, s.prices_micro)
+        assert type(cost) is int and cost > 0
+
+    def test_verify_plan_rejects_duplicated_pod(self):
+        catalog = [mk_type("small", "8", "16Gi", 1.0)]
+        constraints = universe_constraints(catalog)
+        pods = [req_pod("1", "1Gi") for _ in range(3)]
+        problems = [Problem(constraints=constraints, pods=pods,
+                            instance_types=catalog)]
+        win = encode_window(problems, SolverConfig().cost_config)
+        s = win.scheds[0]
+        ffd = host_ffd.pack(s.pod_vecs, s.pod_ids, s.packables)
+        vecs = dict(zip(s.pod_ids, s.pod_vecs))
+        by_index = {p.index: p for p in s.packables}
+        assert verify_plan(vecs, by_index, ffd)
+        # duplicate one pod id inside a node → conservation check fires
+        ffd.packings[0].pod_ids[0].append(ffd.packings[0].pod_ids[0][0])
+        assert not verify_plan(vecs, by_index, ffd)

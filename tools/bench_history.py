@@ -92,6 +92,20 @@ def _policy_cell(extra: dict) -> str:
     return f"{cfg['speedup']}x/{par}/f{len(cfg.get('spot_frontier') or [])}"
 
 
+def _global_cell(extra: dict) -> str:
+    """Compressed global-window column (config_14, round 14+): fleet
+    saving vs per-schedule FFD, accepted schedules, verdict (decline
+    parity AND zero unverified AND live kill switch) — '12.48%/a3/par'.
+    '!par' flags any break; '-' when the config never ran."""
+    cfg = extra.get("config_14_global_window")
+    if not isinstance(cfg, dict) or "saving_pct" not in cfg:
+        return "-"
+    par = "par" if (cfg.get("decline_parity")
+                    and cfg.get("unverified") == 0
+                    and cfg.get("killswitch_gate")) else "!par"
+    return f"{cfg['saving_pct']}%/a{cfg.get('accepted', '?')}/{par}"
+
+
 def _slo_cell(extra: dict) -> str:
     """Compressed SLO column (config_9 replay + chaos probe, round 14+):
     clean-leg sentinel trips, chaos-probe trips, worst digest-parity
@@ -165,7 +179,7 @@ def load_rows(root: str) -> list:
                     "value": None, "unit": "", "device_count": None,
                     "backend": "?", "degraded": None, "configs": "-",
                     "marshal": "-", "gang": "-", "filter": "-",
-                    "policy": "-", "slo": "-"})
+                    "policy": "-", "global": "-", "slo": "-"})
                 continue
             line = inner
         extra = line.get("extra", {}) if isinstance(line, dict) else {}
@@ -183,6 +197,7 @@ def load_rows(root: str) -> list:
             "gang": _gang_cell(extra),
             "filter": _filter_cell(extra),
             "policy": _policy_cell(extra),
+            "global": _global_cell(extra),
             "slo": _slo_cell(extra),
         })
     for b in bad:
@@ -194,7 +209,7 @@ def load_rows(root: str) -> list:
 def render(rows: list) -> str:
     headers = ["round", "variant", "metric", "value", "unit",
                "device_count", "backend", "degraded", "configs", "marshal",
-               "gang", "filter", "policy", "slo"]
+               "gang", "filter", "policy", "global", "slo"]
     table = [headers] + [
         ["" if r[h] is None else str(r[h]) for h in headers] for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(headers))]

@@ -82,6 +82,9 @@ SERIES = [
     ("policy_scoring_speedup",
      lambda l: _dig(l, "extra", "config_13_policy_scoring", "speedup"),
      "higher", 0.30),
+    ("global_window_saving_pct",
+     lambda l: _dig(l, "extra", "config_14_global_window", "saving_pct"),
+     "higher", 0.30),
 ]
 
 # (name, extractor(line) -> bool|None): latest non-None entry must be True
@@ -111,6 +114,15 @@ FLAGS = [
                          "unverified") == 0
                 and bool(_dig(l, "extra", "config_13_policy_scoring",
                               "frontier_ok")))),
+    ("global_window_parity",
+     lambda l: (None if _dig(l, "extra", "config_14_global_window",
+                             "decline_parity") is None
+                else bool(_dig(l, "extra", "config_14_global_window",
+                               "decline_parity"))
+                and _dig(l, "extra", "config_14_global_window",
+                         "unverified") == 0
+                and bool(_dig(l, "extra", "config_14_global_window",
+                              "killswitch_gate")))),
     ("slo_clean_trips_zero",
      lambda l: (None if _dig(l, "extra", "config_9_million_pod_replay",
                              "replay", "slo") is None

@@ -1,0 +1,95 @@
+"""Global-window verdict: one human-readable line from the bench JSON.
+
+`make bench-global` pipes bench.py (``--only config_14``) through this
+filter. The bench line passes through UNCHANGED on stdout (so
+`> BENCH_rNN.json` redirects still capture the pure JSON); the verdict
+goes to stderr:
+
+    global window: 12 schedules x 6 types, fleet $120.46/h vs FFD \
+$137.64/h (12.48% cheaper, 3 accepted), p99 59.7ms <= 200.0ms budget, \
+decline_parity=True killswitch=True, unverified=0 — PASS
+
+PASS needs (the round-14 acceptance gate):
+- the joint window plan is >= 5% cheaper per hour than per-schedule
+  exact FFD (or places strictly fewer nodes), with the cost computed by
+  the controller's substitution rule — accepted schedules contribute
+  their rounded plan, declined ones their untouched FFD plan — in exact
+  int micro-$;
+- at least one schedule accepted (the relaxation actually fired, the
+  saving is not vacuous);
+- window p99 inside the budget: the global solve rides the dispatch
+  stage concurrent with the per-schedule batch, so the provisioning p99
+  is unchanged as long as the global leg fits max(200ms, 5x FFD p99);
+- exact-FFD parity on every decline: the single-type window (where
+  restricted rounding can never win) returns all-None results with
+  fallback-prefixed reasons — the controller keeps the FFD plan
+  byte-for-byte;
+- zero unverified placements: no plan that failed the host int replay
+  (verify_plan) was ever accepted;
+- the KARPENTER_GLOBAL_SOLVE=0 kill switch reads as disabled.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+GATE_SAVING_PCT = 5.0
+
+
+def verdict(line: dict) -> str:
+    extra = line.get("extra", {})
+    cfg = extra.get("config_14_global_window", {})
+    if "error" in cfg or "saving_pct" not in cfg:
+        return ("global window: no config_14_global_window in bench line "
+                f"({cfg.get('error', cfg.get('skipped', 'config_14 not run'))})"
+                " — NO VERDICT")
+    saving = cfg.get("saving_pct")
+    cheaper = (saving is not None and saving >= GATE_SAVING_PCT) or (
+        cfg.get("global_nodes") is not None
+        and cfg.get("ffd_nodes") is not None
+        and cfg["global_nodes"] < cfg["ffd_nodes"])
+    head = (f"global window: {cfg.get('schedules')} schedules x "
+            f"{cfg.get('types')} types, fleet "
+            f"${cfg.get('global_cost_per_hour')}/h vs FFD "
+            f"${cfg.get('ffd_cost_per_hour')}/h ({saving}% cheaper, "
+            f"{cfg.get('accepted')} accepted), p99 "
+            f"{cfg.get('global_p99_ms')}ms <= {cfg.get('p99_budget_ms')}ms "
+            f"budget, decline_parity={cfg.get('decline_parity')} "
+            f"killswitch={cfg.get('killswitch_gate')}, "
+            f"unverified={cfg.get('unverified')}")
+    ok = (cheaper and (cfg.get("accepted") or 0) >= 1
+          and cfg.get("p99_ok") is True
+          and cfg.get("decline_parity") is True
+          and cfg.get("killswitch_gate") is True
+          and cfg.get("unverified") == 0)
+    return (f"{head} — {'PASS' if ok else 'FAIL'} "
+            f"(gate >={GATE_SAVING_PCT}% cheaper or fewer nodes, >=1 "
+            "accepted, p99 in budget, decline parity, kill switch, "
+            "0 unverified)")
+
+
+def main() -> int:
+    last = None
+    for raw in sys.stdin:
+        sys.stdout.write(raw)  # pass-through: stdout stays the pure JSON
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            line = json.loads(raw)
+            if isinstance(line, dict) and "metric" in line:
+                last = line
+        except ValueError:
+            continue
+    sys.stdout.flush()
+    if last is None:
+        print("global window: no bench JSON line on stdin — NO VERDICT",
+              file=sys.stderr)
+        return 1
+    print(verdict(last), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,7 @@ REGISTERING_MODULES = [
     "karpenter_tpu.metrics.pressure",
     "karpenter_tpu.metrics.filter",
     "karpenter_tpu.metrics.gang",
+    "karpenter_tpu.metrics.global_solve",
     "karpenter_tpu.metrics.marshal",
     "karpenter_tpu.metrics.policy",
     "karpenter_tpu.metrics.slo",
