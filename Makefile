@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-filter bench-policy bench-global bench-replay bench-replay-smoke bench-history bench-regress replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-overload clean help
+.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-filter bench-policy bench-global bench-replay bench-replay-smoke bench-history bench-regress replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-crash chaos-overload clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -106,6 +106,9 @@ soak: ## Extended differential soak: 500 fuzz cases + repeated chaos/races
 
 chaos-soak: ## Seeded fault-injection soak (slow); prints seed, replay via KARPENTER_CHAOS_SEED=<n>
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -s -m slow
+
+chaos-crash: ## Crash-restart soak: every journal kill point x seeds {1,7,42} (slow)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_crash_recovery.py -q -s -m slow
 
 chaos-overload: ## Brownout soak: 50k-pod flood + pressure faults (slow) after the fast seeded smoke
 	JAX_PLATFORMS=cpu python -m pytest \

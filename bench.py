@@ -1706,6 +1706,202 @@ def config_14_global_window():
     }
 
 
+def config_15_crash_recovery():
+    """Crash-consistency gate (docs/robustness.md §5). Three legs:
+
+    - journal tax: a journaled (fsync ON) replay leg — the bench-replay
+      shape scaled down — with the tax read from the journal's own
+      append histogram delta against the leg's wall (acceptance: <= 1%,
+      the crash_recovery_clean ratchet in tools/bench_regress.py).
+      A bare vs journaled ProvisionerWorker micro A/B (after an untimed
+      prewarm) prices the raw per-append fsync alongside; at micro
+      scale the fsync dominates the toy bind loop, so the micro numbers
+      are reported for attribution, not gated.
+    - recovery wall: a journal seeded with open fleet-launch intents
+      over genuinely leaked fake-provider capacity, replayed by
+      RecoveryController from a cold open, repeated for p50/p99 —
+      the window the readyz gate holds 503 ``recovering``.
+    - leak gate: after every replay the provider ledger must be empty
+      and the journal must hold zero open intents (``leaks`` /
+      ``open_intents_after`` feed the bench-regress ratchet)."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from karpenter_tpu.api import wellknown
+    from karpenter_tpu.api.constraints import Constraints
+    from karpenter_tpu.api.core import NodeSelectorRequirement as Req
+    from karpenter_tpu.api.requirements import Requirements
+    from karpenter_tpu.cloudprovider.fake.provider import (
+        FakeCloudProvider, instance_types,
+    )
+    from karpenter_tpu.controllers.provisioning import (
+        ProvisionerWorker, global_requirements,
+    )
+    from karpenter_tpu.controllers.recovery import RecoveryController
+    from karpenter_tpu.metrics.recovery import JOURNAL_APPEND_SECONDS
+    from karpenter_tpu.metrics.registry import HISTOGRAMS
+    from karpenter_tpu.runtime import journal as jr
+    from karpenter_tpu.runtime.journal import IntentJournal
+    from karpenter_tpu.runtime.kubecore import KubeCore
+    from karpenter_tpu.scheduling.batcher import Batcher
+    from tests.expectations import make_provisioner, unschedulable_pod
+
+    def _hsum(hist):
+        collected = hist.collect()
+        return (sum(s for _, s, _ in collected.values()),
+                sum(t for _, _, t in collected.values()))
+
+    def _constraints():
+        return Constraints(
+            labels={wellknown.PROVISIONER_NAME_LABEL: "crash-bench"},
+            requirements=Requirements([
+                Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In",
+                    values=["test-zone-1"]),
+                Req(key=wellknown.LABEL_CAPACITY_TYPE, operator="In",
+                    values=["on-demand"]),
+            ]))
+
+    def _bind_leg(n_pods, journal):
+        kube = KubeCore()
+        provider = FakeCloudProvider(catalog=instance_types(4))
+        cons = _constraints()
+        prov = make_provisioner(name="crash-bench", constraints=cons)
+        prov.spec.constraints.requirements = (
+            prov.spec.constraints.requirements.add(
+                *global_requirements(provider.get_instance_types(cons)).items))
+        kube.create(prov)
+        worker = ProvisionerWorker(
+            prov, kube, provider,
+            batcher=Batcher(idle_seconds=0.01, max_seconds=0.1),
+            journal=journal)
+        pods = []
+        for i in range(n_pods):
+            p = unschedulable_pod(requests={"cpu": "500m", "memory": "256Mi"},
+                                  name=f"crash-bench-pod-{i}")
+            kube.create(p)
+            pods.append(p)
+        bind0 = _hsum(HISTOGRAMS.histogram("bind_duration_seconds"))
+        tax0 = _hsum(JOURNAL_APPEND_SECONDS)
+        t0 = _time.perf_counter()
+        for _ in range(25):
+            unbound = [p for p in pods
+                       if not kube.get("Pod", p.metadata.name).spec.node_name]
+            if not unbound:
+                break
+            for p in unbound:
+                worker.add(p, key=(p.metadata.namespace, p.metadata.name))
+            worker.provision()
+        wall = _time.perf_counter() - t0
+        bind1 = _hsum(HISTOGRAMS.histogram("bind_duration_seconds"))
+        tax1 = _hsum(JOURNAL_APPEND_SECONDS)
+        bound = sum(1 for p in pods
+                    if kube.get("Pod", p.metadata.name).spec.node_name)
+        return {
+            "wall_s": round(wall, 4),
+            "bound": bound,
+            "bind_s": round(bind1[0] - bind0[0], 6),
+            "journal_tax_s": round(tax1[0] - tax0[0], 6),
+            "journal_appends": tax1[1] - tax0[1],
+        }
+
+    n_pods = 400
+    _bind_leg(64, journal=None)   # untimed prewarm: jit + import caches
+    bare = _bind_leg(n_pods, journal=None)
+    jdir = tempfile.mkdtemp(prefix="bench-journal-")
+    try:
+        with IntentJournal(jdir, fsync=True) as journal:
+            journaled = _bind_leg(n_pods, journal=journal)
+    finally:
+        shutil.rmtree(jdir, ignore_errors=True)
+
+    # the gated number: the journal's share of a replay-shaped run
+    # (the bench-replay bind path scaled down; chaos off for stability)
+    from karpenter_tpu.replay import ReplayConfig, run_replay
+
+    jdir = tempfile.mkdtemp(prefix="bench-journal-replay-")
+    try:
+        tax0 = _hsum(JOURNAL_APPEND_SECONDS)
+        replay = run_replay(ReplayConfig(
+            pods_total=4_000, shards=1, tenants=1, seed=7,
+            bound_cohort=400, churn_pods=0, max_depth=2_000, ticks=8,
+            tick_sleep_s=0.6, burst_ticks=1, chaos=False, settle_s=60.0,
+            flood_pool=96, journal_dir=jdir, journal_fsync=True))
+        tax1 = _hsum(JOURNAL_APPEND_SECONDS)
+    finally:
+        shutil.rmtree(jdir, ignore_errors=True)
+    replay_tax_s = tax1[0] - tax0[0]
+    overhead_pct = (round(replay_tax_s / replay["wall_s"] * 100.0, 4)
+                    if replay["wall_s"] else None)
+
+    leaks_per_iter, noop_per_iter, iters = 48, 24, 16
+    walls, leaks_after, opens_after, errors = [], 0, 0, 0
+    rolled_back = 0
+    for _ in range(iters):
+        kube = KubeCore()
+        provider = FakeCloudProvider(catalog=instance_types(4))
+        cons = _constraints()
+        itype = provider.catalog[-1]
+        d = tempfile.mkdtemp(prefix="bench-recovery-")
+        try:
+            journal = IntentJournal(d, fsync=False)
+            for _k in range(leaks_per_iter):
+                nonce = jr.new_nonce()
+                journal.open_intent("fleet-launch", nonce=nonce,
+                                    provisioner="crash-bench")
+                # bind dies before the Node write: the ledger entry is a
+                # real leak attributable only through the journaled nonce
+                with jr.preassigned_nonce(nonce):
+                    provider.create(cons, [itype], 1,
+                                    lambda node: "simulated crash")
+            for _k in range(noop_per_iter):
+                journal.open_intent("fleet-launch", nonce=jr.new_nonce(),
+                                    provisioner="crash-bench")
+            journal.close_journal()
+            with IntentJournal(d, fsync=False) as journal:
+                recovery = RecoveryController(kube, provider, journal)
+                t0 = _time.perf_counter()
+                stats = recovery.run()
+                walls.append(_time.perf_counter() - t0)
+                errors += stats["errors"]
+                rolled_back += stats["rollback"]
+                leaks_after += len(provider.list_instances())
+                opens_after += len(journal.open_intents())
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    return {
+        "bind_leg_pods": n_pods,
+        "bare": bare,
+        "journaled": journaled,
+        "bound_equal": bare["bound"] == journaled["bound"] == n_pods,
+        "journal_tax": {
+            "overhead_pct": overhead_pct,
+            "replay_tax_s": round(replay_tax_s, 6),
+            "replay_appends": tax1[1] - tax0[1],
+            "replay_wall_s": replay["wall_s"],
+            "replay_bound": replay["bound"],
+            "replay_completed": replay["completed"],
+            "micro_appends": journaled["journal_appends"],
+            "micro_tax_s": journaled["journal_tax_s"],
+            "micro_bind_s": journaled["bind_s"],
+            "us_per_append": (round(journaled["journal_tax_s"] * 1e6
+                                    / journaled["journal_appends"], 2)
+                              if journaled["journal_appends"] else None),
+        },
+        "recovery": {
+            "iters": iters,
+            "open_intents_per_iter": leaks_per_iter + noop_per_iter,
+            "leaked_instances_per_iter": leaks_per_iter,
+            "wall_ms": _stats(walls),
+            "rolled_back": rolled_back,
+            "errors": errors,
+        },
+        "leaks": leaks_after,
+        "open_intents_after": opens_after,
+    }
+
+
 def jax_devices_first():
     import jax
 
@@ -2121,6 +2317,7 @@ def run_all(degraded: bool, probe_note: str = ""):
         ("config_12_device_filter", config_12_device_filter),
         ("config_13_policy_scoring", config_13_policy_scoring),
         ("config_14_global_window", config_14_global_window),
+        ("config_15_crash_recovery", config_15_crash_recovery),
     ):
         if not _selected(key, only):
             continue

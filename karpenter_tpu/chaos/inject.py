@@ -56,6 +56,15 @@ pressure   rss            ``memory-pressure`` (the RSS sample is
                           inflated by 87% of the watermark —
                           deterministically lands in the L2 band
                           without allocating memory)
+journal    <transition>   ``crash-point`` (deterministic simulated
+                          process death at a named write-ahead-journal
+                          transition — ``pre:<kind>:<phase>`` fires
+                          before the record is durable,
+                          ``<kind>:<phase>`` after; raises
+                          :class:`SimulatedCrash`, which derives from
+                          BaseException so no ``except Exception``
+                          recovery path can accidentally survive it —
+                          see runtime/journal.py KILL_POINTS)
 ========== ============== ==========================================
 
 The ``pressure`` boundary is consumed by
@@ -206,6 +215,29 @@ def active_fault(boundary: str, op: str) -> Optional[str]:
     if plan is None:
         return None
     return plan.decide(boundary, op)
+
+
+class SimulatedCrash(BaseException):
+    """Deterministic simulated process death at a journal kill point.
+
+    Derives from BaseException — NOT Exception — so the control plane's
+    broad ``except Exception`` error-handling (launch error aggregation,
+    reconcile loops, unwind paths) cannot accidentally survive it: like
+    a real SIGKILL, nothing between the kill point and the soak harness
+    gets to clean up.
+    """
+
+    def __init__(self, point: str):
+        super().__init__(f"simulated crash at journal kill point {point!r}")
+        self.point = point
+
+
+def crash_point(name: str) -> None:
+    """Named kill point on the ``journal`` boundary; the write-ahead
+    journal fires one per transition edge (see runtime/journal.py
+    KILL_POINTS). With no plan installed this is one global read."""
+    if active_fault("journal", name) == "crash-point":
+        raise SimulatedCrash(name)
 
 
 # ---------------------------------------------------------------------------

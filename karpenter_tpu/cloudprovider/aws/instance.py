@@ -130,9 +130,14 @@ class InstanceProvider:
             constraints, provider, instance_types, capacity_type)
         # the nonce tag rides the CreateFleet TagSpecification, so it is on
         # the instances from birth — a crash anywhere after this call
-        # leaves capacity that list_instances() can enumerate and attribute
+        # leaves capacity that list_instances() can enumerate and attribute.
+        # A journaled launch pre-stamps the nonce (runtime/journal.py) so
+        # the write-ahead record and the cloud tags agree across a restart.
         import uuid
 
+        from karpenter_tpu.runtime import journal
+
+        nonce = journal.current_preassigned_nonce() or uuid.uuid4().hex
         request = sdk.CreateFleetRequest(
             launch_template_configs=configs,
             total_target_capacity=quantity,
@@ -143,7 +148,7 @@ class InstanceProvider:
             tags=merge_tags(
                 provisioner_name, provider.tags,
                 {f"kubernetes.io/cluster/{self.cluster_name}": "owned",
-                 wellknown.LAUNCH_NONCE_TAG: uuid.uuid4().hex}),
+                 wellknown.LAUNCH_NONCE_TAG: nonce}),
         )
         self.fleet_limiter.acquire()
         response = self.ec2api.create_fleet(request)

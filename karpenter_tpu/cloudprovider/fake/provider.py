@@ -21,6 +21,7 @@ from karpenter_tpu.cloudprovider import spi
 from karpenter_tpu.cloudprovider.spi import (
     CapacityRecord, CloudProvider, InstanceType, Offering,
 )
+from karpenter_tpu.runtime import journal
 from karpenter_tpu.utils import clock
 from karpenter_tpu.utils.resources import Quantity, parse_resource_list
 
@@ -119,6 +120,11 @@ class FakeCloudProvider(CloudProvider):
         errs: List[Optional[str]] = []
         provisioner_name = constraints.labels.get(
             wellknown.PROVISIONER_NAME_LABEL, "default")
+        # one nonce per create call, shared by every unit it launches —
+        # the same semantics as the AWS path's per-CreateFleet launch-nonce
+        # tag. When the caller journaled the launch, its pre-stamped nonce
+        # is used so crashed launches stay attributable across restart.
+        launch_nonce = journal.current_preassigned_nonce() or uuid.uuid4().hex
         for _ in range(quantity):
             n = next(_name_counter)
             name = f"fake-node-{n}"
@@ -151,7 +157,7 @@ class FakeCloudProvider(CloudProvider):
                 self._capacity[name] = CapacityRecord(
                     instance_id=name,
                     provisioner_name=provisioner_name,
-                    launch_nonce=uuid.uuid4().hex,
+                    launch_nonce=launch_nonce,
                     created_unix=clock.now(),
                     zone=zone,
                     instance_type=instance.name,

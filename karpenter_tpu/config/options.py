@@ -99,6 +99,12 @@ class Options:
     trace_jax: bool = False
     # flight recorder dump directory ("" keeps the ring in memory only)
     flight_dir: str = ""
+    # write-ahead intent journal directory (runtime/journal.py,
+    # docs/robustness.md §5); "" disables journaling AND startup recovery
+    journal_dir: str = ""
+    # fsync every journal append (crash-safe); disable only for benches
+    # where the journal's durability is not under test
+    journal_fsync: bool = True
     # per-pod SLO engine (obs/slo.py, docs/observability.md §7): mergeable
     # latency digests per (band × stage) + burn-rate sentinel; ~µs/pod
     # enabled, a no-op branch disabled
@@ -343,6 +349,17 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                    help="flight recorder dump directory for watchdog/"
                         "breaker/pressure-L3/chaos trips (empty = in-memory "
                         "ring only)")
+    p.add_argument("--journal-dir",
+                   default=_env("journal-dir", defaults.journal_dir),
+                   help="write-ahead intent journal directory; every multi-"
+                        "step mutation (launch/bind/gang/drain/delete) is "
+                        "journaled there and replayed by startup recovery "
+                        "(empty disables journaling and recovery)")
+    p.add_argument("--journal-fsync", action=argparse.BooleanOptionalAction,
+                   default=_env("journal-fsync", defaults.journal_fsync),
+                   help="fsync every journal append (crash durability); "
+                        "--no-journal-fsync trades that for speed in "
+                        "benches")
     p.add_argument("--slo-enabled", action=argparse.BooleanOptionalAction,
                    default=_env("slo-enabled", defaults.slo_enabled),
                    help="per-pod SLO engine (obs/slo.py): latency digests "

@@ -142,9 +142,11 @@ class ConsolidationController:
                  window_size: int = 512,
                  whatif_config: Optional[WhatIfConfig] = None,
                  cost_config: CostConfig = CostConfig(),
-                 repack_cost_per_hour: float = 0.0):
+                 repack_cost_per_hour: float = 0.0,
+                 journal=None):
         self.kube = kube
         self.provider = provider
+        self.journal = journal
         self.max_actions_per_pass = max_actions_per_pass
         self.window_size = window_size
         self.whatif_config = whatif_config or WhatIfConfig()
@@ -264,11 +266,30 @@ class ConsolidationController:
                      "capacity; reclaims $%.4f/h) window_id=%s",
                      node.metadata.name,
                      len(enc.cand_pods[action.cand]), action.saving, wid)
-            try:
-                self.kube.delete("Node", node.metadata.name,
-                                 node.metadata.namespace)
-            except NotFound:
-                continue
-            CONSOLIDATION_DRAINS_TOTAL.inc()
-            CONSOLIDATION_RECLAIMED_TOTAL.inc(action.saving)
+            self._drain_node(node, action.saving)
         return self.REQUEUE_SECONDS
+
+    def _drain_node(self, node: Node, saving: float) -> bool:
+        """Execute one planned drain, journaled as a ``drain`` intent
+        (open → deleting → closed) so a crash between the decision and
+        the delete is re-driven by restart recovery instead of silently
+        keeping the node."""
+        journal = self.journal
+        iid = None
+        if journal is not None:
+            iid = journal.open_intent(
+                "drain", node=node.metadata.name,
+                namespace=node.metadata.namespace, saving=saving)
+        try:
+            self.kube.delete("Node", node.metadata.name,
+                             node.metadata.namespace)
+        except NotFound:
+            if iid is not None:
+                journal.close(iid, outcome="gone")
+            return False
+        if iid is not None:
+            journal.advance(iid, "deleting")
+            journal.close(iid)
+        CONSOLIDATION_DRAINS_TOTAL.inc()
+        CONSOLIDATION_RECLAIMED_TOTAL.inc(saving)
+        return True

@@ -69,11 +69,17 @@ class GarbageCollection:
         cloud_provider: CloudProvider,
         interval_seconds: float = DEFAULT_INTERVAL_SECONDS,
         grace_seconds: float = DEFAULT_GRACE_SECONDS,
+        journal=None,
     ):
         self.kube = kube
         self.cloud_provider = cloud_provider
         self.interval_seconds = interval_seconds
         self.grace_seconds = grace_seconds
+        # ownership handoff with restart recovery: capacity whose launch
+        # nonce is covered by an open journaled fleet-launch intent belongs
+        # to recovery (which rolls it forward or terminates it exactly
+        # once); GC must never race it — see controllers/recovery.py
+        self.journal = journal
 
     # -- manager wiring ------------------------------------------------------
     def kind(self) -> Optional[str]:
@@ -110,12 +116,22 @@ class GarbageCollection:
             backed |= segments
 
         # direction 1: instances with no Node → terminate after grace
+        covered = (self.journal.covered_nonces()
+                   if self.journal is not None else frozenset())
         live_ids = set()
         for record in records:
             if not record.instance_id:
                 continue  # malformed: never act on an empty id
             live_ids.add(record.instance_id)
             if record.instance_id in backed:
+                continue
+            if record.launch_nonce and record.launch_nonce in covered:
+                # journal-owned: an open fleet-launch intent covers this
+                # nonce, so recovery is (or will be) resolving it — acting
+                # here would double-terminate or kill a roll-forward
+                log.debug("instance %s owned by open journal intent "
+                          "(nonce=%s); skipping", record.instance_id,
+                          record.launch_nonce)
                 continue
             if record.created_unix <= 0.0:
                 # unknown launch time: fail-safe — age cannot be proven

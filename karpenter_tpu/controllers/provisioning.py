@@ -55,6 +55,7 @@ from karpenter_tpu.metrics.pressure import WINDOW_SPLITS_TOTAL
 from karpenter_tpu.metrics.registry import HISTOGRAMS
 from karpenter_tpu.obs import slo
 from karpenter_tpu.obs import trace as obtrace
+from karpenter_tpu.runtime import journal as jr
 from karpenter_tpu.runtime.kubecore import (
     AlreadyExists, ApiError, KubeCore, NotFound,
 )
@@ -169,9 +170,11 @@ class ProvisionerWorker:
         batcher: Optional[Batcher] = None,
         pipeline_config: Optional[PipelineConfig] = None,
         shard: str = "",
+        journal: Optional["jr.IntentJournal"] = None,
     ):
         self.kube = kube
         self.cloud_provider = cloud_provider
+        self.journal = journal
         self.solver_config = solver_config or SolverConfig()
         self.gang_config = GangConfig()
         self.batcher = batcher or Batcher()
@@ -646,21 +649,51 @@ class ProvisionerWorker:
         if err is not None:
             return err
         enc = prep.gang_enc
+        journal = self.journal
+        iid = None
+        if journal is not None:
+            # member set + created-node set are journaled as they grow,
+            # so a crash at ANY instant — mid phase 1, mid bind, mid
+            # unwind — leaves the exact rollback list on disk
+            iid = journal.open_intent(
+                "gang-bind", gang=str(placement.gang.key),
+                members=[f"{p.metadata.namespace}/{p.metadata.name}"
+                         for p in placement.gang.pods])
         # phase 1: every node object exists before any member binds
         created: List[str] = []
+        nonces: List[str] = []
         node_of: Dict[int, str] = {}
         for bin_index, _pods in placement.node_sets:
             name = prep.gang_nodes.get(bin_index)
             if name is None:
                 _, itype = prep.gang_types[enc.bins[bin_index].type_index]
-                name = self._create_gang_node(constraints, itype)
+                if iid is not None:
+                    # each gang node's launch nonce is durable BEFORE the
+                    # provider create: a crash between the instance launch
+                    # and the Node write (or the created-set note below)
+                    # leaves capacity recovery attributes by nonce rather
+                    # than an uncovered leak
+                    nonce = jr.new_nonce()
+                    nonces.append(nonce)
+                    journal.note(iid, nonces=list(nonces))
+                    with jr.preassigned_nonce(nonce):
+                        name = self._create_gang_node(constraints, itype)
+                else:
+                    name = self._create_gang_node(constraints, itype)
                 if name is None:
-                    self._unwind_gang(prep, placement, node_of, created)
+                    self._unwind_gang_journaled(iid, prep, placement,
+                                                node_of, created)
                     return (f"could not launch node for bin "
                             f"{enc.bins[bin_index].name}")
                 prep.gang_nodes[bin_index] = name
                 created.append(name)
+                if iid is not None:
+                    journal.note(iid, created=list(created))
             node_of[bin_index] = name
+        if iid is not None:
+            journal.advance(iid, "nodes-created",
+                            nodes=sorted(set(node_of.values())),
+                            created=list(created))
         # phase 2: bind members node-set by node-set
         for bin_index, pods in placement.node_sets:
             name = node_of[bin_index]
@@ -671,13 +704,34 @@ class ProvisionerWorker:
             errs = [e for e in errs
                     if "already bound" not in e and "already exists" not in e]
             if errs:
-                self._unwind_gang(prep, placement, node_of, created)
+                self._unwind_gang_journaled(iid, prep, placement,
+                                            node_of, created)
                 return f"binding to {name}: " + "; ".join(errs)
+        if iid is not None:
+            journal.advance(iid, "bound")
+            journal.close(iid)
         log.info("gang %s bound: %d pod(s) across %d node(s) window_id=%s "
                  "shard=%s", placement.gang.key, len(placement.gang.pods),
                  len(placement.node_sets), self._window_id,
                  self.shard or "0")
         return None
+
+    def _unwind_gang_journaled(self, iid: Optional[str], prep: _ChunkPrep,
+                               placement: GangPlacement,
+                               node_of: Dict[int, str],
+                               created: List[str]) -> None:
+        """Journal-bracketed unwind: ``unwinding`` is durable before the
+        first rollback write and ``unwound`` after the last, so recovery
+        can resume (phase unwinding) or skip (unwound) a crashed one."""
+        journal = self.journal
+        if journal is not None and iid is not None:
+            journal.advance(iid, "unwinding",
+                            nodes=sorted(set(node_of.values())),
+                            created=list(created))
+        self._unwind_gang(prep, placement, node_of, created)
+        if journal is not None and iid is not None:
+            journal.advance(iid, "unwound")
+            journal.close(iid, outcome="unwound")
 
     def _create_gang_node(self, constraints: Constraints,
                           itype) -> Optional[str]:
@@ -809,9 +863,29 @@ class ProvisionerWorker:
             node.spec.taints.extend(constraints.taints)
             return self._bind(node, pods_per_node.pop(0) if pods_per_node else [])
 
-        errs = self.cloud_provider.create(
-            constraints, packing.instance_type_options, packing.node_quantity, bind)
+        journal = self.journal
+        if journal is None:
+            errs = self.cloud_provider.create(
+                constraints, packing.instance_type_options,
+                packing.node_quantity, bind)
+            errs = [e for e in errs if e]
+            return "; ".join(errs) if errs else None
+        # journaled fleet launch: the launch nonce is drawn and durable
+        # BEFORE the provider create, and pre-stamped onto the capacity it
+        # launches — a crash anywhere inside leaves instances that restart
+        # recovery attributes by nonce instead of waiting out GC's grace
+        nonce = jr.new_nonce()
+        iid = journal.open_intent(
+            "fleet-launch", nonce=nonce,
+            provisioner=provisioner.metadata.name,
+            quantity=int(packing.node_quantity))
+        with jr.preassigned_nonce(nonce):
+            errs = self.cloud_provider.create(
+                constraints, packing.instance_type_options,
+                packing.node_quantity, bind)
+        journal.advance(iid, "launched")
         errs = [e for e in errs if e]
+        journal.close(iid, outcome="error" if errs else "done")
         return "; ".join(errs) if errs else None
 
     def _bind(self, node: Node, pods: List[Pod]) -> Optional[str]:
@@ -839,6 +913,14 @@ class ProvisionerWorker:
             # prevent the kube scheduler racing our binds (provisioner.go:164-176)
             node.spec.taints.append(Taint(key=wellknown.NOT_READY_TAINT_KEY,
                                           effect="NoSchedule"))
+            journal = self.journal
+            iid = None
+            if journal is not None:
+                iid = journal.open_intent(
+                    "bind", node=node.metadata.name,
+                    provider_id=node.spec.provider_id,
+                    pods=[f"{p.metadata.namespace}/{p.metadata.name}"
+                          for p in pods])
             try:
                 self.kube.create(node)
             except AlreadyExists:
@@ -847,7 +929,11 @@ class ProvisionerWorker:
                 # no Node object: the pods stay pending and re-enter the
                 # next batch; the launched capacity (if any) is the GC
                 # controller's problem, not silently orphaned state
+                if iid is not None:
+                    journal.close(iid, outcome="error")
                 return f"creating node object {node.metadata.name}: {e}"
+            if iid is not None:
+                journal.advance(iid, "node-created")
             # one locked pass for the node's whole pod set (provisioner.go
             # binds sequentially; per-pod lock round-trips dominated the
             # 10k-pod flood on a contended host)
@@ -871,8 +957,13 @@ class ProvisionerWorker:
             # error log, and the unbound pods remain provisionable so the
             # selection requeue / next batch retries them
             if errs:
+                if iid is not None:
+                    journal.close(iid, outcome="error")
                 return (f"binding {len(errs)} pod(s) to "
                         f"{node.metadata.name}: " + "; ".join(errs))
+            if iid is not None:
+                journal.advance(iid, "bound")
+                journal.close(iid)
             return None
 
 
@@ -889,9 +980,11 @@ class ProvisioningController:
                  solver_config: Optional[SolverConfig] = None,
                  batcher_factory: Optional[Callable[[], Batcher]] = None,
                  pipeline_config: Optional[PipelineConfig] = None,
-                 shards: int = 0):
+                 shards: int = 0,
+                 journal: Optional["jr.IntentJournal"] = None):
         self.kube = kube
         self.cloud_provider = cloud_provider
+        self.journal = journal
         self.solver_config = solver_config
         self.pipeline_config = pipeline_config
         self.batcher_factory = batcher_factory or Batcher
@@ -931,7 +1024,8 @@ class ProvisioningController:
                 solver_config=self.solver_config,
                 batcher=self.batcher_factory(),
                 pipeline_config=self.pipeline_config,
-                shard=str(sid))
+                shard=str(sid),
+                journal=self.journal)
             worker.start()
             self.workers[wname] = worker
         return worker
@@ -978,7 +1072,8 @@ class ProvisioningController:
                         provisioner, self.kube, self.cloud_provider,
                         solver_config=self.solver_config,
                         batcher=self.batcher_factory(),
-                        pipeline_config=self.pipeline_config)
+                        pipeline_config=self.pipeline_config,
+                        journal=self.journal)
                     worker.start()
                     self.workers[name] = worker
                 self._hashes[name] = key

@@ -127,9 +127,11 @@ class Terminator:
     """terminate.go."""
 
     def __init__(self, kube: KubeCore, cloud_provider: CloudProvider,
-                 eviction_queue: Optional[EvictionQueue] = None):
+                 eviction_queue: Optional[EvictionQueue] = None,
+                 journal=None):
         self.kube = kube
         self.cloud_provider = cloud_provider
+        self.journal = journal
         self.eviction_queue = eviction_queue or EvictionQueue(kube)
 
     def cordon(self, node: Node) -> None:
@@ -155,10 +157,23 @@ class Terminator:
         return False
 
     def terminate(self, node: Node) -> None:
-        """CloudProvider.Delete then strip the finalizer (terminate.go)."""
+        """CloudProvider.Delete then strip the finalizer (terminate.go).
+        Journaled as a ``node-delete`` intent: a crash between the
+        instance delete and the finalizer strip leaves a Node object whose
+        instance is gone — recovery re-drives exactly this method."""
+        journal = self.journal
+        iid = None
+        if journal is not None:
+            iid = journal.open_intent(
+                "node-delete", node=node.metadata.name,
+                provider_id=node.spec.provider_id)
         err = self.cloud_provider.delete(node)
         if err is not None:
+            if iid is not None:
+                journal.close(iid, outcome="error")
             raise RuntimeError(f"terminating cloudprovider instance: {err}")
+        if iid is not None:
+            journal.advance(iid, "instance-deleted")
         def apply(live: Node):
             live.metadata.finalizers = [
                 f for f in live.metadata.finalizers
@@ -166,7 +181,11 @@ class Terminator:
         try:
             self.kube.patch("Node", node.metadata.name, node.metadata.namespace, apply)
         except NotFound:
+            if iid is not None:
+                journal.close(iid)
             return
+        if iid is not None:
+            journal.close(iid)
         log.info("deleted node %s", node.metadata.name)
 
     def _get_evictable_pods(self, pods: List[Pod]) -> List[Pod]:
@@ -197,9 +216,10 @@ class Terminator:
 class TerminationController:
     """controller.go:62-98."""
 
-    def __init__(self, kube: KubeCore, cloud_provider: CloudProvider):
+    def __init__(self, kube: KubeCore, cloud_provider: CloudProvider,
+                 journal=None):
         self.kube = kube
-        self.terminator = Terminator(kube, cloud_provider)
+        self.terminator = Terminator(kube, cloud_provider, journal=journal)
 
     def kind(self) -> str:
         return "Node"

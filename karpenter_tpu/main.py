@@ -91,8 +91,23 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
     if options.solver_warmup:
         solver_warmup.start_warmup(solver_config,
                                    include_ring=options.solver_donate)
+    # crash consistency (docs/robustness.md §5): the write-ahead intent
+    # journal + startup recovery are built before any controller so every
+    # multi-step mutation is journaled from the first window; main() runs
+    # recovery.run() before manager.start() and readyz answers 503
+    # "recovering" until the replay completes
+    journal = None
+    recovery = None
+    if options.journal_dir:
+        from karpenter_tpu.controllers.recovery import RecoveryController
+        from karpenter_tpu.runtime.journal import IntentJournal
+
+        journal = IntentJournal(options.journal_dir,
+                                fsync=options.journal_fsync)
+        recovery = RecoveryController(kube, cloud_provider, journal)
     provisioning = ProvisioningController(
         kube, cloud_provider,
+        journal=journal,
         solver_config=solver_config,
         pipeline_config=PipelineConfig(
             depth=options.pipeline_depth,
@@ -115,16 +130,19 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
     manager.register(SelectionController(kube, provisioning),
                      workers=adaptive_workers(64))
     manager.register(NodeController(kube), workers=adaptive_workers(10))
-    manager.register(TerminationController(kube, cloud_provider),
+    manager.register(TerminationController(kube, cloud_provider,
+                                           journal=journal),
                      workers=adaptive_workers(10))
     manager.register(CounterController(kube))
     if options.gc_interval_seconds > 0:
         manager.register(GarbageCollection(
             kube, cloud_provider,
             interval_seconds=options.gc_interval_seconds,
-            grace_seconds=options.gc_grace_seconds))
+            grace_seconds=options.gc_grace_seconds,
+            journal=journal))
     manager.register(ConsolidationController(
         kube, provider=cloud_provider,
+        journal=journal,
         # spot keep-cost premium (models/consolidate.fleet_prices): only the
         # interruption-priced policy charges reclaim risk into the ranking
         repack_cost_per_hour=(
@@ -137,6 +155,10 @@ def build_manager(kube: KubeCore, options: Options) -> Manager:
     # watch the controller's own namespace (POD_NAMESPACE / --namespace), not
     # a hardcoded one — the deployed map lives in "karpenter"
     manager.register(LoggingConfigController(kube, namespace=options.namespace))
+    # attached (not positional) so build_manager's signature stays stable
+    # for every existing caller; main() getattr's them back
+    manager.journal = journal
+    manager.recovery = recovery
     return manager
 
 
@@ -165,6 +187,7 @@ def debug_vars() -> dict:
 
 class _Handler(BaseHTTPRequestHandler):
     manager: Optional[Manager] = None
+    recovery = None  # RecoveryController when --journal-dir is set
 
     def do_GET(self):
         if self.path == "/metrics":
@@ -182,6 +205,12 @@ class _Handler(BaseHTTPRequestHandler):
             level = int(pressure.get_monitor().level())
             suffix = ""
             if self.path == "/readyz":
+                if self.recovery is not None and self.recovery.recovering():
+                    # journal replay in progress: open intents from the
+                    # previous process are still being rolled forward or
+                    # back — serving windows now could double-act on them
+                    ok = False
+                    suffix = " recovering"
                 if level >= 3:
                     # L3 = system-critical only: stop advertising readiness
                     # so load balancers drain non-critical traffic off this
@@ -196,7 +225,7 @@ class _Handler(BaseHTTPRequestHandler):
                     # the replica is falling behind its latency objectives
                     # even if the pressure ladder hasn't caught up yet
                     ok = False
-                    suffix = f" slo-burn={','.join(burning)}"
+                    suffix += f" slo-burn={','.join(burning)}"
             body = (f"{'ok' if ok else 'unhealthy'} "
                     f"level=L{level}{suffix}").encode()
             self.send_response(200 if ok else 503)
@@ -213,7 +242,9 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def serve_observability(manager: Manager, port: int) -> ThreadingHTTPServer:
-    handler = type("Handler", (_Handler,), {"manager": manager})
+    handler = type("Handler", (_Handler,),
+                   {"manager": manager,
+                    "recovery": getattr(manager, "recovery", None)})
     server = ThreadingHTTPServer(("0.0.0.0", port), handler)
     threading.Thread(target=server.serve_forever, daemon=True,
                      name="observability").start()
@@ -303,6 +334,13 @@ def main(argv=None) -> int:
         elector.wait_for_leadership(interrupt=stopping)
     try:
         if not stopping.is_set():
+            # replay the intent journal BEFORE any controller runs: open
+            # intents from a crashed predecessor are rolled forward or
+            # back against live state while readyz answers 503 recovering
+            recovery = getattr(manager, "recovery", None)
+            if recovery is not None:
+                stats = recovery.run()
+                log.info("journal recovery: %s", stats)
             manager.start()
             log.info("karpenter-tpu started (cluster=%s, metrics=:%d)",
                      options.cluster_name, options.metrics_port)

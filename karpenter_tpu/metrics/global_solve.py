@@ -13,6 +13,9 @@ registry.expose()):
   ``unpriced``, ``unencodable``, ``no-support``, ``infeasible``,
   ``costlier``, ``unverified``, ``error``, ``window-cap``): the
   zero-unverified-placements contract made visible
+- ``karpenter_global_widened_accept_total`` counter — no-support
+  schedules recovered by the single widened-support rounding retry
+  (accepted through the same exact cheaper/verify gates)
 - ``karpenter_global_iterations``      gauge — projected-gradient
   iterations configured for the last dispatched window
 - ``karpenter_global_solve_seconds``   histogram — dispatch+fetch wall
@@ -36,6 +39,10 @@ GLOBAL_USED_TOTAL = DEFAULT.counter(
 GLOBAL_FALLBACK_TOTAL = DEFAULT.counter(
     "global_fallback_total",
     "Schedules that kept the FFD backend's plan bit-for-bit, by reason")
+
+GLOBAL_WIDENED_ACCEPT_TOTAL = DEFAULT.counter(
+    "global_widened_accept_total",
+    "No-support schedules recovered by the widened-support rounding retry")
 
 GLOBAL_ITERATIONS = DEFAULT.gauge(
     "global_iterations",

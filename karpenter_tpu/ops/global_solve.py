@@ -302,3 +302,19 @@ def support_positions(n_row: np.ndarray, num_types: int) -> List[int]:
         return []
     return [t for t in range(num_types)
             if n[t] >= max(0.4, 0.02 * float(n.max()))]
+
+
+def widened_support_positions(n_row: np.ndarray,
+                              num_types: int) -> List[int]:
+    """The no-support retry's relaxed keep rule: small schedules often
+    optimize to fractional node counts everywhere (every n_t < 0.4), so
+    the strict rule returns empty and the window declines. Widening keeps
+    any type with a non-trivial share of the mass — the exact rounding,
+    strictly-cheaper and re-verify gates downstream still hold, so a
+    widened accept is as sound as a strict one; it is merely attempted
+    second."""
+    n = np.asarray(n_row[:num_types], dtype=np.float64)
+    if n.size == 0 or not np.all(np.isfinite(n)) or float(n.max()) <= 0.0:
+        return []
+    return [t for t in range(num_types)
+            if n[t] >= max(0.05, 0.005 * float(n.max()))]

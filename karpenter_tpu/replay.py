@@ -111,6 +111,12 @@ class ReplayConfig:
     # report digest-vs-exact quantile parity (smoke runs only — at the
     # million-pod shape the whole point is NOT materializing the lists)
     slo_exact_check: bool = False
+    # write-ahead intent journal directory ("" = journaling off, the
+    # historical behavior). The bench uses a journaled leg vs a bare leg
+    # to price the bind-path journal overhead (acceptance: <= 1%); the
+    # journal's stats land in the report under ``journal``.
+    journal_dir: str = ""
+    journal_fsync: bool = True
 
     def validate(self) -> None:
         if self.shards < 1:
@@ -280,8 +286,14 @@ def run_replay(cfg: ReplayConfig) -> dict:
     kube = inject.ChaosKube(core) if cfg.chaos else core
     fake = FakeCloudProvider(catalog=tenant_catalog(cfg.tenants))
     provider = decorate(fake)
+    journal = None
+    if cfg.journal_dir:
+        from karpenter_tpu.runtime.journal import IntentJournal
+
+        journal = IntentJournal(cfg.journal_dir, fsync=cfg.journal_fsync)
     provisioning = ProvisioningController(
         kube, provider,
+        journal=journal,
         batcher_factory=functools.partial(
             Batcher, idle_seconds=0.05, max_seconds=0.5,
             max_depth=cfg.max_depth),
@@ -684,6 +696,7 @@ def run_replay(cfg: ReplayConfig) -> dict:
                 "partial_gangs": partial_gangs,
             },
             "spot": spot_section,
+            "journal": journal.stats() if journal is not None else None,
             "store_ops": sampler.report(),
             "slo": slo_section,
             "slo_digest_parity": digest_parity,
@@ -703,6 +716,8 @@ def run_replay(cfg: ReplayConfig) -> dict:
         if cfg.chaos:
             inject.uninstall()
         manager.stop()
+        if journal is not None:
+            journal.close_journal()
         core.unwatch(watch_q)
         pressure.set_monitor(None)
         if cfg.slo_objectives is not None:

@@ -734,8 +734,15 @@ class KubeApiClient:
             w is q for w in self._watch_queues)
 
     def _watch_loop(self, kind: str, q: "queue.Queue[Event]") -> None:
+        from karpenter_tpu.metrics.recovery import WATCH_RELIST_TOTAL
+
         path = self._collection(kind, None)
         attempt = 0
+        # None until the first snapshot lands; after that every further
+        # pass is a full relist-and-reconcile forced by a gap — counted by
+        # reason: "expired" (410, resourceVersion aged out of the watch
+        # cache) vs "reconnect" (stream ended or errored)
+        relist_reason: Optional[str] = None
         while self._watch_active(q):
             try:
                 raw_items, rv = self._list_pages(path, {})
@@ -746,6 +753,9 @@ class KubeApiClient:
                 # see a partial snapshot); a re-list after a watch gap
                 # purges deletions
                 self._cache_replace_kind(kind, objs, id(q))
+                if relist_reason is not None:
+                    WATCH_RELIST_TOTAL.inc(kind=kind, reason=relist_reason)
+                relist_reason = "reconnect"
                 for obj in objs:
                     q.put(Event("ADDED", obj))
                 try:
@@ -765,6 +775,7 @@ class KubeApiClient:
                 if not self._watch_active(q):
                     return
                 log.info("watch %s expired, resyncing: %s", kind, e)
+                relist_reason = "expired"
                 self._watch_stop.wait(0.2)
             except (ApiError, OSError, ValueError,
                     http.client.HTTPException) as e:

@@ -40,11 +40,12 @@ import numpy as np
 
 from karpenter_tpu.metrics.global_solve import (
     GLOBAL_FALLBACK_TOTAL, GLOBAL_ITERATIONS, GLOBAL_SOLVE_SECONDS,
-    GLOBAL_USED_TOTAL, GLOBAL_WINDOWS_TOTAL)
+    GLOBAL_USED_TOTAL, GLOBAL_WIDENED_ACCEPT_TOTAL, GLOBAL_WINDOWS_TOTAL)
 from karpenter_tpu.obs import trace as obtrace
 from karpenter_tpu.ops.global_solve import (
     GlobalWindowEncoding, encode_window, host_global_support,
-    plan_cost_micro, support_positions, verify_plan)
+    plan_cost_micro, support_positions, verify_plan,
+    widened_support_positions)
 from karpenter_tpu.solver import host_ffd
 from karpenter_tpu.solver import solve as solve_module
 from karpenter_tpu.solver.solve import SolveResult, SolverConfig, materialize
@@ -121,6 +122,7 @@ class GlobalInfo:
     ffd_cost_micro: int = 0     # exact int µ$/h of the FFD baseline
     support: int = 0
     iters: int = 0
+    widened: bool = False       # accepted via the widened-support retry
 
 
 @dataclass
@@ -220,32 +222,54 @@ def _round_window(win: GlobalWindowEncoding, n_rows: Optional[np.ndarray],
                                 .max_instance_types)
             info.ffd_cost_micro = plan_cost_micro(ffd, s.prices_micro) \
                 if ffd.packings else 0
-            if not keep:
-                info.reason = "fallback-no-support"
-            else:
-                restricted = [s.packables[t].copy() for t in keep]
+
+            def attempt(positions):
+                """One restricted rounding pass through the full gate
+                chain (infeasible → costlier → unverified); returns
+                (reason, accepted-or-None)."""
+                restricted = [s.packables[t].copy() for t in positions]
                 rounded = host_ffd.pack(
                     s.pod_vecs, s.pod_ids, restricted,
                     max_instance_types=solver_config.max_instance_types)
                 if rounded.unschedulable:
-                    info.reason = "fallback-infeasible"
+                    return "fallback-infeasible", None
+                rmicro = plan_cost_micro(rounded, s.prices_micro)
+                info.relax_cost_micro = rmicro
+                if ffd.unschedulable == [] \
+                        and rmicro >= info.ffd_cost_micro:
+                    return "fallback-costlier", None
+                if not verify_plan(
+                        {pid: vec for pid, vec in
+                         zip(s.pod_ids, s.pod_vecs)},
+                        {p.index: p for p in s.packables}, rounded):
+                    return "fallback-unverified", None
+                return "global", materialize(
+                    rounded, s.pods, s.sorted_types,
+                    s.constraints, solver_config)
+
+            if not keep:
+                # ROADMAP item 2 tail: many small schedules decline with
+                # no-support because the hand-tuned threshold is too strict
+                # for their magnitudes. Retry rounding ONCE on a widened
+                # support; an accept still passes every exact gate above,
+                # and a decline keeps the no-support verdict so fallback
+                # parity is unchanged.
+                widened = widened_support_positions(n_rows[s.row],
+                                                    s.num_types)
+                if widened:
+                    _, accepted = attempt(widened)
+                if accepted is not None:
+                    info.used = True
+                    info.reason = "global"
+                    info.widened = True
+                    info.support = len(widened)
+                    GLOBAL_WIDENED_ACCEPT_TOTAL.inc()
                 else:
-                    rmicro = plan_cost_micro(rounded, s.prices_micro)
-                    info.relax_cost_micro = rmicro
-                    if ffd.unschedulable == [] \
-                            and rmicro >= info.ffd_cost_micro:
-                        info.reason = "fallback-costlier"
-                    elif not verify_plan(
-                            {pid: vec for pid, vec in
-                             zip(s.pod_ids, s.pod_vecs)},
-                            {p.index: p for p in s.packables}, rounded):
-                        info.reason = "fallback-unverified"
-                    else:
-                        info.used = True
-                        info.reason = "global"
-                        accepted = materialize(
-                            rounded, s.pods, s.sorted_types,
-                            s.constraints, solver_config)
+                    info.reason = "fallback-no-support"
+            else:
+                reason, accepted = attempt(keep)
+                info.reason = reason
+                info.used = accepted is not None
         if info.used:
             GLOBAL_USED_TOTAL.inc()
         else:

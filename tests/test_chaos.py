@@ -430,6 +430,7 @@ SMOKE_SPECS = [
     inject.FaultSpec("kube", "create", "conflict", 1),
     inject.FaultSpec("kube", "bind_pods", "timeout", 1),
     inject.FaultSpec("kube", "watch", "drop", 1),
+    inject.FaultSpec("kube", "patch", "slow-apiserver", 1),
     inject.FaultSpec("provider", "create", "ice", 1),
     inject.FaultSpec("provider", "create", "crash-before-bind", 1),
 ]
@@ -437,7 +438,9 @@ SMOKE_SPECS = [
 SOAK_SPECS = [
     inject.FaultSpec("kube", "create", "conflict", 2),
     inject.FaultSpec("kube", "create", "timeout", 1),
+    inject.FaultSpec("kube", "create", "slow-apiserver", 1),
     inject.FaultSpec("kube", "patch", "conflict", 2),
+    inject.FaultSpec("kube", "patch", "slow-apiserver", 1),
     inject.FaultSpec("kube", "bind_pods", "timeout", 2),
     inject.FaultSpec("kube", "delete", "timeout", 1),
     inject.FaultSpec("kube", "watch", "drop", 3),

@@ -1,0 +1,717 @@
+"""Crash-restart recovery: journal replay + kill-point chaos soak.
+
+The scenario driver exercises every journaled intent kind — fleet
+launch, node bind, two-phase gang bind (success AND unwind legs),
+consolidation drain, termination finalizer — against KubeCore + the
+fake provider with a live IntentJournal. The soak then arms one
+``crash-point`` kill point at a time (chaos/inject.py), lets the
+simulated process death land wherever the seed puts it, "restarts"
+(fresh journal on the same directory + RecoveryController replay),
+re-drives the scenario to convergence, and asserts the crash-safety
+contract:
+
+- zero leaked instances (every ledger record backed by a Node);
+- zero double-binds (every bound pod points at exactly one live node);
+- zero partially-bound gangs (gang members bind all-or-nothing);
+- the final cluster state is identical to an uncrashed reference run
+  (canonicalized WITHOUT node names — the fake's global name counter
+  makes names run-order dependent; types/zones/bindings are compared).
+
+Plus unit coverage of each per-kind replay rule and the GC ↔ recovery
+ownership handoff (ISSUE 17 satellite).
+"""
+
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.constraints import Constraints
+from karpenter_tpu.api.core import NodeSelectorRequirement as Req
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.chaos import inject
+from karpenter_tpu.cloudprovider.fake.provider import (
+    FakeCloudProvider, instance_types,
+)
+from karpenter_tpu.controllers.consolidation import ConsolidationController
+from karpenter_tpu.controllers.gc import GarbageCollection
+from karpenter_tpu.controllers.provisioning import (
+    ProvisionerWorker, global_requirements,
+)
+from karpenter_tpu.controllers.recovery import RecoveryController
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.runtime import journal as jr
+from karpenter_tpu.runtime.journal import KILL_POINTS, IntentJournal
+from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
+from karpenter_tpu.scheduling.batcher import Batcher
+from karpenter_tpu.utils import clock
+from tests.expectations import make_provisioner, unschedulable_pod
+
+PLAIN_PODS = ["plain-0", "plain-1"]
+GANG_OK = ["gang-ok-0", "gang-ok-1"]
+GANG_BAD_REAL = "gang-bad-0"
+GANG_BAD_GHOST = "gang-bad-ghost"  # never created: forces the unwind leg
+DRAIN_LABEL = "test.karpenter.sh/drain-target"
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    yield
+    inject.uninstall()
+
+
+def make_constraints(provisioner="crash"):
+    return Constraints(
+        labels={wellknown.PROVISIONER_NAME_LABEL: provisioner},
+        requirements=Requirements([
+            Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In",
+                values=["test-zone-1"]),
+            Req(key=wellknown.LABEL_CAPACITY_TYPE, operator="In",
+                values=["on-demand"]),
+        ]),
+    )
+
+
+class Cluster:
+    """The state that survives a simulated process death: the apiserver
+    (KubeCore), the cloud (the fake provider's capacity ledger), and the
+    journal directory. Workers/controllers are per-"process" and rebuilt
+    on every (re)drive."""
+
+    def __init__(self, journal_dir: str):
+        self.journal_dir = journal_dir
+        self.kube = KubeCore()
+        self.provider = FakeCloudProvider(catalog=instance_types(4))
+        self.constraints = make_constraints()
+        self.prov = make_provisioner(name="crash",
+                                     constraints=self.constraints)
+        self.prov.spec.constraints.requirements = (
+            self.prov.spec.constraints.requirements.add(
+                *global_requirements(self.provider.get_instance_types(
+                    self.prov.spec.constraints)).items))
+        self.kube.create(self.prov)
+
+    def open_journal(self, **kw) -> IntentJournal:
+        kw.setdefault("fsync", False)  # tmpfs CI: durability is the API's
+        return IntentJournal(self.journal_dir, **kw)
+
+
+def ensure_pod(kube, name, cpu="500m"):
+    try:
+        return kube.get("Pod", name)
+    except NotFound:
+        p = unschedulable_pod(requests={"cpu": cpu, "memory": "256Mi"},
+                              name=name)
+        kube.create(p)
+        return p
+
+
+def bound_node(kube, pod_name):
+    try:
+        return kube.get("Pod", pod_name).spec.node_name or None
+    except NotFound:
+        return None
+
+
+def make_worker(cluster, journal):
+    return ProvisionerWorker(
+        cluster.prov, cluster.kube, cluster.provider,
+        batcher=Batcher(idle_seconds=0.02, max_seconds=0.2),
+        journal=journal)
+
+
+def launch_gang(worker, cluster, pods, key):
+    """Drive _launch_gang through fabricated planner structures — the
+    planner upstream of it is pure; the crash windows live here."""
+    itype = cluster.provider.catalog[-1]
+    enc = SimpleNamespace(
+        bins=[SimpleNamespace(type_index=0, name=f"{key}-bin-0")])
+    prep = SimpleNamespace(gang_enc=enc, gang_nodes={},
+                           gang_types=[(itype.name, itype)])
+    gang = SimpleNamespace(
+        key=key, pods=pods,
+        context=SimpleNamespace(constraints=cluster.constraints))
+    placement = SimpleNamespace(gang=gang, node_sets=[(0, pods)])
+    return worker._launch_gang(prep, placement)
+
+
+def settle_terminations(cluster, journal, rounds=25):
+    """Finish every node the scenario put into deletion (drain target,
+    unwound gang nodes): the termination finalizer's reconcile loop."""
+    term = TerminationController(cluster.kube, cluster.provider,
+                                 journal=journal)
+    try:
+        for _ in range(rounds):
+            deleting = [
+                n for n in cluster.kube.list("Node")
+                if n.metadata.deletion_timestamp is not None]
+            if not deleting:
+                return
+            for n in deleting:
+                term.reconcile(n.metadata.name, "")
+            time.sleep(0.01)
+        raise AssertionError(
+            f"nodes stuck terminating: "
+            f"{[n.metadata.name for n in deleting]}")
+    finally:
+        term.stop_all()
+
+
+def drain_target(cluster):
+    """The dedicated empty node the drain leg operates on, labeled so
+    re-drives find it regardless of the run-order-dependent name."""
+    for n in cluster.kube.list("Node"):
+        if n.metadata.labels.get(DRAIN_LABEL):
+            return n
+    made = []
+
+    def bind(node):
+        node.metadata.labels[DRAIN_LABEL] = "true"
+        node.metadata.labels.update(cluster.constraints.labels)
+        node.metadata.finalizers.append(wellknown.TERMINATION_FINALIZER)
+        cluster.kube.create(node)
+        made.append(node)
+        return None
+
+    errs = cluster.provider.create(
+        cluster.constraints, [cluster.provider.catalog[0]], 1, bind)
+    assert errs == [None]
+    return made[0]
+
+
+def run_scenario(cluster, journal):
+    """One full control-plane pass: idempotent, so the soak re-drives it
+    verbatim after a crash + recovery and converges to the reference."""
+    kube = cluster.kube
+    worker = make_worker(cluster, journal)
+
+    # 1. plain pods: fleet-launch + bind intents via the real hot loop
+    pods = [ensure_pod(kube, n, cpu="1500m") for n in PLAIN_PODS]
+    pending = [p for p in pods if not bound_node(kube, p.metadata.name)]
+    if pending:
+        for p in pending:
+            worker.add(p, key=(p.metadata.namespace, p.metadata.name))
+        worker.provision()
+
+    # 2. gang success leg: all-or-nothing two-phase bind
+    gang_pods = [ensure_pod(kube, n) for n in GANG_OK]
+    if not all(bound_node(kube, n) for n in GANG_OK):
+        err = launch_gang(worker, cluster, gang_pods, key="gang-ok")
+        assert err is None, f"gang-ok failed to bind: {err}"
+
+    # 3. gang failure leg: a ghost member forces bind failure → unwind
+    bad = ensure_pod(kube, GANG_BAD_REAL)
+    ghost = unschedulable_pod(name=GANG_BAD_GHOST)  # NOT in kube
+    err = launch_gang(worker, cluster, [bad, ghost], key="gang-bad")
+    assert err is not None, "ghost-member gang unexpectedly bound"
+
+    # 4. consolidation drain of the dedicated target
+    target = drain_target(cluster)
+    consolidation = ConsolidationController(
+        kube, provider=cluster.provider, journal=journal)
+    consolidation._drain_node(target, 0.25)
+
+    # 5. termination finalizer finishes every deleting node
+    settle_terminations(cluster, journal)
+
+
+def restart(cluster):
+    """Process restart: fresh journal handle over the same directory,
+    then the startup replay — exactly main.py's boot order."""
+    journal = cluster.open_journal()
+    recovery = RecoveryController(cluster.kube, cluster.provider, journal)
+    assert recovery.recovering()
+    stats = recovery.run()
+    assert not recovery.recovering()
+    return journal, stats
+
+
+def canonical_state(cluster):
+    """Node-name-free canonical snapshot (the fake provider's global
+    name counter makes names depend on how many launches ever ran)."""
+    node_shape = {}
+    for n in cluster.kube.list("Node"):
+        labels = n.metadata.labels
+        node_shape[n.metadata.name] = (
+            labels.get(wellknown.LABEL_INSTANCE_TYPE, ""),
+            labels.get(wellknown.LABEL_TOPOLOGY_ZONE, ""),
+            labels.get(wellknown.LABEL_CAPACITY_TYPE, ""),
+        )
+    pods = []
+    for p in cluster.kube.list("Pod"):
+        nn = p.spec.node_name
+        pods.append((p.metadata.namespace, p.metadata.name,
+                     bool(nn), node_shape.get(nn) if nn else None))
+    return {"pods": sorted(pods),
+            "node_types": sorted(node_shape.values())}
+
+
+def assert_invariants(cluster):
+    kube, provider = cluster.kube, cluster.provider
+    records = provider.list_instances()
+    backed = set()
+    for n in kube.list("Node"):
+        backed |= {s for s in (n.spec.provider_id or "").split("/") if s}
+    leaked = [r.instance_id for r in records if r.instance_id not in backed]
+    assert not leaked, f"leaked instances (no Node): {leaked}"
+    ledger = {r.instance_id for r in records}
+    for n in kube.list("Node"):
+        segs = {s for s in (n.spec.provider_id or "").split("/") if s}
+        assert segs & ledger, (
+            f"ghost node {n.metadata.name}: no backing instance")
+    # double-binds: every bound pod points at a live node, and the
+    # node-name index agrees with the objects
+    for p in kube.list("Pod"):
+        if p.spec.node_name:
+            kube.get("Node", p.spec.node_name, "")  # raises if dangling
+            on_node = {q.metadata.name
+                       for q in kube.pods_on_node(p.spec.node_name)}
+            assert p.metadata.name in on_node, (
+                f"index lost bound pod {p.metadata.name}")
+    # gang atomicity: gang-ok all-or-nothing, gang-bad never bound
+    ok_bound = [bound_node(kube, n) for n in GANG_OK]
+    assert all(ok_bound) or not any(ok_bound), (
+        f"partially bound gang: {dict(zip(GANG_OK, ok_bound))}")
+    assert bound_node(kube, GANG_BAD_REAL) is None, (
+        "member of the failed gang stayed bound")
+
+
+def crash_soak_once(tmp_path, kill_point, seed, window=2):
+    """One soak cell: crashed run vs uncrashed reference."""
+    ref = Cluster(str(tmp_path / f"ref-{seed}"))
+    ref_journal = ref.open_journal()
+    run_scenario(ref, ref_journal)
+    assert ref_journal.open_intents() == {}, (
+        "reference run left intents open")
+    ref_state = canonical_state(ref)
+    ref_journal.close_journal()
+
+    c = Cluster(str(tmp_path / f"crash-{seed}"))
+    journal = c.open_journal()
+    inject.install(inject.FaultPlan(seed, [
+        inject.FaultSpec("journal", kill_point, "crash-point", 1)],
+        window=window))
+    crashed = False
+    try:
+        run_scenario(c, journal)
+    except inject.SimulatedCrash as e:
+        crashed = True
+        assert e.point == kill_point
+    finally:
+        inject.uninstall()
+        journal.close_journal()  # drop the dead process's handle
+
+    journal2, stats = restart(c)
+    if crashed:
+        # a crash mid-mutation must leave a journal trail to resolve —
+        # except at the two edges where nothing was durable yet and live
+        # state alone already converged
+        assert sum(stats.values()) >= 0
+    assert stats["errors"] == 0, f"recovery errored: {stats}"
+    run_scenario(c, journal2)  # re-drive to convergence
+    assert journal2.open_intents() == {}
+    assert_invariants(c)
+    state = canonical_state(c)
+    assert state == ref_state, (
+        f"kill point {kill_point} seed {seed} diverged "
+        f"(crashed={crashed}):\n got: {state}\n ref: {ref_state}")
+    journal2.close_journal()
+    return crashed
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 smoke: one seed, a curated subset of kill points spanning every
+# intent kind and both pre/post edges, window=1 so each is guaranteed to
+# fire. The slow matrix below runs seeds 1/7/42 x the full catalog.
+# ---------------------------------------------------------------------------
+
+SMOKE_POINTS = [
+    "pre:fleet-launch:open",
+    "fleet-launch:open",        # nonce durable, CreateFleet not yet run
+    "fleet-launch:launched",
+    "pre:bind:node-created",    # instance up, Node write in flight
+    "bind:node-created",
+    "pre:bind:bound",
+    "gang-bind:open",
+    "gang-bind:nodes-created",  # mid two-phase bind
+    "pre:gang-bind:bound",
+    "gang-bind:unwinding",      # mid _unwind_gang (ISSUE 17 acceptance)
+    "pre:drain:deleting",       # mid consolidation drain
+    "drain:open",
+    "pre:node-delete:instance-deleted",
+    "node-delete:instance-deleted",
+]
+
+
+class TestCrashSoakSmoke:
+    @pytest.mark.parametrize("kill_point", SMOKE_POINTS)
+    def test_kill_point(self, tmp_path, kill_point):
+        crashed = crash_soak_once(tmp_path, kill_point, seed=1, window=1)
+        assert crashed, (
+            f"kill point {kill_point} never fired — the scenario no "
+            "longer reaches this transition; update SMOKE_POINTS")
+
+
+class TestCrashSoakFull:
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_every_kill_point(self, tmp_path, seed):
+        fired = 0
+        for kill_point in KILL_POINTS:
+            if crash_soak_once(tmp_path / kill_point.replace(":", "_"),
+                               kill_point, seed=seed):
+                fired += 1
+        # window=2 means a point on a single-call stream may draw index 1
+        # and never fire (a valid no-crash cell); the bulk must fire
+        assert fired >= len(KILL_POINTS) // 2, (
+            f"only {fired}/{len(KILL_POINTS)} kill points fired")
+        print(f"\ncrash soak seed={seed}: {fired}/{len(KILL_POINTS)} "
+              "kill points fired, all converged")
+
+
+# ---------------------------------------------------------------------------
+# Per-kind replay rules (unit scale)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    return Cluster(str(tmp_path / "wal"))
+
+
+class TestReplayRules:
+    def test_fleet_launch_rollback_terminates_unbacked(self, cluster):
+        journal = cluster.open_journal()
+        nonce = jr.new_nonce()
+        journal.open_intent("fleet-launch", nonce=nonce, quantity=1)
+        # the launch ran, the bind never did (crash between them)
+        with jr.preassigned_nonce(nonce):
+            inject.install(inject.FaultPlan(1, [
+                inject.FaultSpec("provider", "create",
+                                 "crash-before-bind", 1)], window=1))
+            cluster.provider.create(
+                cluster.constraints, cluster.provider.catalog, 1,
+                lambda n: pytest.fail("bind ran"))
+            inject.uninstall()
+        assert len(cluster.provider.list_instances()) == 1
+        journal.close_journal()
+
+        journal2, stats = restart(cluster)
+        assert stats["rollback"] == 1
+        assert cluster.provider.list_instances() == []
+        assert journal2.open_intents() == {}
+
+    def test_fleet_launch_keeps_backed_instances(self, cluster):
+        journal = cluster.open_journal()
+        nonce = jr.new_nonce()
+        journal.open_intent("fleet-launch", nonce=nonce, quantity=1)
+        with jr.preassigned_nonce(nonce):
+            cluster.provider.create(
+                cluster.constraints, cluster.provider.catalog, 1,
+                lambda n: cluster.kube.create(n))
+        journal.close_journal()
+
+        _, stats = restart(cluster)
+        assert stats["rollback"] == 0
+        assert len(cluster.provider.list_instances()) == 1  # kept
+        assert len(cluster.kube.list("Node")) == 1
+
+    def test_fleet_launch_nothing_launched_is_noop(self, cluster):
+        journal = cluster.open_journal()
+        journal.open_intent("fleet-launch", nonce=jr.new_nonce(),
+                            quantity=3)
+        journal.close_journal()
+        _, stats = restart(cluster)
+        assert stats == {"forward": 0, "rollback": 0, "noop": 1,
+                         "errors": 0}
+
+    def test_bind_rolls_forward_unbound_members(self, cluster):
+        kube = cluster.kube
+        node = drain_target(cluster)  # any backed node
+        done = ensure_pod(kube, "done-pod")
+        kube.bind_pod(done, node.metadata.name)
+        missed = ensure_pod(kube, "missed-pod")
+        journal = cluster.open_journal()
+        journal.open_intent(
+            "bind", node=node.metadata.name,
+            pods=["default/done-pod", "default/missed-pod"])
+        journal.close_journal()
+
+        _, stats = restart(cluster)
+        assert stats["forward"] == 1
+        assert bound_node(kube, "missed-pod") == node.metadata.name
+        assert bound_node(kube, "done-pod") == node.metadata.name
+
+    def test_bind_noop_when_node_never_landed(self, cluster):
+        ensure_pod(cluster.kube, "orphan-pod")
+        journal = cluster.open_journal()
+        journal.open_intent("bind", node="never-created",
+                            pods=["default/orphan-pod"])
+        journal.close_journal()
+        _, stats = restart(cluster)
+        assert stats["noop"] == 1
+        assert bound_node(cluster.kube, "orphan-pod") is None
+
+    def test_gang_unwind_from_nodes_created(self, cluster):
+        kube = cluster.kube
+        journal = cluster.open_journal()
+        worker = make_worker(cluster, journal)
+        pods = [ensure_pod(kube, n) for n in GANG_OK]
+        # bind crashed mid-gang: arm the post-point so the intent is left
+        # at nodes-created with members partially bound
+        inject.install(inject.FaultPlan(1, [
+            inject.FaultSpec("journal", "gang-bind:nodes-created",
+                             "crash-point", 1)], window=1))
+        with pytest.raises(inject.SimulatedCrash):
+            launch_gang(worker, cluster, pods, key="gang-ok")
+        inject.uninstall()
+        journal.close_journal()
+        assert len(kube.list("Node")) == 1  # the gang node landed
+
+        _, stats = restart(cluster)
+        assert stats["rollback"] == 1
+        assert kube.list("Node") == []
+        assert cluster.provider.list_instances() == []
+        for n in GANG_OK:
+            assert bound_node(kube, n) is None
+
+    def test_gang_unwind_reaps_nonce_only_instance(self, cluster):
+        # crash landed between the instance launch and the Node write:
+        # the gang intent holds only the nonce, no created entry
+        journal = cluster.open_journal()
+        iid = journal.open_intent("gang-bind", gang="g",
+                                  members=["default/gang-ok-0"])
+        nonce = jr.new_nonce()
+        journal.note(iid, nonces=[nonce])
+        inject.install(inject.FaultPlan(1, [
+            inject.FaultSpec("provider", "create",
+                             "crash-before-bind", 1)], window=1))
+        with jr.preassigned_nonce(nonce):
+            cluster.provider.create(
+                cluster.constraints, cluster.provider.catalog, 1,
+                lambda n: pytest.fail("bind ran"))
+        inject.uninstall()
+        assert len(cluster.provider.list_instances()) == 1
+        journal.close_journal()
+
+        _, stats = restart(cluster)
+        assert stats["rollback"] == 1
+        assert cluster.provider.list_instances() == []
+
+    def test_gang_bound_rolls_forward(self, cluster):
+        kube = cluster.kube
+        journal = cluster.open_journal()
+        worker = make_worker(cluster, journal)
+        pods = [ensure_pod(kube, n) for n in GANG_OK]
+        inject.install(inject.FaultPlan(1, [
+            inject.FaultSpec("journal", "gang-bind:bound",
+                             "crash-point", 1)], window=1))
+        with pytest.raises(inject.SimulatedCrash):
+            launch_gang(worker, cluster, pods, key="gang-ok")
+        inject.uninstall()
+        journal.close_journal()
+
+        _, stats = restart(cluster)
+        assert stats["forward"] == 1
+        # bound is past the point of no return: the gang survives
+        assert all(bound_node(kube, n) for n in GANG_OK)
+        assert len(kube.list("Node")) == 1
+
+    def test_drain_reissued_when_delete_never_landed(self, cluster):
+        node = drain_target(cluster)
+        journal = cluster.open_journal()
+        journal.open_intent("drain", node=node.metadata.name, namespace="")
+        journal.close_journal()
+
+        _, stats = restart(cluster)
+        assert stats["forward"] == 1
+        live = cluster.kube.get("Node", node.metadata.name, "")
+        assert live.metadata.deletion_timestamp is not None
+
+    def test_drain_noop_when_already_deleting(self, cluster):
+        node = drain_target(cluster)
+        cluster.kube.delete("Node", node.metadata.name, "")
+        journal = cluster.open_journal()
+        journal.open_intent("drain", node=node.metadata.name, namespace="")
+        journal.close_journal()
+        _, stats = restart(cluster)
+        assert stats["noop"] == 1
+
+    def test_node_delete_strips_finalizer_after_instance_gone(self, cluster):
+        node = drain_target(cluster)
+        cluster.kube.delete("Node", node.metadata.name, "")
+        journal = cluster.open_journal()
+        iid = journal.open_intent("node-delete", node=node.metadata.name,
+                                  provider_id=node.spec.provider_id)
+        # the instance delete landed, the finalizer strip crashed
+        segs = [s for s in node.spec.provider_id.split("/") if s]
+        cluster.provider.delete_instance(segs[0])
+        journal.advance(iid, "instance-deleted")
+        journal.close_journal()
+
+        _, stats = restart(cluster)
+        assert stats["forward"] == 1
+        with pytest.raises(NotFound):
+            cluster.kube.get("Node", node.metadata.name, "")
+
+    def test_node_delete_reaps_leftover_instance(self, cluster):
+        node = drain_target(cluster)
+        journal = cluster.open_journal()
+        journal.open_intent("node-delete", node=node.metadata.name,
+                            provider_id=node.spec.provider_id)
+        # the Node object is fully gone but the instance delete never ran
+        def strip(live):
+            live.metadata.finalizers = []
+        cluster.kube.patch("Node", node.metadata.name, "", strip)
+        cluster.kube.delete("Node", node.metadata.name, "")
+        assert len(cluster.provider.list_instances()) == 1
+        journal.close_journal()
+
+        _, stats = restart(cluster)
+        assert stats["forward"] == 1
+        assert cluster.provider.list_instances() == []
+
+    def test_rollback_trips_flight_recorder(self, cluster, tmp_path):
+        from karpenter_tpu.obs import flight
+        flight.configure(str(tmp_path / "flight"), min_interval_s=0.0)
+        try:
+            journal = cluster.open_journal()
+            nonce = jr.new_nonce()
+            journal.open_intent("fleet-launch", nonce=nonce)
+            inject.install(inject.FaultPlan(1, [
+                inject.FaultSpec("provider", "create",
+                                 "crash-before-bind", 1)], window=1))
+            with jr.preassigned_nonce(nonce):
+                cluster.provider.create(
+                    cluster.constraints, cluster.provider.catalog, 1,
+                    lambda n: None)
+            inject.uninstall()
+            journal.close_journal()
+            _, stats = restart(cluster)
+            assert stats["rollback"] == 1
+            dumps = os.listdir(str(tmp_path / "flight"))
+            assert any("recovery-rollback" in d for d in dumps), dumps
+        finally:
+            flight.configure("", min_interval_s=5.0)
+
+
+# ---------------------------------------------------------------------------
+# GC <-> recovery ownership handoff (ISSUE 17 satellite)
+# ---------------------------------------------------------------------------
+
+T0 = 1_700_000_000.0
+GRACE = 60.0
+
+
+class TestGcRecoveryHandoff:
+    def _leak_with_intent(self, cluster, journal):
+        """A journaled fleet-launch whose node never appeared."""
+        nonce = jr.new_nonce()
+        iid = journal.open_intent("fleet-launch", nonce=nonce, quantity=1)
+        inject.install(inject.FaultPlan(1, [
+            inject.FaultSpec("provider", "create",
+                             "crash-before-bind", 1)], window=1))
+        with jr.preassigned_nonce(nonce):
+            cluster.provider.create(
+                cluster.constraints, cluster.provider.catalog, 1,
+                lambda n: pytest.fail("bind ran"))
+        inject.uninstall()
+        (record,) = cluster.provider.list_instances()
+        assert record.launch_nonce == nonce
+        return iid, record
+
+    def test_gc_skips_journal_covered_nonce(self, cluster):
+        clock.DEFAULT.set(T0)
+        journal = cluster.open_journal()
+        iid, record = self._leak_with_intent(cluster, journal)
+        gc = GarbageCollection(cluster.kube, cluster.provider,
+                               interval_seconds=0.01, grace_seconds=GRACE,
+                               journal=journal)
+        clock.DEFAULT.set(T0 + GRACE + 5)  # well past the grace window
+        gc.reconcile("capacity-gc", "")
+        # owned by the open intent: GC must NOT touch it
+        assert len(cluster.provider.list_instances()) == 1
+        # once the intent closes, the same sweep reaps it
+        journal.close(iid, outcome="abandoned")
+        gc.reconcile("capacity-gc", "")
+        assert cluster.provider.list_instances() == []
+        assert cluster.provider.deleted.count(record.instance_id) == 1
+
+    def test_recovery_terminates_exactly_once_vs_concurrent_gc(
+            self, cluster):
+        clock.DEFAULT.set(T0)
+        journal = cluster.open_journal()
+        _, record = self._leak_with_intent(cluster, journal)
+        journal.close_journal()
+        clock.DEFAULT.set(T0 + GRACE + 5)
+
+        journal2 = cluster.open_journal()
+        recovery = RecoveryController(cluster.kube, cluster.provider,
+                                      journal2)
+        gc = GarbageCollection(cluster.kube, cluster.provider,
+                               interval_seconds=0.0, grace_seconds=GRACE,
+                               journal=journal2)
+        stop = threading.Event()
+
+        def gc_loop():
+            while not stop.is_set():
+                gc.reconcile("capacity-gc", "")
+
+        t = threading.Thread(target=gc_loop)
+        t.start()
+        try:
+            stats = recovery.run()
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        gc.reconcile("capacity-gc", "")  # one more sweep after handoff
+        assert stats["rollback"] == 1, stats
+        assert cluster.provider.list_instances() == []
+        # terminated by recovery exactly once, never double-terminated
+        assert cluster.provider.deleted.count(record.instance_id) == 1
+
+
+# ---------------------------------------------------------------------------
+# readyz gates on recovery (ISSUE 17 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestReadyzRecovering:
+    def test_readyz_503_until_replay_completes(self, cluster):
+        import urllib.request
+        from http.server import ThreadingHTTPServer
+
+        from karpenter_tpu.main import _Handler
+
+        journal = cluster.open_journal()
+        journal.open_intent("fleet-launch", nonce=jr.new_nonce())
+        journal.close_journal()
+        journal2 = cluster.open_journal()
+        recovery = RecoveryController(cluster.kube, cluster.provider,
+                                      journal2)
+        handler = type("H", (_Handler,),
+                       {"manager": None, "recovery": recovery})
+        server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        port = server.server_address[1]
+
+        def readyz():
+            try:
+                r = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz")
+                return r.status, r.read().decode()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read().decode()
+
+        try:
+            status, body = readyz()
+            assert status == 503 and "recovering" in body, (status, body)
+            recovery.run()
+            status, body = readyz()
+            assert status == 200 and "recovering" not in body, (status,
+                                                                body)
+        finally:
+            server.shutdown()

@@ -367,3 +367,72 @@ class TestExactIntSeam:
         # duplicate one pod id inside a node → conservation check fires
         ffd.packings[0].pod_ids[0].append(ffd.packings[0].pod_ids[0][0])
         assert not verify_plan(vecs, by_index, ffd)
+
+
+class TestWidenedSupportRetry:
+    """ISSUE 17 satellite (ROADMAP item 2 tail): a ``no-support`` verdict
+    gets ONE rounding retry on a widened support. An accept passes the
+    same exact gates (feasible, strictly cheaper, host-verified) and is
+    counted; a decline keeps fallback parity bit-for-bit."""
+
+    def _widened_total(self):
+        from karpenter_tpu.metrics.registry import DEFAULT as REGISTRY
+        return sum(REGISTRY.counter(
+            "global_widened_accept_total").collect().values())
+
+    def test_widened_positions_superset_of_strict(self):
+        from karpenter_tpu.ops.global_solve import (
+            support_positions, widened_support_positions,
+        )
+        n = np.array([5.0, 0.3, 0.04, 0.0])
+        strict = support_positions(n, 4)
+        widened = widened_support_positions(n, 4)
+        assert set(strict) <= set(widened)
+        assert 1 in widened and 1 not in strict  # 0.3: only the loose bar
+        assert 2 not in widened                  # 0.04: noise stays out
+
+    def test_widened_guards_degenerate_rows(self):
+        from karpenter_tpu.ops.global_solve import widened_support_positions
+        assert widened_support_positions(np.array([]), 0) == []
+        assert widened_support_positions(np.array([0.0, 0.0]), 2) == []
+        assert widened_support_positions(np.array([np.nan, 1.0]), 2) == []
+
+    def test_no_support_recovered_through_exact_gates(self, monkeypatch):
+        # force every schedule down the no-support path; the widened
+        # retry must recover the accepts the strict threshold would have
+        # taken, through the SAME cheaper/verify gates
+        monkeypatch.setattr(global_solve, "support_positions",
+                            lambda n, t: [])
+        before = self._widened_total()
+        accepted = 0
+        for seed in SEEDS:
+            _, problems = random_window(seed)
+            plan = solve_window_global(problems, SolverConfig(), MIRROR)
+            for info, result, problem in zip(plan.infos, plan.results,
+                                             problems):
+                if info.used:
+                    accepted += 1
+                    assert info.widened and info.reason == "global"
+                    assert info.support > 0
+                    assert result is not None
+                    assert_conserved(result, problem.pods)
+                    assert info.relax_cost_micro < info.ffd_cost_micro
+                else:
+                    assert result is None
+                    assert info.reason == "fallback-no-support"
+        assert accepted > 0, "widened retry never recovered an accept"
+        assert self._widened_total() == before + accepted
+
+    def test_decline_parity_when_widened_also_fails(self, monkeypatch):
+        monkeypatch.setattr(global_solve, "support_positions",
+                            lambda n, t: [])
+        monkeypatch.setattr(global_solve, "widened_support_positions",
+                            lambda n, t: [])
+        before = self._widened_total()
+        _, problems = random_window(7)
+        plan = solve_window_global(problems, SolverConfig(), MIRROR)
+        assert plan.accepted == 0
+        assert plan.results == [None] * len(problems)
+        assert all(i.reason == "fallback-no-support" and not i.widened
+                   for i in plan.infos)
+        assert self._widened_total() == before

@@ -1,0 +1,317 @@
+"""The write-ahead intent journal (runtime/journal.py).
+
+Covers the storage format (CRC framing, torn-tail tolerance, mid-segment
+corruption, rotation, compaction-as-atomic-rewrite), the intent state
+machines (monotonic advance, data-only notes, idempotent close), the
+launch-nonce pre-stamp plumbing, and the kill-point catalog the
+crash-restart soak (test_crash_recovery.py) iterates.
+"""
+
+import json
+import os
+import threading
+import zlib
+
+import pytest
+
+from karpenter_tpu.chaos import inject
+from karpenter_tpu.runtime import journal as jr
+from karpenter_tpu.runtime.journal import (
+    KILL_POINTS, MACHINES, IntentJournal, _decode_line,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    yield
+    inject.uninstall()
+
+
+def segments(d):
+    return sorted(f for f in os.listdir(d) if f.endswith(".wal"))
+
+
+def raw_lines(d):
+    out = []
+    for fn in segments(d):
+        with open(os.path.join(d, fn), "rb") as f:
+            out.extend(line for line in f.read().split(b"\n") if line)
+    return out
+
+
+class TestFraming:
+    def test_decode_roundtrip(self):
+        payload = json.dumps({"id": "x", "kind": "drain",
+                              "phase": "open"}).encode()
+        line = f"{zlib.crc32(payload):08x} ".encode() + payload
+        assert _decode_line(line) == {"id": "x", "kind": "drain",
+                                      "phase": "open"}
+
+    def test_decode_rejects_garbage(self):
+        payload = b'{"id":"x"}'
+        good = f"{zlib.crc32(payload):08x} ".encode() + payload
+        assert _decode_line(b"") is None
+        assert _decode_line(b"short") is None
+        assert _decode_line(b"zzzzzzzz " + payload) is None  # bad hex
+        assert _decode_line(good[:-2]) is None               # torn payload
+        assert _decode_line(good.replace(b'"x"', b'"y"')) is None  # bit flip
+        # valid CRC over a non-object payload
+        arr = b"[1,2]"
+        assert _decode_line(f"{zlib.crc32(arr):08x} ".encode() + arr) is None
+
+    def test_every_written_line_is_framed(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        iid = j.open_intent("drain", node="n1")
+        j.advance(iid, "deleting")
+        j.close(iid)
+        lines = raw_lines(str(tmp_path))
+        assert len(lines) == 3
+        phases = [_decode_line(line)["phase"] for line in lines]
+        assert phases == ["open", "deleting", "closed"]
+
+
+class TestReplay:
+    def test_restart_restores_open_intents(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        a = j.open_intent("fleet-launch", nonce="abc", quantity=2)
+        b = j.open_intent("bind", node="n1", pods=["default/p1"])
+        j.advance(b, "node-created")
+        c = j.open_intent("drain", node="n2")
+        j.close(c)
+        j.close_journal()
+
+        j2 = IntentJournal(str(tmp_path), fsync=False)
+        live = j2.open_intents()
+        assert set(live) == {a, b}
+        assert live[a].phase == "open"
+        assert live[a].data["nonce"] == "abc"
+        assert live[b].phase == "node-created"
+        assert live[b].data["pods"] == ["default/p1"]
+        assert j2.stats()["torn_records"] == 0
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        a = j.open_intent("drain", node="n1")
+        j.advance(a, "deleting")
+        j.close_journal()
+        # crash mid-append: the final line loses its tail bytes
+        path = os.path.join(str(tmp_path), segments(str(tmp_path))[-1])
+        with open(path, "rb") as f:
+            raw = f.read()
+        with open(path, "wb") as f:
+            f.write(raw[:-7])
+
+        j2 = IntentJournal(str(tmp_path), fsync=False)
+        assert j2.stats()["torn_records"] == 1
+        # the open record survived; the torn advance is simply not there
+        assert j2.open_intents()[a].phase == "open"
+        # appends go to a FRESH segment: the torn tail is never
+        # appended after, so it stays the last line of ITS segment
+        j2.advance(a, "deleting")
+        assert len(segments(str(tmp_path))) == 2
+
+    def test_mid_segment_corruption_skipped(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        a = j.open_intent("drain", node="n1")
+        b = j.open_intent("drain", node="n2")
+        j.advance(b, "deleting")
+        j.close_journal()
+        path = os.path.join(str(tmp_path), segments(str(tmp_path))[-1])
+        with open(path, "rb") as f:
+            lines = f.read().split(b"\n")
+        lines[1] = b"xx" + lines[1][2:]  # corrupt b's open, keep the rest
+        with open(path, "wb") as f:
+            f.write(b"\n".join(lines))
+
+        j2 = IntentJournal(str(tmp_path), fsync=False)
+        assert j2.stats()["torn_records"] == 1
+        live = j2.open_intents()
+        assert live[a].phase == "open"
+        # records are self-describing: the surviving advance still
+        # reconstructs b (kind + phase) despite its torn open
+        assert live[b].kind == "drain"
+        assert live[b].phase == "deleting"
+
+    def test_close_record_wins_over_corrupt_history(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        a = j.open_intent("drain", node="n1")
+        j.close(a)
+        j.close_journal()
+        j2 = IntentJournal(str(tmp_path), fsync=False)
+        assert j2.open_intents() == {}
+
+
+class TestRotationAndCompaction:
+    def test_rotation_at_segment_cap(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False,
+                          segment_max_records=2)
+        for _ in range(3):
+            iid = j.open_intent("drain", node="n")
+            j.close(iid)
+        assert len(segments(str(tmp_path))) >= 3
+
+    def test_compaction_keeps_only_open_intents(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False,
+                          segment_max_records=2)
+        keep = j.open_intent("fleet-launch", nonce="keep-me")
+        for _ in range(5):
+            iid = j.open_intent("drain", node="n")
+            j.close(iid)
+        before = len(raw_lines(str(tmp_path)))
+        removed = j.compact()
+        assert removed >= 1
+        lines = raw_lines(str(tmp_path))
+        assert len(lines) < before
+        assert all(_decode_line(line)["id"] == keep for line in lines)
+        # the compacted journal replays identically
+        j.close_journal()
+        j2 = IntentJournal(str(tmp_path), fsync=False)
+        assert set(j2.open_intents()) == {keep}
+        assert j2.open_intents()[keep].data["nonce"] == "keep-me"
+
+    def test_compaction_of_all_closed_empties_the_dir(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        for _ in range(3):
+            j.close(j.open_intent("drain", node="n"))
+        j.compact()
+        assert raw_lines(str(tmp_path)) == []
+        j.close_journal()
+        assert IntentJournal(str(tmp_path), fsync=False).open_intents() == {}
+
+    def test_append_after_compaction_lands_in_new_segment(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        j.close(j.open_intent("drain", node="n"))
+        j.compact()
+        a = j.open_intent("drain", node="m")
+        j.close_journal()
+        j2 = IntentJournal(str(tmp_path), fsync=False)
+        assert set(j2.open_intents()) == {a}
+
+
+class TestStateMachines:
+    def test_unknown_kind_rejected(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        with pytest.raises(ValueError):
+            j.open_intent("teleport")
+
+    def test_advance_validates_membership_and_order(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        iid = j.open_intent("bind", node="n1")
+        with pytest.raises(ValueError):
+            j.advance(iid, "launched")  # fleet-launch phase, not bind's
+        with pytest.raises(ValueError):
+            j.advance(iid, "open")      # no going back
+        with pytest.raises(ValueError):
+            j.advance(iid, "closed")    # terminal is close()'s job
+        j.advance(iid, "bound")         # skipping node-created is legal
+        with pytest.raises(ValueError):
+            j.advance(iid, "node-created")  # monotonic
+        with pytest.raises(KeyError):
+            j.advance("no-such-intent", "bound")
+
+    def test_note_grows_data_without_phase_change(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        iid = j.open_intent("gang-bind", gang="g", members=["default/a"])
+        j.note(iid, created=["node-1"])
+        j.note(iid, created=["node-1", "node-2"])
+        intent = j.intent(iid)
+        assert intent.phase == "open"
+        assert intent.data["created"] == ["node-1", "node-2"]
+        j.close_journal()
+        restored = IntentJournal(str(tmp_path), fsync=False).intent(iid)
+        assert restored.phase == "open"
+        assert restored.data["created"] == ["node-1", "node-2"]
+
+    def test_close_unknown_is_noop(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        j.close("never-opened")  # recovery and the happy path may race
+        iid = j.open_intent("drain", node="n")
+        j.close(iid, outcome="done")
+        j.close(iid, outcome="again")  # double close: no-op, no record
+        assert len(raw_lines(str(tmp_path))) == 2
+
+    def test_covered_nonces(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        a = j.open_intent("fleet-launch", nonce="n-a")
+        g = j.open_intent("gang-bind", gang="g", members=[])
+        j.note(g, nonces=["n-g1", "n-g2"])
+        b = j.open_intent("fleet-launch", nonce="n-b")
+        j.open_intent("drain", node="x")
+        assert j.covered_nonces() == {"n-a", "n-b", "n-g1", "n-g2"}
+        j.close(a)
+        j.close(g)
+        assert j.covered_nonces() == {"n-b"}
+        j.close(b)
+        assert j.covered_nonces() == set()
+
+
+class TestNoncePlumbing:
+    def test_preassigned_nonce_nests_and_restores(self):
+        assert jr.current_preassigned_nonce() is None
+        with jr.preassigned_nonce("outer"):
+            assert jr.current_preassigned_nonce() == "outer"
+            with jr.preassigned_nonce("inner"):
+                assert jr.current_preassigned_nonce() == "inner"
+            assert jr.current_preassigned_nonce() == "outer"
+        assert jr.current_preassigned_nonce() is None
+
+    def test_preassigned_nonce_is_thread_local(self):
+        seen = {}
+
+        def peek():
+            seen["other"] = jr.current_preassigned_nonce()
+
+        with jr.preassigned_nonce("mine"):
+            t = threading.Thread(target=peek)
+            t.start()
+            t.join()
+        assert seen["other"] is None
+
+
+class TestKillPoints:
+    def test_catalog_shape(self):
+        # pre + post per (kind, phase) across every machine
+        assert len(KILL_POINTS) == 2 * sum(len(p) for p in MACHINES.values())
+        assert "pre:fleet-launch:open" in KILL_POINTS
+        assert "fleet-launch:open" in KILL_POINTS
+        assert "gang-bind:unwinding" in KILL_POINTS
+        assert "pre:node-delete:instance-deleted" in KILL_POINTS
+        assert len(set(KILL_POINTS)) == len(KILL_POINTS)
+
+    def test_pre_point_crashes_before_durability(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        inject.install(inject.FaultPlan(1, [
+            inject.FaultSpec("journal", "pre:drain:open",
+                             "crash-point", 1)], window=1))
+        with pytest.raises(inject.SimulatedCrash) as e:
+            j.open_intent("drain", node="n1")
+        assert e.value.point == "pre:drain:open"
+        inject.uninstall()
+        j.close_journal()
+        # nothing durable: the restarted journal has no trace of it
+        assert IntentJournal(str(tmp_path), fsync=False).open_intents() == {}
+
+    def test_post_point_crashes_after_durability(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        inject.install(inject.FaultPlan(1, [
+            inject.FaultSpec("journal", "drain:open",
+                             "crash-point", 1)], window=1))
+        with pytest.raises(inject.SimulatedCrash):
+            j.open_intent("drain", node="n1")
+        inject.uninstall()
+        j.close_journal()
+        live = IntentJournal(str(tmp_path), fsync=False).open_intents()
+        assert len(live) == 1
+        intent = next(iter(live.values()))
+        assert intent.kind == "drain" and intent.phase == "open"
+
+    def test_simulated_crash_is_not_an_exception(self):
+        # broad `except Exception` error handling must not survive a
+        # kill point, exactly like a real SIGKILL
+        assert not issubclass(inject.SimulatedCrash, Exception)
+        assert issubclass(inject.SimulatedCrash, BaseException)
+
+    def test_no_plan_is_free(self, tmp_path):
+        j = IntentJournal(str(tmp_path), fsync=False)
+        iid = j.open_intent("drain", node="n1")  # no raise, no plan
+        j.close(iid)

@@ -85,6 +85,13 @@ SERIES = [
     ("global_window_saving_pct",
      lambda l: _dig(l, "extra", "config_14_global_window", "saving_pct"),
      "higher", 0.30),
+    # p99 over 16 sub-10ms replays: single-digit-ms walls jitter 2-4x on
+    # host noise alone, so the tolerance is wide — a real regression
+    # (an fsync leaking onto the replay path, a quadratic ledger scan)
+    # lands 10x+ past the best prior and still fails
+    ("recovery_time_p99_ms",
+     lambda l: _dig(l, "extra", "config_15_crash_recovery", "recovery",
+                    "wall_ms", "p99_ms"), "lower", 2.00),
 ]
 
 # (name, extractor(line) -> bool|None): latest non-None entry must be True
@@ -134,6 +141,17 @@ FLAGS = [
                 else bool(_dig(l, "extra", "config_9_million_pod_replay",
                                "replay", "slo_digest_parity",
                                "within_1pct")))),
+    ("crash_recovery_clean",
+     lambda l: (None if _dig(l, "extra", "config_15_crash_recovery",
+                             "leaks") is None
+                else _dig(l, "extra", "config_15_crash_recovery",
+                          "leaks") == 0
+                and _dig(l, "extra", "config_15_crash_recovery",
+                         "open_intents_after") == 0
+                and _dig(l, "extra", "config_15_crash_recovery",
+                         "recovery", "errors") == 0
+                and (_dig(l, "extra", "config_15_crash_recovery",
+                          "journal_tax", "overhead_pct") or 0.0) <= 1.0)),
 ]
 
 

@@ -39,6 +39,7 @@ REGISTERING_MODULES = [
     "karpenter_tpu.metrics.global_solve",
     "karpenter_tpu.metrics.marshal",
     "karpenter_tpu.metrics.policy",
+    "karpenter_tpu.metrics.recovery",
     "karpenter_tpu.metrics.slo",
     "karpenter_tpu.solver.solve",
     "karpenter_tpu.solver.hedge",
