@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-filter bench-policy bench-global bench-replay bench-replay-smoke bench-history bench-regress replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-crash chaos-overload clean help
+.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-filter bench-policy bench-global bench-topology bench-replay bench-replay-smoke bench-history bench-regress replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-crash chaos-overload clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -50,6 +50,10 @@ bench-policy: ## Device-vectorized policy scoring vs per-cell host loop + spot r
 bench-global: ## Whole-window global solve vs per-schedule FFD fleet cost A/B (config_14); prints verdict line on stderr
 	python bench.py --only config_14 \
 		| python tools/global_verdict.py
+
+bench-topology: ## Torus-grid slice carving: fragmentation harvest, carve kernel vs scalar loop, priced preemption (config_16); prints verdict line on stderr
+	python bench.py --only config_16 \
+		| python tools/topology_verdict.py
 
 bench-replay: ## Million-pod replay across 4 shards + 100k-object store A/B (config_9); verdict + SLO verdict + traceview table on stderr
 	python bench.py --only config_9 \

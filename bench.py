@@ -1902,6 +1902,270 @@ def config_15_crash_recovery():
     }
 
 
+def config_16_topology_carve():
+    """Round-16 gate: torus-grid slice carving + priced preemption
+    (docs/solver.md §19). Five legs over 4x4-torus fleets:
+
+    - fragmentation A/B (grow=False — no fresh capacity): the
+      conservative shape-only baseline can only trust fully-EMPTY nodes
+      (without cell geometry a fragmented torus is unusable — the
+      pre-v18 planner handed slice gangs whole fresh nodes), while the
+      carve-aware walk additionally harvests every node whose free
+      chips form a contiguous sub-slice. Gate: >= 20% more gangs placed
+      on the same saturated fleet. Scatter-fragmented nodes (free chips
+      counted right, contiguity impossible) are the phantom-capacity
+      trap — shape math places gangs there, the carve walk must reject
+      every one (topology_carve_rejects_total);
+    - commit audit: every committed carve is re-validated post hoc —
+      exactly one placement-mask row, disjoint from the replayed
+      occupancy plane. Gate: 0 unverified carves;
+    - kernel throughput: the batched carve jit (gangs x bins x
+      placements in ONE dispatch) vs the scalar host carve loop
+      (ops/topology.scalar_carve — first_carve per cell) on a 64x64
+      window, bit-identical verdicts required. Gate: >= 5x at p50;
+    - priced preemption on a saturated pool: strictly-lower-band
+      victims only, displacement accepted exactly while the summed
+      what-if price stays under the beneficiary's fresh-node cost.
+      Gate: >= 1 executed preemption (non-vacuous), 0 system-critical
+      displacements, the overpriced victim declined fresh-cheaper;
+    - kill switch: KARPENTER_TOPOLOGY_CARVE=0 reads disabled AND an
+      annotation-free encode is bit-for-bit the shape-only encoding
+      (no carve tensors, identical device tensors, identical plan)."""
+    import numpy as np
+
+    from karpenter_tpu.metrics.topology import (
+        PREEMPTION_DECLINED_TOTAL, TOPOLOGY_CARVE_REJECTS_TOTAL,
+    )
+    from karpenter_tpu.ops import topology as topo
+    from karpenter_tpu.ops.gang import GangBin, encode_gang_window
+    from karpenter_tpu.ops.whatif import _reserve_vec
+    from karpenter_tpu.solver import topology as topo_solver
+    from karpenter_tpu.solver.gang import (
+        PreemptCandidate, PreemptContext, plan_gang_window,
+    )
+    from karpenter_tpu.solver.topology import CarveConfig, solve_carve_window
+
+    if not topo_solver.carve_enabled():
+        return {"skipped": "KARPENTER_TOPOLOGY_CARVE=0"}
+
+    GRID = (4, 4)
+    CELLS = 16
+    member_shape = (4000, 8192)  # one pod == one chip-equivalent
+    mvec = [max(v, 1) for v in _reserve_vec(make_pods(1, [member_shape])[0])]
+
+    def chips(n):  # free vector worth exactly n chips of members
+        return [v * n for v in mvec]
+
+    t_names, t_prices = ["tpu-carve-4x4"], [4.0]
+    t_frees, t_grids = [chips(CELLS)], [GRID]
+
+    def gangs_of(n, members, prefix, slice_dims, band="default"):
+        out, slices, bands = [], [], []
+        for i in range(n):
+            pods = make_pods(members, [member_shape])
+            for j, p in enumerate(pods):
+                p.metadata.name = f"{prefix}{i}-m{j}"
+            out.append(((f"bench-{prefix}", f"g{i}"), pods,
+                        np.ones(1, bool), None))
+            slices.append(slice_dims)
+            bands.append(band)
+        return out, slices, bands
+
+    def seed(name, occ):
+        occ = np.asarray(occ, bool)
+        return GangBin(name=name, type_index=0,
+                       free=chips(int(CELLS - occ.sum())),
+                       grid=GRID, occ=occ, node_name=name)
+
+    def plan_sig(plan):
+        return sorted((pl.gang.key,
+                       [(bi, [p.metadata.name for p in pods])
+                        for bi, pods in pl.node_sets])
+                      for pl in plan.placements)
+
+    # --- leg 1: fragmentation A/B ------------------------------------
+    # E empty nodes; C contiguous-fragmented (rows 0-1 busy, a clean 2x4
+    # slab free); S scatter-fragmented (checkerboard: 8 free chips, no
+    # contiguous 2x4 exists even with torus wrap)
+    E, C, S = 4, 8, 8
+    rows01 = np.zeros(CELLS, bool)
+    rows01[:8] = True
+    checker = np.array([(r + c) % 2 == 0 for r in range(4)
+                        for c in range(4)])
+
+    def fleet(kinds):
+        out = []
+        for i in range(E):
+            if "empty" in kinds:
+                out.append(seed(f"n-empty-{i}", np.zeros(CELLS, bool)))
+        for i in range(C):
+            if "contig" in kinds:
+                out.append(seed(f"n-contig-{i}", rows01))
+        for i in range(S):
+            if "scatter" in kinds:
+                out.append(seed(f"n-scatter-{i}", checker))
+        return out
+
+    G = 24
+    gangs, slices, bands = gangs_of(G, 8, "frag", (2, 4))
+    rej0 = sum(TOPOLOGY_CARVE_REJECTS_TOTAL.collect().values())
+    enc_carve = encode_gang_window(
+        gangs, t_frees, t_prices, t_names, slices=slices, bands=bands,
+        type_grids=t_grids, seed_bins=fleet({"empty", "contig", "scatter"}),
+        grow=False)
+    plan_carve = plan_gang_window(enc_carve)
+    carve_placed = len(plan_carve.placements)
+    carve_rejects = sum(TOPOLOGY_CARVE_REJECTS_TOTAL.collect().values()) - rej0
+
+    # commit audit: replay every committed carve cell-by-cell
+    unverified = 0
+    replay: dict = {}
+    for pl in plan_carve.placements:
+        for bi, cells in pl.carves.items():
+            bn = enc_carve.bins[bi]
+            base = replay.setdefault(bi, (bn.occ.copy() if bn.occ is not None
+                                          else np.zeros(CELLS, bool)))
+            want = np.zeros(CELLS, bool)
+            want[list(cells)] = True
+            masks = topo.placement_masks(bn.grid, pl.gang.slice_dims)
+            row_ok = masks is not None and any(
+                np.array_equal(row, want) for row in masks)
+            if not row_ok or base[list(cells)].any():
+                unverified += 1
+            base[list(cells)] = True
+
+    # shape-only conservative baseline: empty nodes only, no carve plumbing
+    gangs_a, _, _ = gangs_of(G, 8, "frag", None)
+    shape_bins = [GangBin(name=s.name, type_index=0, free=list(s.free),
+                          node_name=s.name) for s in fleet({"empty"})]
+    enc_shape = encode_gang_window(gangs_a, t_frees, t_prices, t_names,
+                                   seed_bins=shape_bins, grow=False)
+    shape_placed = len(plan_gang_window(enc_shape).placements)
+    gain_pct = round(100.0 * (carve_placed - shape_placed)
+                     / (shape_placed or 1), 2)
+
+    # phantom illustration: naive shape-only over the WHOLE fleet happily
+    # lands gangs on scatter bins — capacity that does not exist
+    gangs_n, _, _ = gangs_of(G, 8, "frag", None)
+    naive_bins = [GangBin(name=s.name, type_index=0, free=list(s.free),
+                          node_name=s.name)
+                  for s in fleet({"empty", "contig", "scatter"})]
+    enc_naive = encode_gang_window(gangs_n, t_frees, t_prices, t_names,
+                                   seed_bins=naive_bins, grow=False)
+    phantom = sum(
+        1 for pl in plan_gang_window(enc_naive).placements
+        if any(enc_naive.bins[bi].name.startswith("n-scatter")
+               for bi, _ in pl.node_sets))
+
+    # --- leg 2: kernel vs scalar host carve loop ---------------------
+    KG, KB = 64, 64
+    kgangs, kslices, kbands = gangs_of(KG, 4, "kern", (2, 2))
+    kseeds = []
+    for j in range(KB):
+        occ = np.zeros(CELLS, bool)
+        occ[[(j * 7 + 3 * k) % CELLS for k in range(j % 10)]] = True
+        kseeds.append(seed(f"n-kern-{j}", occ))
+    enc_k = encode_gang_window(
+        kgangs, t_frees, t_prices, t_names, slices=kslices, bands=kbands,
+        type_grids=t_grids, seed_bins=kseeds, grow=False)
+    kcfg = CarveConfig(device_min_cells=0)
+    verdict_dev, kexec = solve_carve_window(enc_k, kcfg)  # warm: jit+ring
+    verdict_scalar = topo.scalar_carve(enc_k)
+    divergence = int((verdict_dev != verdict_scalar).sum())
+    kernel_times = run_timed(lambda: solve_carve_window(enc_k, kcfg),
+                             budget_s=10.0)
+    scalar_times = run_timed(lambda: topo.scalar_carve(enc_k),
+                             budget_s=15.0)
+    st_k, st_s = _stats(kernel_times), _stats(scalar_times)
+    speedup = round(st_s["p50_ms"] / max(st_k["p50_ms"], 1e-9), 2)
+
+    # --- leg 3: priced preemption on a saturated pool ----------------
+    # three saturated nodes, each half-held by a displaceable resident:
+    # bin 0 low band at $0.25 (cheap — fires), bin 1 system-critical
+    # (must never fire), bin 2 low band at $10 > the $4 fresh node
+    # (declined fresh-cheaper, beneficiary falls through to growth)
+    sat = [seed(f"p-sat-{i}", np.ones(CELLS, bool)) for i in range(3)]
+    half = list(range(8))
+
+    def victim(bi, band, cost):
+        return PreemptCandidate(
+            gang_key=("bench-victim", f"v{bi}"), bin_index=bi,
+            node=f"p-sat-{bi}", band=band,
+            pods=[("default", f"v{bi}-m{k}") for k in range(8)],
+            cells=np.array(half), refund=chips(8),
+            displacement_cost=cost)
+
+    ctx = PreemptContext(candidates=[
+        victim(0, "low", 0.25), victim(1, "system-critical", 0.0),
+        victim(2, "low", 10.0)])
+    pgangs, pslices, pbands = gangs_of(2, 8, "pre", (2, 4), band="high")
+    enc_p = encode_gang_window(
+        pgangs, t_frees, t_prices, t_names, slices=pslices, bands=pbands,
+        type_grids=t_grids, seed_bins=sat, grow=True)
+    dec0 = dict(PREEMPTION_DECLINED_TOTAL.collect())
+    plan_p = plan_gang_window(enc_p, preempt=ctx)
+    dec1 = PREEMPTION_DECLINED_TOTAL.collect()
+    declines = {dict(k).get("reason", "?"): dec1[k] - dec0.get(k, 0.0)
+                for k in dec1 if dec1[k] - dec0.get(k, 0.0) > 0}
+    sc_preempts = sum(1 for _, c in plan_p.preemptions
+                      if c.band == "system-critical")
+    displaced = sum(len(c.pods) for _, c in plan_p.preemptions)
+    fresh_fallback = sum(  # the priced-out gang must land on growth
+        1 for pl in plan_p.placements
+        if all(enc_p.bins[bi].node_name is None for bi, _ in pl.node_sets))
+
+    # --- leg 4: kill switch ------------------------------------------
+    prev = os.environ.get("KARPENTER_TOPOLOGY_CARVE")
+    try:
+        os.environ["KARPENTER_TOPOLOGY_CARVE"] = "0"
+        killswitch_gate = not topo_solver.carve_enabled()
+    finally:
+        if prev is None:
+            os.environ.pop("KARPENTER_TOPOLOGY_CARVE", None)
+        else:
+            os.environ["KARPENTER_TOPOLOGY_CARVE"] = prev
+    ks_a, _, _ = gangs_of(6, 4, "ks", None)
+    ks_b, sl_b, bd_b = gangs_of(6, 4, "ks", None)
+    enc_a = encode_gang_window(ks_a, t_frees, t_prices, t_names)
+    enc_b = encode_gang_window(ks_b, t_frees, t_prices, t_names,
+                               slices=sl_b, bands=bd_b, type_grids=t_grids)
+    tensors_equal = all(
+        (x is None and y is None) or
+        (x is not None and y is not None and np.array_equal(x, y))
+        for x, y in ((enc_a.d_pods, enc_b.d_pods),
+                     (enc_a.d_valid, enc_b.d_valid),
+                     (enc_a.d_compat, enc_b.d_compat),
+                     (enc_a.d_free0, enc_b.d_free0)))
+    parity = (enc_b.carve is None and tensors_equal
+              and plan_sig(plan_gang_window(enc_a))
+              == plan_sig(plan_gang_window(enc_b)))
+
+    return {
+        "gangs": G, "seed_nodes": E + C + S, "empty_nodes": E,
+        "frag_contiguous": C, "frag_scattered": S,
+        "shape_only_placed": int(shape_placed),
+        "carve_placed": int(carve_placed),
+        "gain_pct": gain_pct,
+        "phantom_gangs_naive": int(phantom),
+        "carve_rejects": int(carve_rejects),
+        "unverified": int(unverified),
+        "kernel_gangs": KG, "kernel_bins": KB,
+        "kernel_executor": kexec,
+        "kernel_divergence": divergence,
+        "kernel_p50_ms": st_k["p50_ms"], "kernel_p99_ms": st_k["p99_ms"],
+        "scalar_p50_ms": st_s["p50_ms"], "scalar_p99_ms": st_s["p99_ms"],
+        "speedup": speedup,
+        "preemptions": len(plan_p.preemptions),
+        "system_critical_preemptions": int(sc_preempts),
+        "displaced_pods": int(displaced),
+        "preempt_declines": declines,
+        "preempt_fresh_fallback": int(fresh_fallback),
+        "preempt_placed": len(plan_p.placements),
+        "killswitch_gate": bool(killswitch_gate),
+        "killswitch_parity": bool(parity),
+    }
+
+
 def jax_devices_first():
     import jax
 
@@ -2318,6 +2582,7 @@ def run_all(degraded: bool, probe_note: str = ""):
         ("config_13_policy_scoring", config_13_policy_scoring),
         ("config_14_global_window", config_14_global_window),
         ("config_15_crash_recovery", config_15_crash_recovery),
+        ("config_16_topology_carve", config_16_topology_carve),
     ):
         if not _selected(key, only):
             continue

@@ -25,7 +25,8 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from itertools import product
+from typing import Iterator, Optional, Sequence, Tuple
 
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.core import Pod
@@ -51,6 +52,27 @@ class SliceShape:
         for d in self.dims:
             n *= d
         return n
+
+    @property
+    def grid(self) -> Tuple[int, ...]:
+        """The chip grid this shape spans — the torus the carving engine
+        (ops/topology.py) models. An alias of ``dims`` with the physical
+        reading made explicit: axis i has ``dims[i]`` chips and its ICI
+        links wrap (TPU pods close every axis into a ring)."""
+        return self.dims
+
+    def coords(self) -> Iterator[Tuple[int, ...]]:
+        """Every chip coordinate of the grid in row-major order — the cell
+        enumeration the occupancy bit-planes flatten over."""
+        return product(*(range(d) for d in self.dims))
+
+    def flat_index(self, coord: Sequence[int]) -> int:
+        """Row-major flat cell index of one chip coordinate (the inverse of
+        the ``coords()`` enumeration order)."""
+        idx = 0
+        for c, d in zip(coord, self.dims):
+            idx = idx * d + (c % d)
+        return idx
 
     def __str__(self) -> str:
         return f"{self.family}-" + "x".join(str(d) for d in self.dims)

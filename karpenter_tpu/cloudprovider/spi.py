@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import abc
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from karpenter_tpu.api.constraints import Constraints
 from karpenter_tpu.api.core import Node
@@ -89,6 +89,21 @@ class InstanceType:
     # carrying a pod-group-slice label only land on types whose topology
     # contains the requested shape (api/gang.py, ops/feasibility.py).
     tpu_topology: str = ""
+
+    def grid_dims(self) -> Optional[Tuple[int, ...]]:
+        """Chip-grid dimensions of the advertised TPU topology — the
+        per-type torus the carving engine (ops/topology.py) models
+        occupancy over — or None when the type hosts no slices. Parsed
+        once and cached on the instance, same idiom as
+        api/gang.instance_slice_shape."""
+        cached = self.__dict__.get("_grid_dims", False)
+        if cached is not False:
+            return cached
+        from karpenter_tpu.api.gang import instance_slice_shape
+        shape = instance_slice_shape(self)
+        dims = shape.dims if shape is not None else None
+        self.__dict__["_grid_dims"] = dims
+        return dims
 
 
 BindCallback = Callable[[Node], Optional[str]]

@@ -72,8 +72,11 @@ class Options:
     # global. "global" solves the whole window jointly as one batched
     # ADMM relaxation with FFD as the exact rounding oracle and the
     # bit-for-bit fallback; pressure L1+ and gang schedules keep FFD, and
-    # KARPENTER_GLOBAL_SOLVE=0 kills the global path regardless.
-    window_backend: str = "ffd"
+    # KARPENTER_GLOBAL_SOLVE=0 kills the global path regardless. Default
+    # since PR 18 (docs/solver.md §18): the relaxation only replaces FFD
+    # plans it strictly beats in exact micro-$, so the flip is cost-
+    # monotone; --window-backend=ffd restores the previous behavior.
+    window_backend: str = "global"
     # JAX persistent compilation cache dir ("" disables): restarts re-load
     # compiled programs instead of re-lowering them
     solver_compile_cache_dir: str = ""
@@ -295,11 +298,12 @@ def parse(argv: Optional[List[str]] = None) -> Options:
                         "($/h); 0 lets the what-if engine price each chunk")
     p.add_argument("--window-backend", choices=["ffd", "global"],
                    default=_env("window-backend", defaults.window_backend),
-                   help="provisioning-window packing backend: ffd "
-                        "(per-schedule greedy batch, the default) | global "
+                   help="provisioning-window packing backend: global "
                         "(whole-window ADMM relaxation with FFD as the "
                         "exact rounding oracle and bit-for-bit fallback; "
-                        "L1+ pressure and gang schedules keep ffd)")
+                        "the default — L1+ pressure and gang schedules "
+                        "keep ffd) | ffd (per-schedule greedy batch, the "
+                        "pre-v18 default)")
     p.add_argument("--solver-compile-cache-dir",
                    default=_env("solver-compile-cache-dir",
                                 defaults.solver_compile_cache_dir),

@@ -61,11 +61,19 @@ from karpenter_tpu.runtime.kubecore import (
 )
 from karpenter_tpu.scheduling.batcher import Batcher
 from karpenter_tpu.scheduling.scheduler import Scheduler
-from karpenter_tpu.ops.gang import GangEncoding, encode_gang_window
+from karpenter_tpu.metrics.topology import (
+    PREEMPTION_DISPLACED_PODS_TOTAL, PREEMPTIONS_TOTAL,
+    TOPOLOGY_CARVE_WINDOWS_TOTAL, TOPOLOGY_CARVES_COMMITTED_TOTAL,
+)
+from karpenter_tpu.ops import topology as topo_ops
+from karpenter_tpu.ops.gang import GangBin, GangEncoding, encode_gang_window
+from karpenter_tpu.pressure.bands import RANK
 from karpenter_tpu.solver import global_solve
+from karpenter_tpu.solver import topology as topo_solver
 from karpenter_tpu.solver.batch_solve import Problem, dispatch_batch
 from karpenter_tpu.solver.gang import (
-    GangConfig, GangPlacement, dispatch_gang_window, plan_gang_window,
+    GangConfig, GangPlacement, PreemptCandidate, PreemptContext,
+    dispatch_gang_window, plan_gang_window,
 )
 from karpenter_tpu.solver.pipeline import PipelineConfig, SolvePipeline
 from karpenter_tpu.solver.solve import SolveResult, SolverConfig
@@ -450,6 +458,11 @@ class ProvisionerWorker:
         prep = _ChunkPrep(schedules=schedules, problems=problems, pods=pods)
         if gang_scheds:
             prep.gang_enc, prep.gang_types = self._encode_gangs(gang_scheds)
+            # seed bins ARE real nodes: pre-binding their bin→node names
+            # makes _launch_gang bind onto them without creating anything
+            for bi, bn in enumerate(prep.gang_enc.bins):
+                if bn.node_name:
+                    prep.gang_nodes[bi] = bn.node_name
         prep.solver_config = self._chunk_solver_config(prep)
         return prep
 
@@ -530,12 +543,79 @@ class ProvisionerWorker:
             segments.append((s, base, seg_mask))
         n = len(type_frees)
         gangs = []
+        slice_dims: list = []
+        gang_bands: list = []
         for s, base, seg_mask in segments:
             mask = np.zeros(n, bool)
             mask[base:base + len(seg_mask)] = seg_mask
             gangs.append((s.gang.key, s.pods, mask, s))
-        enc = encode_gang_window(gangs, type_frees, type_prices, type_names)
+            slice_dims.append(s.gang.slice_.dims
+                              if s.gang.slice_ is not None else None)
+            # the gang's band is its highest-priority member's: one
+            # critical member makes the whole group preemption-proof
+            gang_bands.append(min(
+                (pressure.classify(p)[0] for p in s.pods),
+                key=lambda b: RANK.get(b, RANK["default"]),
+                default="default"))
+        if (topo_solver.carve_enabled()
+                and any(d is not None for d in slice_dims)):
+            # carve mode: annotate the window with slice grids, bands,
+            # per-type torus dims, and the ledger's partially-carved real
+            # nodes as seed bins. With the kill switch off, NONE of these
+            # reach the encoder and the window is bit-for-bit shape-only.
+            type_grids = [it.grid_dims() for _s, it in type_ctx]
+            enc = encode_gang_window(
+                gangs, type_frees, type_prices, type_names,
+                slices=slice_dims, bands=gang_bands,
+                type_grids=type_grids,
+                seed_bins=self._gang_seed_bins(type_ctx))
+        else:
+            enc = encode_gang_window(gangs, type_frees, type_prices,
+                                     type_names)
         return enc, type_ctx
+
+    def _gang_seed_bins(self, type_ctx) -> List[GangBin]:
+        """Re-offer the occupancy ledger's partially-carved Ready nodes to
+        the gang window as seed bins. A node matches by (instance type
+        name, constraints signature) against the window's own type axis,
+        so a seed only ever hosts gangs whose labels/taints the node
+        already carries — the same isolation the segment masks give fresh
+        bins. Free capacity is the node's LIVE residual (allocatable minus
+        running pods), so shape math and carve cells stay consistent."""
+        topo_ops.LEDGER.prune(
+            [n.metadata.name for n in self.kube.list("Node")])
+        snap = topo_ops.LEDGER.snapshot()
+        if not snap:
+            return []
+        from karpenter_tpu.models.consolidate import free_capacity_vector
+        index_of: Dict[Tuple[str, tuple], int] = {}
+        sig_of: Dict[int, tuple] = {}
+        for ti, (s, it) in enumerate(type_ctx):
+            sig = sig_of.get(id(s))
+            if sig is None:
+                sig = topo_ops.constraints_sig(s.constraints.labels,
+                                               s.constraints.taints)
+                sig_of[id(s)] = sig
+            index_of.setdefault((it.name, sig), ti)
+        seeds: List[GangBin] = []
+        for ng in snap:
+            ti = index_of.get((ng.type_name, ng.labels_sig))
+            if ti is None:
+                continue
+            try:
+                node = self.kube.get("Node", ng.node, "")
+            except NotFound:
+                continue
+            if (node.metadata.deletion_timestamp is not None
+                    or not nodeutil.is_ready(node)):
+                continue
+            free = free_capacity_vector(
+                node, self.kube.pods_on_node(ng.node))
+            seeds.append(GangBin(
+                name=ng.node, type_index=ti,
+                free=[max(f, 0) for f in free],
+                grid=ng.dims, occ=ng.occ.copy(), node_name=ng.node))
+        return seeds
 
     def _dispatch_chunk(self, prep: _ChunkPrep):
         """ALL the chunk's schedules pack in one batched device call (one
@@ -606,6 +686,8 @@ class ProvisionerWorker:
         them on the next pass."""
         enc = prep.gang_enc
         GANG_WINDOWS_TOTAL.inc()
+        if enc.carve is not None:
+            TOPOLOGY_CARVE_WINDOWS_TOTAL.inc()
         for key, reason in enc.skipped:
             GANGS_UNPLACEABLE_TOTAL.inc(reason="no-type")
             log.info("gang %s unplaceable: %s window_id=%s shard=%s",
@@ -616,20 +698,158 @@ class ProvisionerWorker:
             log.info("gang window solved: %d gang(s) executor=%s "
                      "window_id=%s shard=%s", enc.g, executor,
                      self._window_id, self.shard or "0")
-        plan = plan_gang_window(enc, feasible)
+        preempt = None
+        if enc.carve is not None:
+            preempt = self._build_preempt_context(prep)
+        plan = plan_gang_window(enc, feasible, preempt)
         for e, reason in plan.unplaced:
             GANGS_UNPLACEABLE_TOTAL.inc(reason=reason)
             log.info("gang %s unplaceable: %s window_id=%s shard=%s",
                      e.key, reason, self._window_id, self.shard or "0")
+        pre_of: Dict[int, List[PreemptCandidate]] = {}
+        for e, cand in plan.preemptions:
+            pre_of.setdefault(e.index, []).append(cand)
         for placement in plan.placements:
+            # victims unbind and requeue BEFORE their beneficiary binds:
+            # the carve cells and resource refund the planner charged for
+            # must be real by the time bind_pods lands
+            for cand in pre_of.pop(placement.gang.index, []):
+                self._execute_preemption(cand)
             err = self._launch_gang(prep, placement)
             if err is None:
                 GANGS_PLACED_TOTAL.inc()
+                self._commit_carves(prep, placement)
             else:
                 GANGS_UNPLACEABLE_TOTAL.inc(reason="bind-failed")
                 log.error("gang %s bind failed (unwound): %s window_id=%s "
                           "shard=%s", placement.gang.key, err,
                           self._window_id, self.shard or "0")
+
+    def _build_preempt_context(self, prep: _ChunkPrep
+                               ) -> Optional[PreemptContext]:
+        """Price every displaceable resident of the window's seed bins.
+        System-critical residents are never offered; everyone else is
+        priced through solver/policy.whatif_repack_cost — ~0 when the
+        victim's members refit on the fleet's existing free capacity,
+        else the cheapest replacement node's $/h — so the planner preempts
+        exactly when displacement is cheaper than a fresh node."""
+        enc = prep.gang_enc
+        seeds = [(bi, bn) for bi, bn in enumerate(enc.bins)
+                 if bn.node_name]
+        if not seeds:
+            return None
+        from karpenter_tpu.models.consolidate import (
+            NANO, free_capacity_vector)
+        from karpenter_tpu.solver.adapter import pod_vector
+        from karpenter_tpu.solver.host_ffd import R_PODS
+        from karpenter_tpu.solver.policy import whatif_repack_cost
+        by_node = {ng.node: ng for ng in topo_ops.LEDGER.snapshot()}
+        free_vecs: Optional[list] = None
+        cands: List[PreemptCandidate] = []
+        for bi, bn in seeds:
+            ng = by_node.get(bn.node_name)
+            if ng is None:
+                continue
+            sched, _it = prep.gang_types[bn.type_index]
+            seg_types = [it for s2, it in prep.gang_types if s2 is sched]
+            for rec in ng.carves.values():
+                if rec.band == "system-critical":
+                    continue
+                vecs, live = [], []
+                refund = [0] * len(bn.free)
+                for pns, pname in rec.pods:
+                    try:
+                        p = self.kube.get("Pod", pname, pns)
+                    except NotFound:
+                        continue
+                    v = pod_vector(p)
+                    vecs.append(v)
+                    refund = [a + b for a, b in zip(refund, v)]
+                    refund[R_PODS] += NANO  # the pod slot comes back too
+                    live.append((pns, pname))
+                if free_vecs is None:
+                    free_vecs = []
+                    for node in self.kube.list("Node"):
+                        if node.metadata.deletion_timestamp is not None:
+                            continue
+                        if not nodeutil.is_ready(node):
+                            continue
+                        free_vecs.append(free_capacity_vector(
+                            node,
+                            self.kube.pods_on_node(node.metadata.name)))
+                cost = (whatif_repack_cost(
+                    vecs, free_vecs, seg_types,
+                    sched.constraints.requirements,
+                    self.solver_config.cost_config) if vecs else 0.0)
+                cands.append(PreemptCandidate(
+                    gang_key=rec.gang_key, bin_index=bi, node=ng.node,
+                    band=rec.band, pods=live, cells=rec.cells.copy(),
+                    refund=refund, displacement_cost=cost))
+        return PreemptContext(cands) if cands else None
+
+    def _execute_preemption(self, cand: PreemptCandidate) -> None:
+        """Displace one resident gang: unbind its members, release its
+        ledger carves, and requeue the whole group atomically through the
+        band-aware batcher (shed-proof — the members were running). The
+        requeued items route to the default engine; a multi-engine shard's
+        selection requeue re-offers any that miss their window."""
+
+        def clear(obj):
+            if getattr(obj.spec, "node_name", ""):
+                obj.spec.node_name = ""
+            else:
+                raise _NoChange
+
+        entries = []
+        for pns, pname in cand.pods:
+            try:
+                self.kube.patch("Pod", pname, pns, clear)
+            except (_NoChange, NotFound):
+                pass
+            try:
+                p = self.kube.get("Pod", pname, pns)
+            except NotFound:
+                continue
+            band, priority = pressure.classify(p)
+            gspec = gang_of(p)
+            gang = ((gspec.key, gspec.size)
+                    if gspec is not None and not gspec.error else None)
+            entries.append(((None, p), (pns, pname), band, priority, gang))
+        if entries:
+            self.batcher.requeue_displaced(entries)
+        topo_ops.LEDGER.release_gang(cand.gang_key)
+        PREEMPTIONS_TOTAL.inc(band=cand.band)
+        if entries:
+            PREEMPTION_DISPLACED_PODS_TOTAL.inc(amount=float(len(entries)))
+        log.info("preempted gang %s on %s: band=%s %d pod(s) requeued "
+                 "displacement=$%.4f/h window_id=%s shard=%s",
+                 cand.gang_key, cand.node, cand.band, len(entries),
+                 cand.displacement_cost, self._window_id, self.shard or "0")
+
+    def _commit_carves(self, prep: _ChunkPrep,
+                       placement: GangPlacement) -> None:
+        """Record a bound slice gang's carve cells in the occupancy
+        ledger so later windows seed its nodes' residual grids back into
+        the pool (and can price this gang as a preemption victim)."""
+        if not placement.carves:
+            return
+        enc = prep.gang_enc
+        schedule = placement.gang.context
+        sig = topo_ops.constraints_sig(schedule.constraints.labels,
+                                       schedule.constraints.taints)
+        members = {bi: [(p.metadata.namespace, p.metadata.name)
+                        for p in pods]
+                   for bi, pods in placement.node_sets}
+        for bi, cells in placement.carves.items():
+            node = prep.gang_nodes.get(bi)
+            bn = enc.bins[bi]
+            if node is None or bn.grid is None:
+                continue
+            _s, itype = prep.gang_types[bn.type_index]
+            topo_ops.LEDGER.commit(
+                node, bn.grid, itype.name, sig, placement.gang.key,
+                cells, placement.gang.band, members.get(bi, []))
+            TOPOLOGY_CARVES_COMMITTED_TOTAL.inc()
 
     def _launch_gang(self, prep: _ChunkPrep,
                      placement: GangPlacement) -> Optional[str]:

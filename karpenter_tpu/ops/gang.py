@@ -44,12 +44,20 @@ Vec = Tuple[int, ...]
 
 @dataclass
 class GangBin:
-    """One prospective node: an empty instance of ``type_index`` whose free
-    vector is the type's allocatable after overhead + daemon reserve."""
+    """One candidate node of the window pool. Prospective bins (the
+    default) are empty instances of ``type_index`` whose free vector is
+    the type's allocatable after overhead + daemon reserve; SEED bins
+    (``node_name`` set) are real partially-occupied nodes re-offered by
+    the occupancy ledger — placing there binds to the existing node, no
+    create. ``grid``/``occ`` carry the type's torus dimensions and the
+    bin's occupancy bit-plane when carving is on (ops/topology.py)."""
 
     name: str
     type_index: int
     free: List[int]
+    grid: Optional[Tuple[int, ...]] = None
+    occ: Optional[np.ndarray] = None        # (cells,) bool
+    node_name: Optional[str] = None         # existing node; None = fresh
 
 
 @dataclass
@@ -62,6 +70,12 @@ class EncodedGang:
     vecs: List[Vec]               # reserve vectors, sorted desc (cpu, mem)
     type_mask: np.ndarray         # (T,) group feasibility over instance types
     context: Any = None           # caller payload (Schedule), carried through
+    slice_dims: Optional[Tuple[int, ...]] = None  # declared slice grid
+    band: str = "default"         # pressure band (preemption ordering)
+    # $/h of the fresh node(s) the cheapest feasible type would cost this
+    # gang alone — the preemption pricing comparator; None = no fresh
+    # capacity possible (displacement is then the only path)
+    fresh_cost: Optional[float] = None
 
 
 @dataclass
@@ -81,6 +95,9 @@ class GangEncoding:
     d_free0: Optional[np.ndarray] = None    # (BB, R) int32, scaled
     scales: Optional[Tuple[int, ...]] = None
     skipped: List[Tuple[Any, str]] = field(default_factory=list)
+    # carve tensors when any gang declares a slice (ops/topology.py);
+    # None = carve-neutral window, bit-for-bit the shape-only behavior
+    carve: Optional[Any] = None
 
     @property
     def device_ready(self) -> bool:
@@ -121,6 +138,11 @@ def encode_gang_window(
     type_names: Sequence[str],
     max_cells: int = MAX_WINDOW_CELLS,
     max_bins: int = 4096,
+    slices: Optional[Sequence[Optional[Tuple[int, ...]]]] = None,
+    bands: Optional[Sequence[str]] = None,
+    type_grids: Optional[Sequence[Optional[Tuple[int, ...]]]] = None,
+    seed_bins: Optional[Sequence[GangBin]] = None,
+    grow: bool = True,
 ) -> GangEncoding:
     """Encode one window.
 
@@ -130,13 +152,20 @@ def encode_gang_window(
     (daemons overflow it). A gang with no viable type — empty mask, no
     type that fits its largest member — is recorded in ``skipped`` with a
     reason and excluded from the tensors; a partial answer beats no window.
-    """
+
+    Carving (all optional — omitted, the window is bit-for-bit the
+    shape-only encoding): ``slices[i]``/``bands[i]`` annotate gang i with
+    its declared slice grid and pressure band; ``type_grids[t]`` is type
+    t's torus dimensions; ``seed_bins`` are real partially-occupied nodes
+    from the occupancy ledger, entering the pool FIRST so first-fit reuses
+    live fragmented capacity before opening fresh nodes. ``grow=False``
+    suppresses fresh-bin growth entirely (saturated-pool benches)."""
     encoded: List[EncodedGang] = []
-    bins: List[GangBin] = []
+    bins: List[GangBin] = list(seed_bins or [])
     skipped: List[Tuple[Any, str]] = []
     bins_per_type: dict = {}  # type_index → bin count already materialized
 
-    for key, pods, type_mask, context in gangs:
+    for gi, (key, pods, type_mask, context) in enumerate(gangs):
         # sort members desc (cpu, mem) keeping the pod association: slots[i]
         # names the bin for pods[i] all the way through bind
         pairs = sorted(((_reserve_vec(p), p) for p in pods),
@@ -157,22 +186,29 @@ def encode_gang_window(
             if need is not None:
                 chosen = t
                 break
-        if chosen is None:
+        if chosen is None and grow:
             skipped.append((key, "members exceed every feasible type"))
             continue
-        # grow the shared pool so this gang could place alone on its chosen
-        # type even after earlier gangs consumed their own replicas
-        have = bins_per_type.get(chosen, 0)
-        grow = need  # one gang's worth; sharing leftovers is a bonus
-        for i in range(grow):
-            bins.append(GangBin(
-                name=f"{type_names[chosen]}~{have + i}",
-                type_index=chosen,
-                free=list(type_frees[chosen])))
-        bins_per_type[chosen] = have + grow
+        if chosen is not None and grow:
+            # grow the shared pool so this gang could place alone on its
+            # chosen type even after earlier gangs consumed their replicas
+            have = bins_per_type.get(chosen, 0)
+            for i in range(need):
+                bins.append(GangBin(
+                    name=f"{type_names[chosen]}~{have + i}",
+                    type_index=chosen,
+                    free=list(type_frees[chosen]),
+                    grid=(type_grids[chosen] if type_grids is not None
+                          else None)))
+            bins_per_type[chosen] = have + need
         encoded.append(EncodedGang(
             index=len(encoded), key=key, pods=list(pods), vecs=vecs,
-            type_mask=np.asarray(type_mask, bool), context=context))
+            type_mask=np.asarray(type_mask, bool), context=context,
+            slice_dims=(tuple(slices[gi]) if slices is not None
+                        and slices[gi] is not None else None),
+            band=(bands[gi] if bands is not None else "default"),
+            fresh_cost=(type_prices[chosen] * need
+                        if chosen is not None else None)))
         if len(bins) > max_bins:
             break
 
@@ -193,10 +229,10 @@ def encode_gang_window(
         cols[r].extend(v[r] for e in encoded for v in e.vecs)
     scales = _gcd_scale_signed(cols)
     if scales is None:
-        return enc  # values overflow int32 even scaled: host path only
+        return _attach_carve(enc)  # int32 overflow: host path only
     gb, kb, bb = _pow2(g), _pow2(k), _pow2(b)
     if gb * kb * bb > max_cells:
-        return enc
+        return _attach_carve(enc)
     d_pods = np.zeros((gb, kb, NUM_RESOURCES), np.int32)
     d_valid = np.zeros((gb, kb), bool)
     d_compat = np.zeros((gb, bb), bool)
@@ -213,23 +249,40 @@ def encode_gang_window(
     enc.d_pods, enc.d_valid, enc.d_compat, enc.d_free0 = (
         d_pods, d_valid, d_compat, d_free0)
     enc.scales = scales
+    return _attach_carve(enc)
+
+
+def _attach_carve(enc: GangEncoding) -> GangEncoding:
+    """Build the carve tensors when any gang declares a slice; padded to
+    the gang window's own device axes so the (G, B) carve verdict ANDs
+    straight into ``d_compat`` on device."""
+    from karpenter_tpu.ops.topology import encode_carve
+
+    gb = enc.d_compat.shape[0] if enc.d_compat is not None else None
+    bb = enc.d_compat.shape[1] if enc.d_compat is not None else None
+    enc.carve = encode_carve(enc, gb=gb, bb=bb)
     return enc
 
 
-def host_gang(enc: GangEncoding) -> Tuple[np.ndarray, np.ndarray]:
+def host_gang(enc: GangEncoding,
+              carve_ok: Optional[np.ndarray] = None
+              ) -> Tuple[np.ndarray, np.ndarray]:
     """Exact host mirror of the device kernel: per gang, first-fit its
     members into a PRIVATE copy of the full pool (each gang judged
     independently, as vmap does). Returns (feasible (G,), slots (G, K))
-    with -1 for unplaced/padded members. Nano ints, no scaling."""
+    with -1 for unplaced/padded members. Nano ints, no scaling.
+    ``carve_ok`` ((G, B) bool) mirrors the device composition: the carve
+    verdict ANDs into compat before the first-fit scan."""
     feasible = np.zeros(enc.g, bool)
     slots = np.full((enc.g, enc.k), -1, np.int64)
+    compat = enc.compat if carve_ok is None else (enc.compat & carve_ok)
     for e in enc.gangs:
         free = [list(bn.free) for bn in enc.bins]
         ok = True
         for ki, vec in enumerate(e.vecs):
             placed = False
             for bi in range(enc.b):
-                if not enc.compat[e.index, bi]:
+                if not compat[e.index, bi]:
                     continue
                 if all(free[bi][r] >= vec[r] for r in range(NUM_RESOURCES)):
                     for r in range(NUM_RESOURCES):
@@ -250,35 +303,89 @@ def verify_and_commit_gang(
     enc: GangEncoding,
     gang_index: int,
     free_state: List[List[int]],
+    occ_state: Optional[List[Optional[np.ndarray]]] = None,
+    carves_out: Optional[dict] = None,
+    bin_limit: Optional[int] = None,
 ) -> Optional[List[int]]:
     """Exact host re-verification of one gang against the window's RUNNING
     pool state: first-fit every member on nano ints into a trial copy;
     commit the trial (mutating ``free_state``) only when every member
     lands. Returns the member→bin assignment or None (state untouched).
     This is the only path to a gang bind — the device verdict never
-    commits anything by itself."""
+    commits anything by itself.
+
+    Carving (``occ_state`` set, per-bin running occupancy planes or None
+    for gridless bins): a slice-shaped gang must additionally carve ONE
+    contiguous torus sub-grid of its declared shape on every bin it
+    touches, verified CELL BY CELL by the scalar oracle
+    (ops/topology.first_carve) against the running plane. A bin whose
+    resources fit but whose free chips form no contiguous sub-grid is
+    REJECTED — that is the phantom capacity the shape-only gate admitted.
+    Committed carve cells land in ``carves_out[bin] = cells`` and the
+    occupancy planes advance with the pool state.
+
+    ``bin_limit`` restricts the walk to ``bins[:bin_limit]`` — the seed
+    (real node) prefix — so the planner can price live-capacity placement
+    and preemption against opening fresh nodes."""
+    from karpenter_tpu.ops.topology import first_carve, grid_cells
+
     e = enc.gangs[gang_index]
+    carve_mode = occ_state is not None and e.slice_dims is not None
     trial: dict = {}  # copy-on-write: only touched bins are copied
+    trial_occ: dict = {}
+    trial_carve: dict = {}
     slots: List[int] = []
+    b_max = enc.b if bin_limit is None else min(bin_limit, enc.b)
     for vec in e.vecs:
         placed = False
-        for bi in range(enc.b):
+        for bi in range(b_max):
             if not enc.compat[gang_index, bi]:
                 continue
             free = trial.get(bi)
             if free is None:
                 free = free_state[bi]
-            if all(free[r] >= vec[r] for r in range(NUM_RESOURCES)):
-                work = trial.get(bi)
-                if work is None:
-                    work = trial[bi] = list(free_state[bi])
-                for r in range(NUM_RESOURCES):
-                    work[r] -= vec[r]
-                slots.append(bi)
-                placed = True
-                break
+            if not all(free[r] >= vec[r] for r in range(NUM_RESOURCES)):
+                continue
+            if carve_mode and bi not in trial_carve:
+                # first member landing on this bin: the whole gang shares
+                # one carve of the declared shape here
+                grid = enc.bins[bi].grid
+                if grid is None:
+                    continue  # cannot model contiguity: unsafe for slices
+                occ = trial_occ.get(bi)
+                if occ is None:
+                    occ = occ_state[bi]
+                    if occ is None:
+                        occ = np.zeros(grid_cells(grid), bool)
+                cells = first_carve(occ, grid, e.slice_dims)
+                if cells is None:
+                    from karpenter_tpu.metrics.topology import (
+                        TOPOLOGY_CARVE_REJECTS_TOTAL)
+                    TOPOLOGY_CARVE_REJECTS_TOTAL.inc()
+                    continue  # resources fit, chips do not: phantom
+                work_occ = trial_occ.get(bi)
+                if work_occ is None:
+                    base = occ_state[bi]
+                    work_occ = trial_occ[bi] = (
+                        base.copy() if base is not None
+                        else np.zeros(grid_cells(grid), bool))
+                work_occ[list(cells)] = True
+                trial_carve[bi] = cells
+            work = trial.get(bi)
+            if work is None:
+                work = trial[bi] = list(free_state[bi])
+            for r in range(NUM_RESOURCES):
+                work[r] -= vec[r]
+            slots.append(bi)
+            placed = True
+            break
         if not placed:
             return None
     for bi, work in trial.items():
         free_state[bi] = work
+    if carve_mode:
+        for bi, occ in trial_occ.items():
+            occ_state[bi] = occ
+        if carves_out is not None:
+            carves_out.update(trial_carve)
     return slots

@@ -249,6 +249,41 @@ class Batcher:
         # producing a bind sample — feed the burn sentinel directly
         slo.note_shed(worst.band)
 
+    def requeue_displaced(self, entries) -> int:
+        """Atomically re-enqueue a preempted gang's members: one lock
+        acquisition admits the whole group so window assembly can never
+        observe a partial gang. ``entries`` is a list of
+        ``(item, key, band, priority, gang)`` tuples — the same fields
+        :meth:`add` takes. Unlike :meth:`add`, this path bypasses band
+        shedding and the depth bound: the members were RUNNING until the
+        provisioner displaced them, so dropping them here would silently
+        turn a priced preemption into lost capacity. Returns the number
+        of entries admitted (always ``len(entries)``)."""
+        now = time.monotonic()
+        with self._cv:
+            for item, key, band, priority, gang in entries:
+                rank = RANK.get(band, RANK["default"])
+                first_seen = now
+                if key is not None:
+                    prev = self._first_seen.get(key)
+                    if prev is not None:
+                        first_seen = prev[0]
+                    self._first_seen[key] = (first_seen, now)
+                entry = _Entry(self._seq, item, key, band, rank, priority,
+                               first_seen,
+                               gang=gang[0] if gang else None,
+                               gang_size=gang[1] if gang else 0)
+                self._seq += 1
+                self._entries.append(entry)
+                if key is not None:
+                    self._pending_keys.add(key)
+                self.added_total += 1
+            if entries:
+                self._cv.notify()
+            depth = len(self._entries)
+        self._note_depth(self._monitor(), depth)
+        return len(entries)
+
     def contains(self, key: Any) -> bool:
         """True while an item added with ``key`` awaits a window. Returns
         False the moment wait() consumes it — or the moment it is shed or

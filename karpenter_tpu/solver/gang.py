@@ -28,10 +28,14 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from karpenter_tpu.obs import trace as obtrace
+from karpenter_tpu.ops import topology as topo_ops
 from karpenter_tpu.ops.gang import (
     EncodedGang, GangEncoding, host_gang, verify_and_commit_gang)
+from karpenter_tpu.pressure.bands import RANK
 from karpenter_tpu.solver import solve as solve_module
+from karpenter_tpu.solver.host_ffd import NUM_RESOURCES
 from karpenter_tpu.solver.solve import record_executor
+from karpenter_tpu.solver.topology import _carve_jit, check_probes
 
 log = logging.getLogger("karpenter.solver.gang")
 
@@ -44,6 +48,8 @@ class GangConfig:
     device_min_cells: int = 1 << 14
     device_timeout_s: float = 120.0
     device_breaker_seconds: float = 120.0
+    # carve verdict cells probed against the scalar oracle at fetch
+    carve_probes: int = 8
 
 
 @lru_cache(maxsize=32)
@@ -81,6 +87,7 @@ class GangHandle:
     enc: GangEncoding
     config: GangConfig
     _out: Optional[tuple] = None
+    _carve_out: Optional[object] = None
     _slot: Optional[object] = None
     _ring: Optional[object] = None
     _result: Optional[Tuple[np.ndarray, np.ndarray, str]] = None
@@ -100,21 +107,35 @@ class GangHandle:
     def _fetch(self) -> Tuple[np.ndarray, np.ndarray, str]:
         feas = slots = None
         executor = "host-gang"
+        carve_ok = None
         if self._out is not None:
             try:
                 def _materialize():
                     f, s = self._out
-                    return np.asarray(f), np.asarray(s)
+                    c = None if self._carve_out is None \
+                        else np.asarray(self._carve_out)
+                    return np.asarray(f), np.asarray(s), c
 
                 if self.config.device_timeout_s > 0:
-                    feas, slots = solve_module._WATCHDOG.run(
+                    feas, slots, carve = solve_module._WATCHDOG.run(
                         _materialize, self.config.device_timeout_s,
                         self.config.device_breaker_seconds)
                 else:
-                    feas, slots = _materialize()
+                    feas, slots, carve = _materialize()
                 feas = feas[:self.enc.g]
                 slots = slots[:self.enc.g, :max(self.enc.k, 1)]
                 executor = "device-gang"
+                if carve is not None:
+                    # the gang verdict rode the device carve filter; a
+                    # failed probe condemns BOTH and re-solves on the
+                    # scalar path (self-heal, ops/device_filter idiom)
+                    carve = carve[:self.enc.g, :self.enc.b]
+                    ok, trusted = check_probes(self.enc, carve,
+                                               self.config.carve_probes)
+                    if not ok:
+                        feas = slots = None
+                        carve_ok = trusted
+                        executor = "host-gang"
             except Exception:
                 log.exception("device gang fetch failed; host mirror fallback")
                 feas = slots = None
@@ -123,7 +144,9 @@ class GangHandle:
                     self._ring.release(self._slot)
                     self._slot = None
         if feas is None:
-            feas, slots = host_gang(self.enc)
+            if self.enc.carve is not None and carve_ok is None:
+                carve_ok = topo_ops.host_carve(self.enc.carve)
+            feas, slots = host_gang(self.enc, carve_ok)
         record_executor(executor, count=max(self.enc.g, 1))
         return (feas, slots, executor)
 
@@ -154,20 +177,39 @@ def dispatch_gang_window(enc: GangEncoding,
         rep = replicated(mesh)
         host = {"gg_pods": enc.d_pods, "gg_valid": enc.d_valid,
                 "gg_compat": enc.d_compat, "gg_free0": enc.d_free0}
+        cv = enc.carve
+        if cv is not None and cv.device_ready:
+            host.update({"tc_occ": cv.d_occ, "tc_cls": cv.d_cls,
+                         "tc_scls": cv.d_scls, "tc_pmask": cv.d_pmask,
+                         "tc_pvalid": cv.d_pvalid})
         ring = get_ring()
         slot = ring.acquire(DeviceRing.signature(host))
         dev = {}
         for name, arr in host.items():
-            sharding = rep if name == "gg_free0" else gang_sh
+            sharding = gang_sh if name in ("gg_pods", "gg_valid",
+                                           "gg_compat") else rep
             dev[name] = ring.fill(slot, name, arr, sharding)
+        compat = dev["gg_compat"]
+        if cv is not None and cv.device_ready:
+            # carve kernel feeds the gang kernel in the SAME round trip:
+            # the (GB, BB) carve verdict ANDs into compat on device, so
+            # the first-fit scan only ever sees carve-feasible bins
+            cfn = _carve_jit(cv.d_scls.shape[0], cv.d_occ.shape[0],
+                             cv.d_pmask.shape[0], cv.d_pmask.shape[1],
+                             cv.d_pmask.shape[2], cv.d_pmask.shape[3])
+            handle._carve_out = cfn(dev["tc_occ"], dev["tc_cls"],
+                                    dev["tc_scls"], dev["tc_pmask"],
+                                    dev["tc_pvalid"])
+            compat = compat & handle._carve_out
         fn = _gang_jit(enc.d_pods.shape[0], enc.d_pods.shape[1],
                        enc.d_compat.shape[1])
         handle._out = fn(dev["gg_pods"], dev["gg_valid"],
-                         dev["gg_compat"], dev["gg_free0"])
+                         compat, dev["gg_free0"])
         handle._slot, handle._ring = slot, ring
     except Exception:
         log.exception("device gang dispatch failed; host mirror fallback")
-        handle._out = handle._slot = handle._ring = None
+        handle._out = handle._carve_out = None
+        handle._slot = handle._ring = None
     handle.dispatch_seconds = time.perf_counter() - t0
     obtrace.add_span("gang-dispatch", t0, time.perf_counter(), gangs=enc.g)
     return handle
@@ -186,6 +228,36 @@ class GangPlacement:
 
     gang: EncodedGang
     node_sets: List[Tuple[int, List[Any]]]  # (bin index, member pods)
+    # bin index → committed carve cells (slice gangs with carving on)
+    carves: dict = field(default_factory=dict)
+
+
+@dataclass
+class PreemptCandidate:
+    """One displaceable resident: a gang holding a carve on a seed bin.
+    ``refund`` is the nano resource vector the bin gets back when the
+    resident's members unbind; ``displacement_cost`` is the what-if repack
+    price of re-placing them ($/h, solver/policy.whatif_repack_cost)."""
+
+    gang_key: Any
+    bin_index: int
+    node: str
+    band: str
+    pods: List[Tuple[str, str]]
+    cells: np.ndarray
+    refund: List[int]
+    displacement_cost: float = 0.0
+    taken: bool = False
+
+
+@dataclass
+class PreemptContext:
+    """Priced displacement candidates for one window, built by the
+    provisioning controller from the occupancy ledger. System-critical
+    residents are never offered — the builder excludes them AND the
+    planner's strict band-rank comparison would refuse them anyway."""
+
+    candidates: List[PreemptCandidate] = field(default_factory=list)
 
 
 @dataclass
@@ -193,10 +265,15 @@ class GangPlan:
     placements: List[GangPlacement] = field(default_factory=list)
     unplaced: List[Tuple[EncodedGang, str]] = field(default_factory=list)
     verified: int = 0  # gangs re-verified on host nano ints
+    # (beneficiary, victim) pairs the walk decided to displace, in
+    # execution order — victims unbind/requeue BEFORE the beneficiary binds
+    preemptions: List[Tuple[EncodedGang, PreemptCandidate]] = \
+        field(default_factory=list)
 
 
 def plan_gang_window(enc: GangEncoding,
-                     feasible: Optional[np.ndarray] = None) -> GangPlan:
+                     feasible: Optional[np.ndarray] = None,
+                     preempt: Optional[PreemptContext] = None) -> GangPlan:
     """Greedy window-priority-order plan. ``feasible`` is the device (or
     host-mirror) filter; None runs the pure per-gang sequential host loop —
     the bench baseline. Either way every accepted gang is re-verified and
@@ -204,23 +281,133 @@ def plan_gang_window(enc: GangEncoding,
     are node-for-node identical by construction: the filter only lets the
     planner SKIP verification of gangs that cannot place (free capacity
     shrinks monotonically, so full-pool-infeasible implies
-    running-pool-infeasible)."""
+    running-pool-infeasible). With carve tensors attached the walk also
+    threads per-bin occupancy planes through the commits — the same
+    monotonicity argument covers them (occupancy only grows).
+
+    ``preempt`` enables priced displacement. A slice gang walks the pool
+    seeds-first: live fragmented capacity, then displacement of strictly-
+    lower-band residents on those real nodes (while the summed what-if
+    displacement price stays under the gang's own fresh-node cost), and
+    only then fresh growth — so the window preempts exactly when
+    displacement is cheaper than opening fresh nodes. A filter-infeasible
+    gang still gets the preemption attempt: eviction un-shrinks the pool,
+    so the filter's monotone skip argument does not bind there."""
     plan = GangPlan()
     if enc.g == 0:
         return plan
     free_state = [list(bn.free) for bn in enc.bins]
+    occ_state = None
+    if enc.carve is not None:
+        occ_state = []
+        for bn in enc.bins:
+            if bn.grid is None:
+                occ_state.append(None)
+            elif bn.occ is not None:
+                occ_state.append(bn.occ.copy())
+            else:
+                occ_state.append(
+                    np.zeros(topo_ops.grid_cells(bn.grid), bool))
+    # seed bins (real ledger nodes) are always the bin-list prefix
+    n_seed = 0
+    for bn in enc.bins:
+        if bn.node_name is None:
+            break
+        n_seed += 1
     for e in enc.gangs:
-        if feasible is not None and not feasible[e.index]:
-            plan.unplaced.append((e, "infeasible"))
-            continue
-        slots = verify_and_commit_gang(enc, e.index, free_state)
-        plan.verified += 1
+        carves: dict = {}
+        slots = None
+        filtered = feasible is not None and not feasible[e.index]
+        seeds_first = (preempt is not None and e.slice_dims is not None
+                       and n_seed > 0 and not filtered)
+        if seeds_first:
+            slots = verify_and_commit_gang(enc, e.index, free_state,
+                                           occ_state, carves,
+                                           bin_limit=n_seed)
+            plan.verified += 1
+            if slots is None:
+                slots = _attempt_preemption(enc, e, free_state, occ_state,
+                                            carves, preempt, plan,
+                                            bin_limit=n_seed)
+        if slots is None and not filtered:
+            slots = verify_and_commit_gang(enc, e.index, free_state,
+                                           occ_state, carves)
+            if not seeds_first:
+                plan.verified += 1
+        if slots is None and filtered and preempt is not None:
+            slots = _attempt_preemption(enc, e, free_state, occ_state,
+                                        carves, preempt, plan)
         if slots is None:
-            plan.unplaced.append((e, "capacity"))
+            plan.unplaced.append((e, "infeasible" if filtered
+                                  else "capacity"))
             continue
         by_bin: dict = {}
         for pod, bi in zip(e.pods, slots):
             by_bin.setdefault(bi, []).append(pod)
         plan.placements.append(GangPlacement(
-            gang=e, node_sets=sorted(by_bin.items())))
+            gang=e, node_sets=sorted(by_bin.items()), carves=carves))
     return plan
+
+
+def _attempt_preemption(enc: GangEncoding, e: EncodedGang,
+                        free_state: list, occ_state: Optional[list],
+                        carves: dict, preempt: PreemptContext,
+                        plan: GangPlan,
+                        bin_limit: Optional[int] = None
+                        ) -> Optional[List[int]]:
+    """Evict strictly-lower-band residents one at a time (lowest band,
+    cheapest displacement first) and retry the exact host verification
+    after each, while the accumulated what-if displacement price stays
+    under the gang's fresh-node cost. All evictions roll back when the
+    gang still cannot place — the pool state is only ever advanced by a
+    committed verification."""
+    from karpenter_tpu.metrics.topology import PREEMPTION_DECLINED_TOTAL
+
+    rank_e = RANK.get(e.band, RANK["default"])
+    avail = [c for c in preempt.candidates if not c.taken
+             and RANK.get(c.band, RANK["default"]) > rank_e]
+    if not avail:
+        PREEMPTION_DECLINED_TOTAL.inc(reason="no-victim")
+        return None
+    fresh = e.fresh_cost if e.fresh_cost is not None else float("inf")
+    avail.sort(key=lambda c: (-RANK.get(c.band, RANK["default"]),
+                              c.displacement_cost, c.node,
+                              str(c.gang_key)))
+    undo: list = []
+    total = 0.0
+    chosen: List[PreemptCandidate] = []
+    slots = None
+    priced_out = False
+    for cand in avail:
+        if total + cand.displacement_cost >= fresh:
+            priced_out = True
+            continue
+        bi = cand.bin_index
+        undo.append((cand, list(free_state[bi]),
+                     None if occ_state is None or occ_state[bi] is None
+                     else occ_state[bi].copy()))
+        for r in range(NUM_RESOURCES):
+            free_state[bi][r] += cand.refund[r]
+        if occ_state is not None and occ_state[bi] is not None:
+            occ_state[bi][cand.cells] = False
+        cand.taken = True
+        total += cand.displacement_cost
+        chosen.append(cand)
+        slots = verify_and_commit_gang(enc, e.index, free_state,
+                                       occ_state, carves,
+                                       bin_limit=bin_limit)
+        plan.verified += 1
+        if slots is not None:
+            break
+    if slots is None:
+        for cand, freev, occv in undo:
+            free_state[cand.bin_index] = freev
+            if occ_state is not None and occv is not None:
+                occ_state[cand.bin_index] = occv
+            cand.taken = False
+        PREEMPTION_DECLINED_TOTAL.inc(
+            reason="fresh-cheaper" if priced_out and not chosen
+            else "unplaceable")
+        return None
+    plan.preemptions.extend((e, c) for c in chosen)
+    return slots

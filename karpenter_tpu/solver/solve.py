@@ -317,8 +317,11 @@ class SolverConfig:
     # bit-for-bit fallback whenever the relaxation declines or is not
     # strictly cheaper in exact int micro-$. Pressure L1+ and gang
     # schedules always keep the FFD path; KARPENTER_GLOBAL_SOLVE=0 kills
-    # the global path regardless of this setting.
-    window_backend: str = "ffd"
+    # the global path regardless of this setting. Default flipped to
+    # "global" (docs/solver.md §18): the relaxation only ever replaces an
+    # FFD plan it strictly beats in exact int micro-$, so the flip is
+    # cost-monotone; --window-backend=ffd restores the old default.
+    window_backend: str = "global"
     # auto-select the type-SPMD kernel (device_kernel=None) only when the
     # padded type bucket reaches this size AND the mesh has more than one
     # device: below it, the per-node collective round-trips cost more than

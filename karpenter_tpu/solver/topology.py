@@ -1,0 +1,228 @@
+"""Device carve kernel: (gangs × origins × orientations) in one jit.
+
+One executable per padded bucket scans, for every gang of the window,
+every candidate carve of its slice shape — all origins × all distinct
+orientations, pre-materialized as the (S, NC, P, C) placement-mask bank
+(ops/topology.py) — against every bin's occupancy bit-plane, and emits the
+(G, B) carve-feasibility verdict. The verdict is a FILTER: solver/gang.py
+ANDs it into the gang kernel's compat mask on device (same round trip) and
+the host walk re-verifies every accepted carve cell-by-cell with the
+scalar oracle before commit.
+
+Self-heal discipline (ops/device_filter.py): fetch probes a deterministic
+subset of (gang, bin) verdict cells against the scalar oracle
+``first_carve``; ANY divergence condemns the whole device verdict —
+``karpenter_filter_fallback_total{reason="carve-mismatch"}`` increments
+and the window re-solves on the scalar path.
+
+Kill switch: ``KARPENTER_TOPOLOGY_CARVE=0`` disables carving entirely —
+the provisioning encoder then passes no slice/grid annotations and the
+gang window is bit-for-bit the shape-only behavior this PR replaced.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.obs import trace as obtrace
+from karpenter_tpu.ops.topology import (
+    CarveEncoding, host_carve, scalar_carve, scalar_carve_cell)
+from karpenter_tpu.solver import solve as solve_module
+
+log = logging.getLogger("karpenter.solver.topology")
+
+_ENV = "KARPENTER_TOPOLOGY_CARVE"
+
+
+def carve_enabled() -> bool:
+    """Kill switch: KARPENTER_TOPOLOGY_CARVE=0/false/off falls back to
+    shape-only slice gating bit-for-bit; default ON."""
+    return os.environ.get(_ENV, "").strip().lower() not in (
+        "0", "false", "off")
+
+
+@dataclass
+class CarveConfig:
+    use_device: bool = True
+    # below this many padded cells (GB*BB*PB) the jit compile outweighs
+    # the scan — tiny test windows stay on the numpy mirror
+    device_min_cells: int = 1 << 14
+    device_timeout_s: float = 120.0
+    device_breaker_seconds: float = 120.0
+    probes: int = 8
+
+
+@lru_cache(maxsize=32)
+def _carve_jit(gb: int, bb: int, sb: int, ncb: int, pb: int, cb: int):
+    """One executable per padded (gangs, bins, slice classes, grid
+    classes, placements, cells) bucket: vmap over the gang axis of an
+    any-placement-free reduction over (placements × cells). All bool."""
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(occ, cls_of, scls_of, pmask, pvalid):
+        clsx = jnp.maximum(cls_of, 0)
+
+        def per_gang(sc):
+            has = sc >= 0
+            scx = jnp.maximum(sc, 0)
+            mb = pmask[scx][clsx]        # (BB, PB, CB)
+            vb = pvalid[scx][clsx]       # (BB, PB)
+            overlap = jnp.any(mb & occ[:, None, :], axis=2)
+            ok = jnp.any(vb & ~overlap, axis=1) & (cls_of >= 0)
+            return jnp.where(has, ok, True)
+
+        return jax.vmap(per_gang)(scls_of)
+
+    return jax.jit(kernel)
+
+
+def probe_pairs(g: int, b: int, n: int) -> List[Tuple[int, int]]:
+    """Deterministic probe cells spread over the (G, B) verdict — the
+    ops/device_filter stride idiom, no RNG so a window probes the same
+    cells on every run."""
+    total = g * b
+    if total <= 0:
+        return []
+    n = min(n, total)
+    step = max(total // n, 1)
+    return [((i * step) % total // b, (i * step) % b)
+            for i in range(n)]
+
+
+@dataclass
+class CarveHandle:
+    """In-flight half of a standalone carve solve (bench/tests path —
+    the provisioning path chains the same jit inside the gang dispatch)."""
+
+    enc: object                     # GangEncoding (carries .carve)
+    cv: CarveEncoding
+    config: CarveConfig
+    _out: Optional[object] = None
+    _slot: Optional[object] = None
+    _ring: Optional[object] = None
+    _result: Optional[Tuple[np.ndarray, str]] = None
+    _trace_ctx: Optional[object] = None
+    dispatch_seconds: float = 0.0
+
+    def fetch(self) -> Tuple[np.ndarray, str]:
+        """((G, B) carve feasibility, executor). Device failure, a tripped
+        breaker, or a failed probe all fall through — the window never
+        stalls and never trusts a diverged kernel."""
+        if self._result is not None:
+            return self._result
+        with obtrace.use_context(self._trace_ctx), \
+                obtrace.span("carve-fetch", gangs=self.cv.g):
+            self._result = self._fetch()
+        return self._result
+
+    def _fetch(self) -> Tuple[np.ndarray, str]:
+        verdict = None
+        executor = "host-carve"
+        if self._out is not None:
+            try:
+                def _materialize():
+                    return np.asarray(self._out)
+
+                if self.config.device_timeout_s > 0:
+                    verdict = solve_module._WATCHDOG.run(
+                        _materialize, self.config.device_timeout_s,
+                        self.config.device_breaker_seconds)
+                else:
+                    verdict = _materialize()
+                verdict = verdict[:self.cv.g, :self.cv.b]
+                executor = "device-carve"
+            except Exception:
+                log.exception("device carve fetch failed; host fallback")
+                verdict = None
+            finally:
+                if self._ring is not None and self._slot is not None:
+                    self._ring.release(self._slot)
+                    self._slot = None
+        if verdict is not None:
+            ok, verdict = check_probes(self.enc, verdict,
+                                       self.config.probes)
+            if not ok:
+                executor = "scalar-carve"
+        if verdict is None:
+            verdict = host_carve(self.cv)
+        return (verdict, executor)
+
+
+def check_probes(enc, verdict: np.ndarray, probes: int
+                 ) -> Tuple[bool, np.ndarray]:
+    """Probe a deterministic verdict subset against the scalar oracle.
+    Divergence condemns the WHOLE device result: the fallback counter
+    increments and the scalar full scan answers instead. Returns
+    (probes held, verdict to trust)."""
+    from karpenter_tpu.metrics.filter import FILTER_FALLBACK_TOTAL
+
+    for gi, bi in probe_pairs(verdict.shape[0], verdict.shape[1], probes):
+        if bool(verdict[gi, bi]) != scalar_carve_cell(enc, gi, bi):
+            FILTER_FALLBACK_TOTAL.inc(reason="carve-mismatch")
+            log.warning("carve probe (%d, %d) diverged from the scalar "
+                        "oracle; self-healing to scalar", gi, bi)
+            return False, scalar_carve(enc)
+    return True, verdict
+
+
+def dispatch_carve_window(enc, config: Optional[CarveConfig] = None
+                          ) -> CarveHandle:
+    """Marshal the carve tensors and launch WITHOUT blocking. Buffers
+    cycle through the process DeviceRing keyed by the padded bucket
+    signature, like every other kernel."""
+    config = config or CarveConfig()
+    cv = enc.carve
+    handle = CarveHandle(enc=enc, cv=cv, config=config,
+                         _trace_ctx=obtrace.current_context())
+    if cv is None:
+        raise ValueError("gang window carries no carve encoding")
+    cells = 0
+    if cv.device_ready:
+        gb = cv.d_scls.shape[0]
+        bb, cb = cv.d_occ.shape
+        pb = cv.d_pmask.shape[2]
+        cells = gb * bb * pb
+    if (not config.use_device or not cv.device_ready
+            or cells < config.device_min_cells
+            or solve_module._WATCHDOG.tripped()):
+        return handle
+    t0 = time.perf_counter()
+    try:
+        from karpenter_tpu.parallel.mesh import replicated, solver_mesh
+        from karpenter_tpu.solver.pipeline import DeviceRing, get_ring
+
+        rep = replicated(solver_mesh())
+        host = {"tc_occ": cv.d_occ, "tc_cls": cv.d_cls,
+                "tc_scls": cv.d_scls, "tc_pmask": cv.d_pmask,
+                "tc_pvalid": cv.d_pvalid}
+        ring = get_ring()
+        slot = ring.acquire(DeviceRing.signature(host))
+        dev = {name: ring.fill(slot, name, arr, rep)
+               for name, arr in host.items()}
+        fn = _carve_jit(cv.d_scls.shape[0], cv.d_occ.shape[0],
+                        cv.d_pmask.shape[0], cv.d_pmask.shape[1],
+                        cv.d_pmask.shape[2], cv.d_pmask.shape[3])
+        handle._out = fn(dev["tc_occ"], dev["tc_cls"], dev["tc_scls"],
+                         dev["tc_pmask"], dev["tc_pvalid"])
+        handle._slot, handle._ring = slot, ring
+    except Exception:
+        log.exception("device carve dispatch failed; host fallback")
+        handle._out = handle._slot = handle._ring = None
+    handle.dispatch_seconds = time.perf_counter() - t0
+    obtrace.add_span("carve-dispatch", t0, time.perf_counter(),
+                     gangs=cv.g)
+    return handle
+
+
+def solve_carve_window(enc, config: Optional[CarveConfig] = None
+                       ) -> Tuple[np.ndarray, str]:
+    """dispatch + fetch in one call (bench and tests)."""
+    return dispatch_carve_window(enc, config).fetch()

@@ -92,6 +92,15 @@ SERIES = [
     ("recovery_time_p99_ms",
      lambda l: _dig(l, "extra", "config_15_crash_recovery", "recovery",
                     "wall_ms", "p99_ms"), "lower", 2.00),
+    ("topology_carve_gain_pct",
+     lambda l: _dig(l, "extra", "config_16_topology_carve", "gain_pct"),
+     "higher", 0.30),
+    # sub-ms kernel walls against a ~100ms scalar loop: the ratio jitters
+    # with host noise in the denominator, but a real regression (the
+    # carve falling off the device path) drops it ~100x and still fails
+    ("topology_carve_speedup",
+     lambda l: _dig(l, "extra", "config_16_topology_carve", "speedup"),
+     "higher", 0.80),
 ]
 
 # (name, extractor(line) -> bool|None): latest non-None entry must be True
@@ -152,6 +161,19 @@ FLAGS = [
                          "recovery", "errors") == 0
                 and (_dig(l, "extra", "config_15_crash_recovery",
                           "journal_tax", "overhead_pct") or 0.0) <= 1.0)),
+    ("topology_carve_clean",
+     lambda l: (None if _dig(l, "extra", "config_16_topology_carve",
+                             "unverified") is None
+                else _dig(l, "extra", "config_16_topology_carve",
+                          "unverified") == 0
+                and _dig(l, "extra", "config_16_topology_carve",
+                         "kernel_divergence") == 0
+                and _dig(l, "extra", "config_16_topology_carve",
+                         "system_critical_preemptions") == 0
+                and bool(_dig(l, "extra", "config_16_topology_carve",
+                              "killswitch_gate"))
+                and bool(_dig(l, "extra", "config_16_topology_carve",
+                              "killswitch_parity")))),
 ]
 
 

@@ -41,6 +41,7 @@ REGISTERING_MODULES = [
     "karpenter_tpu.metrics.policy",
     "karpenter_tpu.metrics.recovery",
     "karpenter_tpu.metrics.slo",
+    "karpenter_tpu.metrics.topology",
     "karpenter_tpu.solver.solve",
     "karpenter_tpu.solver.hedge",
     "karpenter_tpu.controllers.provisioning",

@@ -1,0 +1,110 @@
+"""Topology-carve verdict: one human-readable line from the bench JSON.
+
+`make bench-topology` pipes bench.py (``--only config_16``) through this
+filter. The bench line passes through UNCHANGED on stdout (so
+`> BENCH_rNN.json` redirects still capture the pure JSON); the verdict
+goes to stderr:
+
+    topology carve: 24 gangs x 20 nodes (4 empty + 8 contig + 8 scatter), \
+16 placed vs 8 shape-only (+100.0%), unverified=0, kernel 0.585ms vs \
+scalar 79.061ms (135.2x, device-carve, divergence=0), preemptions=1 \
+(sc=0, fresh-cheaper declined), killswitch=True parity=True — PASS
+
+PASS needs (the round-16 acceptance gate, docs/solver.md §19):
+- the carve-aware walk places >= 20% more gangs than the conservative
+  shape-only baseline on the same saturated fleet (grow=False) — the
+  fragmentation harvest is real, not noise;
+- zero unverified carves: every committed carve re-validated post hoc
+  as exactly one placement-mask row disjoint from the replayed
+  occupancy plane (the host cell-by-cell verify is the only committer);
+- every scatter-fragmented bin rejected: phantom capacity the
+  shape-only gate admits (phantom_gangs_naive > 0 demonstrates the
+  trap; carve_rejects > 0 shows the carve walk refusing it);
+- the batched carve kernel is >= 5x the scalar host carve loop at p50,
+  on the device executor, with bit-identical verdicts (divergence=0);
+- >= 1 executed preemption (the priced path is exercised, not vacuous)
+  and ZERO system-critical displacements; the overpriced victim
+  declined fresh-cheaper — displacement fires exactly when it beats a
+  fresh node;
+- the KARPENTER_TOPOLOGY_CARVE=0 kill switch reads as disabled and the
+  annotation-free encode is bit-for-bit the shape-only encoding.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+GATE_GAIN_PCT = 20.0
+GATE_SPEEDUP = 5.0
+
+
+def verdict(line: dict) -> str:
+    extra = line.get("extra", {})
+    cfg = extra.get("config_16_topology_carve", {})
+    if "error" in cfg or "gain_pct" not in cfg:
+        return ("topology carve: no config_16_topology_carve in bench line "
+                f"({cfg.get('error', cfg.get('skipped', 'config_16 not run'))})"
+                " — NO VERDICT")
+    gain = cfg.get("gain_pct")
+    speedup = cfg.get("speedup")
+    declines = cfg.get("preempt_declines") or {}
+    head = (f"topology carve: {cfg.get('gangs')} gangs x "
+            f"{cfg.get('seed_nodes')} nodes ({cfg.get('empty_nodes')} empty "
+            f"+ {cfg.get('frag_contiguous')} contig + "
+            f"{cfg.get('frag_scattered')} scatter), "
+            f"{cfg.get('carve_placed')} placed vs "
+            f"{cfg.get('shape_only_placed')} shape-only (+{gain}%), "
+            f"unverified={cfg.get('unverified')}, kernel "
+            f"{cfg.get('kernel_p50_ms')}ms vs scalar "
+            f"{cfg.get('scalar_p50_ms')}ms ({speedup}x, "
+            f"{cfg.get('kernel_executor')}, "
+            f"divergence={cfg.get('kernel_divergence')}), "
+            f"preemptions={cfg.get('preemptions')} "
+            f"(sc={cfg.get('system_critical_preemptions')}, "
+            f"{'fresh-cheaper declined' if declines.get('fresh-cheaper') else 'no priced decline'}), "
+            f"killswitch={cfg.get('killswitch_gate')} "
+            f"parity={cfg.get('killswitch_parity')}")
+    ok = (gain is not None and gain >= GATE_GAIN_PCT
+          and cfg.get("unverified") == 0
+          and (cfg.get("phantom_gangs_naive") or 0) > 0
+          and (cfg.get("carve_rejects") or 0) > 0
+          and speedup is not None and speedup >= GATE_SPEEDUP
+          and cfg.get("kernel_executor") == "device-carve"
+          and cfg.get("kernel_divergence") == 0
+          and (cfg.get("preemptions") or 0) >= 1
+          and cfg.get("system_critical_preemptions") == 0
+          and (declines.get("fresh-cheaper") or 0) >= 1
+          and cfg.get("killswitch_gate") is True
+          and cfg.get("killswitch_parity") is True)
+    return (f"{head} — {'PASS' if ok else 'FAIL'} "
+            f"(gate >={GATE_GAIN_PCT}% more gangs, 0 unverified, kernel "
+            f">={GATE_SPEEDUP}x scalar on device with 0 divergence, >=1 "
+            "preemption with 0 system-critical, fresh-cheaper priced "
+            "decline, kill switch + parity)")
+
+
+def main() -> int:
+    last = None
+    for raw in sys.stdin:
+        sys.stdout.write(raw)  # pass-through: stdout stays the pure JSON
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            line = json.loads(raw)
+            if isinstance(line, dict) and "metric" in line:
+                last = line
+        except ValueError:
+            continue
+    sys.stdout.flush()
+    if last is None:
+        print("topology carve: no bench JSON line on stdin — NO VERDICT",
+              file=sys.stderr)
+        return 1
+    print(verdict(last), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
