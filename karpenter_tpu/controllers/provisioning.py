@@ -710,12 +710,12 @@ class ProvisionerWorker:
         for e, cand in plan.preemptions:
             pre_of.setdefault(e.index, []).append(cand)
         for placement in plan.placements:
-            # victims unbind and requeue BEFORE their beneficiary binds:
-            # the carve cells and resource refund the planner charged for
-            # must be real by the time bind_pods lands
-            for cand in pre_of.pop(placement.gang.index, []):
-                self._execute_preemption(cand)
-            err = self._launch_gang(prep, placement)
+            # victims ride into _launch_gang: they unbind only after every
+            # beneficiary node exists (so a failed fleet launch displaces
+            # nothing) but before bind_pods lands (the carve cells and
+            # resource refund the planner charged for must be real by then)
+            err = self._launch_gang(prep, placement,
+                                    pre_of.pop(placement.gang.index, []))
             if err is None:
                 GANGS_PLACED_TOTAL.inc()
                 self._commit_carves(prep, placement)
@@ -852,12 +852,17 @@ class ProvisionerWorker:
             TOPOLOGY_CARVES_COMMITTED_TOTAL.inc()
 
     def _launch_gang(self, prep: _ChunkPrep,
-                     placement: GangPlacement) -> Optional[str]:
+                     placement: GangPlacement,
+                     victims: Optional[List[PreemptCandidate]] = None
+                     ) -> Optional[str]:
         """Atomic gang launch: every member binds or none stays bound.
         Two phases — create all node objects first, then bind members —
         so a mid-fleet launch failure costs zero binds; a mid-bind
         failure unwinds the bound members and hands the created nodes to
-        the termination finalizer."""
+        the termination finalizer. ``victims`` (this gang's planned
+        preemptions) displace between the phases: only once every node
+        exists, so a limits refusal or a failed fleet launch evicts
+        nothing, yet before any member binds onto the freed capacity."""
         schedule = placement.gang.context
         constraints = schedule.constraints
         provisioner = self._engine().provisioner
@@ -914,6 +919,8 @@ class ProvisionerWorker:
             journal.advance(iid, "nodes-created",
                             nodes=sorted(set(node_of.values())),
                             created=list(created))
+        for cand in victims or ():
+            self._execute_preemption(cand)
         # phase 2: bind members node-set by node-set
         for bin_index, pods in placement.node_sets:
             name = node_of[bin_index]

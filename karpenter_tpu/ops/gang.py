@@ -334,6 +334,11 @@ def verify_and_commit_gang(
     trial: dict = {}  # copy-on-write: only touched bins are copied
     trial_occ: dict = {}
     trial_carve: dict = {}
+    # a bin's occupancy only changes within this walk via the gang's own
+    # carve, so a failed first_carve stays failed: memoize the reject so
+    # later members skip the scan and the counter counts bins, not
+    # (members x bins)
+    carve_rejected: set = set()
     slots: List[int] = []
     b_max = enc.b if bin_limit is None else min(bin_limit, enc.b)
     for vec in e.vecs:
@@ -347,6 +352,8 @@ def verify_and_commit_gang(
             if not all(free[r] >= vec[r] for r in range(NUM_RESOURCES)):
                 continue
             if carve_mode and bi not in trial_carve:
+                if bi in carve_rejected:
+                    continue
                 # first member landing on this bin: the whole gang shares
                 # one carve of the declared shape here
                 grid = enc.bins[bi].grid
@@ -359,6 +366,7 @@ def verify_and_commit_gang(
                         occ = np.zeros(grid_cells(grid), bool)
                 cells = first_carve(occ, grid, e.slice_dims)
                 if cells is None:
+                    carve_rejected.add(bi)
                     from karpenter_tpu.metrics.topology import (
                         TOPOLOGY_CARVE_REJECTS_TOTAL)
                     TOPOLOGY_CARVE_REJECTS_TOTAL.inc()

@@ -334,7 +334,14 @@ def plan_gang_window(enc: GangEncoding,
                                            occ_state, carves)
             if not seeds_first:
                 plan.verified += 1
-        if slots is None and filtered and preempt is not None:
+        if slots is None and preempt is not None and \
+                (not seeds_first or enc.b > n_seed):
+            # last-resort full-pool preemption. A filter-infeasible gang
+            # skips straight here (eviction un-shrinks the pool, so the
+            # filter's monotone skip argument does not bind); a gang the
+            # full verify rejected may still place by spanning a freed
+            # seed bin plus fresh growth. Skipped only when seeds-first
+            # already attempted this exact walk (the pool IS the seeds).
             slots = _attempt_preemption(enc, e, free_state, occ_state,
                                         carves, preempt, plan)
         if slots is None:
@@ -400,7 +407,10 @@ def _attempt_preemption(enc: GangEncoding, e: EncodedGang,
         if slots is not None:
             break
     if slots is None:
-        for cand, freev, occv in undo:
+        # newest-first: when two victims share a bin the later snapshot
+        # already contains the earlier refund, so forward order would
+        # keep it — phantom capacity for the rest of the window
+        for cand, freev, occv in reversed(undo):
             free_state[cand.bin_index] = freev
             if occ_state is not None and occv is not None:
                 occ_state[cand.bin_index] = occv

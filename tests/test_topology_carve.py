@@ -39,6 +39,7 @@ from karpenter_tpu.cloudprovider.fake.provider import (
 from karpenter_tpu.controllers.provisioning import ProvisioningController
 from karpenter_tpu.controllers.selection import SelectionController
 from karpenter_tpu.metrics.filter import FILTER_FALLBACK_TOTAL
+from karpenter_tpu.metrics.gang import GANGS_UNPLACEABLE_TOTAL
 from karpenter_tpu.metrics.topology import (
     PREEMPTION_DECLINED_TOTAL, PREEMPTION_DISPLACED_PODS_TOTAL,
     PREEMPTIONS_TOTAL, TOPOLOGY_CARVE_REJECTS_TOTAL,
@@ -47,7 +48,7 @@ from karpenter_tpu.metrics.topology import (
 from karpenter_tpu.ops import topology as topo
 from karpenter_tpu.ops.gang import GangBin, encode_gang_window
 from karpenter_tpu.ops.whatif import _reserve_vec
-from karpenter_tpu.runtime.kubecore import KubeCore
+from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
 from karpenter_tpu.scheduling.batcher import Batcher
 from karpenter_tpu.solver import gang as gang_solver
 from karpenter_tpu.solver import topology as topo_solver
@@ -56,8 +57,8 @@ from karpenter_tpu.solver.gang import (
     solve_gang_window,
 )
 from tests.expectations import (
-    expect_provisioned, expect_scheduled, make_provisioner,
-    unschedulable_pod,
+    eventually, expect_not_scheduled, expect_provisioned, expect_scheduled,
+    make_provisioner, unschedulable_pod,
 )
 
 FUZZ_CASES = max(int(os.environ.get("KARPENTER_FUZZ_CASES", "510")) // 3, 1)
@@ -305,6 +306,23 @@ class TestPhantomCapacityRegression:
         assert _count(TOPOLOGY_CARVE_REJECTS_TOTAL) > rejects0
         assert plan.placements[0].carves  # fresh bin carved instead
 
+    def test_carve_reject_counted_once_per_bin_per_walk(self):
+        """Every member's first-fit walk crosses the fragmented seed, but
+        the reject is memoized within the walk: the counter prices
+        rejected BINS, not members x bins."""
+        rejects0 = _count(TOPOLOGY_CARVE_REJECTS_TOTAL)
+        probe = _pod("probe")
+        big = [max(v, 1) * 100 for v in _reserve_vec(probe)]
+        seed = _seed("frag-node", 0, big, (4, 4), self._fragmented_occ())
+        pods = [_pod(f"memo-m{i}") for i in range(3)]
+        enc = encode_gang_window(
+            [("memo", pods, np.ones(1, bool), None)], [list(big)], [1.0],
+            ["tpu-a"], slices=[(2, 2)], bands=["default"],
+            type_grids=[(4, 4)], seed_bins=[seed])
+        plan = plan_gang_window(enc)
+        assert len(plan.placements) == 1
+        assert _count(TOPOLOGY_CARVE_REJECTS_TOTAL) == rejects0 + 1
+
     def test_two_gangs_split_when_one_torus_cannot_hold_both(self):
         """Two 4x4-slice gangs: resource math alone stacks both on bin 0;
         carve-aware placement gives each its own torus."""
@@ -475,6 +493,74 @@ class TestPricedPreemption:
         assert enc.bins[0].free == free_before
         assert not ctx.candidates[0].taken
 
+    def test_rollback_restores_shared_bin_snapshots_newest_first(self):
+        """Two victims on ONE bin: the second undo snapshot already
+        contains the first victim's refund and freed cells, so only a
+        newest-first restore returns the bin to its true state
+        (regression: forward-order restore left the first refund behind
+        a failed attempt — phantom capacity for the rest of the window)."""
+        d0 = _count(PREEMPTION_DECLINED_TOTAL, reason="unplaceable")
+        _, unit, big = _window([("hi", 2, (2, 2), "high")],
+                               [("tpu-a", 1.0, (4, 4))])
+        seed = _seed("node-a", 0, big, (4, 4), np.ones(16, bool))
+        enc, _, _ = _window([("hi", 2, (2, 2), "high")],
+                            [("tpu-a", 1.0, (4, 4))],
+                            seed_bins=[seed], grow=False)
+        # victim cells free only row 0 plus two scattered chips: no
+        # contiguous 2x2 ever appears, so both evictions happen and fail
+        ctx = PreemptContext([
+            PreemptCandidate(
+                gang_key=("d", "a"), bin_index=0, node="node-a",
+                band="low", pods=[("d", "a-m0")], cells=np.arange(4),
+                refund=list(unit), displacement_cost=0.1),
+            PreemptCandidate(
+                gang_key=("d", "b"), bin_index=0, node="node-a",
+                band="low", pods=[("d", "b-m0")],
+                cells=np.array([5, 10]), refund=list(unit),
+                displacement_cost=0.2),
+        ])
+        free_state = [list(bn.free) for bn in enc.bins]
+        occ_state = [enc.bins[0].occ.copy()]
+        free_before = [list(v) for v in free_state]
+        plan = gang_solver.GangPlan()
+        slots = gang_solver._attempt_preemption(
+            enc, enc.gangs[0], free_state, occ_state, {}, ctx, plan)
+        assert slots is None
+        assert plan.verified == 2  # the walk reached the second snapshot
+        assert _count(PREEMPTION_DECLINED_TOTAL,
+                      reason="unplaceable") == d0 + 1
+        assert free_state == free_before
+        assert occ_state[0].all()
+        assert not any(c.taken for c in ctx.candidates)
+
+    def test_full_pool_preemption_spans_freed_seed_and_fresh(self):
+        """A gang the full-pool walk rejects still gets a displacement
+        attempt: its members may only fit by spanning the freed seed
+        torus plus fresh growth (regression: it was declared 'capacity'
+        unplaced without ever consulting the preempt context)."""
+        probe = _pod("probe")
+        unit = [max(v, 1) for v in _reserve_vec(probe)]
+        seed = _seed("node-a", 0, unit, (4, 4), np.ones(16, bool))
+        pods = [_pod("sp-m0"), _pod("sp-m1")]
+        enc = encode_gang_window(
+            [("sp", pods, np.ones(1, bool), None)], [list(unit)], [1.0],
+            ["tpu-a"], slices=[(2, 2)], bands=["high"],
+            type_grids=[(4, 4)], seed_bins=[seed])
+        assert enc.b == 3  # seed + two grown one-member bins
+        # another gang already consumed one fresh replica: the gang no
+        # longer fits anywhere without the seed torus
+        enc.bins[2].free = [0] * len(unit)
+        ctx = PreemptContext([PreemptCandidate(
+            gang_key=("d", "lo"), bin_index=0, node="node-a", band="low",
+            pods=[("d", "lo-m0")], cells=np.arange(16),
+            refund=[0] * len(unit), displacement_cost=0.1)])
+        plan = plan_gang_window(enc, preempt=ctx)
+        assert not plan.unplaced
+        assert len(plan.placements) == 1
+        assert plan.preemptions and plan.preemptions[0][1].node == "node-a"
+        assert {bi for bi, _ in plan.placements[0].node_sets} == {0, 1}
+        assert set(plan.placements[0].carves) == {0, 1}
+
 
 class TestBatcherRequeueDisplaced:
     def test_atomic_and_shed_proof(self):
@@ -537,6 +623,49 @@ class TestCarveE2E:
             nodes2 = {expect_scheduled(kube, pod) for pod in pods2}
             assert nodes2 == nodes
             assert int(topo.LEDGER.snapshot()[0].occ.sum()) == 8
+        finally:
+            for w in provisioning.workers.values():
+                w.stop()
+
+    def test_refused_launch_displaces_no_victims(self, monkeypatch):
+        """The beneficiary's launch is refused (provisioner gone) AFTER
+        the planner chose preemption: no victim may be displaced for a
+        gang that never binds (regression: eviction used to execute
+        before _launch_gang could refuse)."""
+        kube, provider, provisioning, selection = _harness()
+        pre0 = _count(PREEMPTIONS_TOTAL, band="low")
+        try:
+            low = [_gang_pod("low-keep", 2, i, slice_="v5e-4x4",
+                             priority=-5) for i in range(2)]
+            expect_provisioned(kube, selection, provisioning, low)
+            lnodes = {expect_scheduled(kube, pod) for pod in low}
+            assert len(lnodes) == 1
+            failed0 = _count(GANGS_UNPLACEABLE_TOTAL, reason="bind-failed")
+            real_get = kube.get
+
+            def provisioner_gone(kind, name, namespace=""):
+                if kind == "Provisioner":
+                    raise NotFound(f"Provisioner {name}")
+                return real_get(kind, name, namespace)
+
+            monkeypatch.setattr(kube, "get", provisioner_gone)
+            high = [_gang_pod("high-refused", 2, i, slice_="v5e-2x2",
+                              priority=10) for i in range(2)]
+            expect_provisioned(kube, selection, provisioning, high)
+
+            def refused():
+                assert _count(GANGS_UNPLACEABLE_TOTAL,
+                              reason="bind-failed") > failed0
+
+            eventually(refused)
+            # the resident low gang is untouched: still bound, no
+            # preemption executed, ledger carve intact
+            assert _count(PREEMPTIONS_TOTAL, band="low") == pre0
+            for pod in low:
+                assert expect_scheduled(kube, pod) in lnodes
+            for pod in high:
+                expect_not_scheduled(kube, pod)
+            assert topo.LEDGER.node_count() == 1
         finally:
             for w in provisioning.workers.values():
                 w.stop()
