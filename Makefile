@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-filter bench-policy bench-global bench-topology bench-replay bench-replay-smoke bench-history bench-regress replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-crash chaos-overload clean help
+.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-filter bench-policy bench-global bench-topology bench-carve-journal bench-replay bench-replay-smoke bench-history bench-regress replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-crash chaos-overload clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -54,6 +54,9 @@ bench-global: ## Whole-window global solve vs per-schedule FFD fleet cost A/B (c
 bench-topology: ## Torus-grid slice carving: fragmentation harvest, carve kernel vs scalar loop, priced preemption (config_16); prints verdict line on stderr
 	python bench.py --only config_16 \
 		| python tools/topology_verdict.py
+
+bench-carve-journal: ## Durable carve ledger: journal tax (gate <=1% of loop wall) + cold ledger-recovery wall + machine cleanliness (config_17)
+	python bench.py --only config_17
 
 bench-replay: ## Million-pod replay across 4 shards + 100k-object store A/B (config_9); verdict + SLO verdict + traceview table on stderr
 	python bench.py --only config_9 \
@@ -111,7 +114,7 @@ soak: ## Extended differential soak: 500 fuzz cases + repeated chaos/races
 chaos-soak: ## Seeded fault-injection soak (slow); prints seed, replay via KARPENTER_CHAOS_SEED=<n>
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -s -m slow
 
-chaos-crash: ## Crash-restart soak: every journal kill point x seeds {1,7,42} (slow)
+chaos-crash: ## Crash-restart soak: every journal kill point (incl. carve/preempt, ledger compared bit-for-bit) x seeds {1,7,42} (slow)
 	JAX_PLATFORMS=cpu python -m pytest tests/test_crash_recovery.py -q -s -m slow
 
 chaos-overload: ## Brownout soak: 50k-pod flood + pressure faults (slow) after the fast seeded smoke

@@ -2166,6 +2166,244 @@ def config_16_topology_carve():
     }
 
 
+def config_17_carve_journal():
+    """Round-17 gate: the durable topology ledger + preemption intent
+    machine (docs/robustness.md §6). Three legs over one carve-heavy
+    gang loop — launch, carve commit, priced displacement of the
+    previous resident, winner carve on the freed node:
+
+    - carve-journal tax: a replay-shaped run (journal fsync ON) whose
+      gang cohort carries ``gang_slice`` labels, so every gang routes
+      through the topology-carve planner and journals one durable carve
+      intent per committed slice at realistic window pacing. The tax is
+      the carve records' share of the journal's append histogram
+      (records x mean append latency) against the run's wall.
+      Gate: <= 1% (``overhead_pct`` / ``tax_gate``);
+    - ledger recovery wall: the gang loop's journal (its open carve
+      intents ARE the durable ledger) is replayed from cold —
+      LEDGER.reset() + fresh handle + RecoveryController.run() per
+      iteration — and the rebuilt occupancy must be bit-for-bit the
+      pre-death snapshot every time (``recovered_bitident``).
+      ``wall_ms`` p50/p99 feed the ledger_recovery_p99_ms ratchet;
+    - machine cleanliness: after the loop the ONLY open intents are the
+      live carves (every preempt/gang-bind pair folded) and replay
+      reports zero errors — the preempt_crash_clean flag."""
+    import shutil
+    import tempfile
+    import time as _time
+    from types import SimpleNamespace
+
+    import numpy as np
+
+    from karpenter_tpu.api import wellknown
+    from karpenter_tpu.api.constraints import Constraints
+    from karpenter_tpu.api.core import NodeSelectorRequirement as Req
+    from karpenter_tpu.api.requirements import Requirements
+    from karpenter_tpu.cloudprovider.fake.provider import (
+        FakeCloudProvider, tpu_catalog,
+    )
+    from karpenter_tpu.controllers.provisioning import (
+        ProvisionerWorker, global_requirements,
+    )
+    from karpenter_tpu.controllers.recovery import RecoveryController
+    from karpenter_tpu.metrics.recovery import JOURNAL_APPEND_SECONDS
+    from karpenter_tpu.metrics.topology import (
+        TOPOLOGY_CARVES_COMMITTED_TOTAL,
+    )
+    from karpenter_tpu.ops import topology as topo
+    from karpenter_tpu.replay import ReplayConfig, run_replay
+    from karpenter_tpu.runtime.journal import IntentJournal
+    from karpenter_tpu.runtime.kubecore import KubeCore
+    from karpenter_tpu.scheduling.batcher import Batcher
+    from karpenter_tpu.solver.gang import PreemptCandidate
+    from tests.expectations import make_provisioner, unschedulable_pod
+
+    GRID = (4, 4)
+    CELLS = 16
+    G = 96       # gangs through the loop; every odd one displaces
+    RECOVERY_ITERS = 12
+
+    def _hsum(hist):
+        collected = hist.collect()
+        return (sum(s for _, s, _ in collected.values()),
+                sum(t for _, _, t in collected.values()))
+
+    def canon():
+        out = []
+        for ng in topo.LEDGER.snapshot():
+            for k, r in ng.carves.items():
+                out.append((ng.node, ng.type_name, tuple(ng.dims),
+                            tuple(int(c) for c in sorted(r.cells)),
+                            r.band, str(k),
+                            tuple(sorted(f"{a}/{b}" for a, b in r.pods))))
+        return sorted(out)
+
+    cons = Constraints(
+        labels={wellknown.PROVISIONER_NAME_LABEL: "carve-bench"},
+        requirements=Requirements([
+            Req(key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In",
+                values=["test-zone-1"]),
+            Req(key=wellknown.LABEL_CAPACITY_TYPE, operator="In",
+                values=["on-demand"]),
+        ]))
+    kube = KubeCore()
+    provider = FakeCloudProvider(catalog=tpu_catalog())
+    itype = next(t for t in provider.catalog if t.name == "tpu-v5e-4x4")
+    prov = make_provisioner(name="carve-bench", constraints=cons)
+    prov.spec.constraints.requirements = (
+        prov.spec.constraints.requirements.add(
+            *global_requirements(provider.get_instance_types(cons)).items))
+    kube.create(prov)
+
+    def prep_of(key, node=None):
+        enc = SimpleNamespace(bins=[SimpleNamespace(
+            type_index=0, name=f"{key}-bin", grid=GRID, node_name=node)])
+        return SimpleNamespace(
+            gang_enc=enc, gang_nodes=dict({0: node} if node else {}),
+            gang_types=[(itype.name, itype)])
+
+    def placement_of(key, pods, band, cells):
+        gang = SimpleNamespace(
+            key=key, pods=pods, band=band,
+            context=SimpleNamespace(constraints=cons))
+        return SimpleNamespace(gang=gang, node_sets=[(0, pods)],
+                               carves={0: list(cells)})
+
+    def rec_of(key):
+        for ng in topo.LEDGER.snapshot():
+            for k, r in ng.carves.items():
+                if str(k) == key:
+                    return ng.node, r
+        return None
+
+    # --- leg 1: carve-journal tax at replay pacing -------------------
+    # the gang cohort is slice-labeled, so every gang runs the REAL
+    # topology-carve planner inside the paced provisioning loop and
+    # journals a durable carve intent per committed slice (fsync ON)
+    topo.LEDGER.reset()
+    rdir = tempfile.mkdtemp(prefix="bench-carve-replay-")
+    try:
+        carves0 = sum(TOPOLOGY_CARVES_COMMITTED_TOTAL.collect().values())
+        rtax0 = _hsum(JOURNAL_APPEND_SECONDS)
+        replay = run_replay(ReplayConfig(
+            pods_total=3_000, shards=1, tenants=1, seed=7,
+            bound_cohort=320, gang_fraction=0.5, gang_size=4,
+            gang_slice="v5e-2x2", churn_pods=0, max_depth=2_000,
+            ticks=6, tick_sleep_s=0.5, burst_ticks=1, chaos=False,
+            settle_s=60.0, flood_pool=96, journal_dir=rdir,
+            journal_fsync=True))
+        rtax1 = _hsum(JOURNAL_APPEND_SECONDS)
+    finally:
+        shutil.rmtree(rdir, ignore_errors=True)
+    carves = sum(TOPOLOGY_CARVES_COMMITTED_TOTAL.collect().values()) - carves0
+    appends = rtax1[1] - rtax0[1]
+    mean_append_s = ((rtax1[0] - rtax0[0]) / appends) if appends else 0.0
+    # the tax the carve ledger ADDED: one durable record per committed
+    # carve, priced at this run's measured mean append latency (the rest
+    # of the append volume — fleet-launch, bind, gang-bind — predates
+    # the ledger and is gated by config_15)
+    carve_tax_s = carves * mean_append_s
+    overhead_pct = (round(carve_tax_s / replay["wall_s"] * 100.0, 4)
+                    if replay["wall_s"] else None)
+
+    topo.LEDGER.reset()
+    jdir = tempfile.mkdtemp(prefix="bench-carve-journal-")
+    try:
+        journal = IntentJournal(jdir, fsync=True)
+        worker = ProvisionerWorker(
+            prov, kube, provider,
+            batcher=Batcher(idle_seconds=0.01, max_seconds=0.1),
+            journal=journal)
+        preemptions = launch_errors = 0
+        tax0 = _hsum(JOURNAL_APPEND_SECONDS)
+        t0 = _time.perf_counter()
+        for i in range(G):
+            key = f"cj-{i}"
+            pods = []
+            for j in range(2):
+                p = unschedulable_pod(
+                    requests={"cpu": "250m", "memory": "128Mi"},
+                    name=f"{key}-m{j}")
+                kube.create(p)
+                pods.append(p)
+            victims, node = [], None
+            if i % 2 == 1:
+                found = rec_of(f"cj-{i - 1}")  # displace the resident
+                if found is not None:
+                    node, r = found
+                    victims.append(PreemptCandidate(
+                        gang_key=r.gang_key, bin_index=0, node=node,
+                        band=r.band, pods=list(r.pods),
+                        cells=r.cells.copy(), refund=[0],
+                        displacement_cost=0.1))
+            prep = prep_of(key, node=node)
+            placement = placement_of(
+                key, pods, "high" if victims else "low",
+                list(range(CELLS)))
+            err = worker._launch_gang(prep, placement, victims or None)
+            if err is not None:
+                launch_errors += 1
+                continue
+            worker._commit_carves(prep, placement)
+            preemptions += len(victims)
+        loop_wall = _time.perf_counter() - t0
+        tax1 = _hsum(JOURNAL_APPEND_SECONDS)
+        loop_tax_s = tax1[0] - tax0[0]
+
+        before = canon()
+        opens = journal.open_intents()
+        non_carve_open = sum(
+            1 for it in opens.values() if it.kind != "carve")
+        journal.close_journal()
+
+        walls, errors = [], 0
+        bitident = True
+        for _ in range(RECOVERY_ITERS):
+            topo.LEDGER.reset()
+            with IntentJournal(jdir, fsync=False) as j2:
+                recovery = RecoveryController(kube, provider, j2)
+                r0 = _time.perf_counter()
+                stats = recovery.run()
+                walls.append(_time.perf_counter() - r0)
+                errors += stats["errors"]
+                bitident = bitident and canon() == before
+    finally:
+        topo.LEDGER.reset()
+        shutil.rmtree(jdir, ignore_errors=True)
+
+    return {
+        "carve_tax": {
+            "replay_wall_s": replay["wall_s"],
+            "replay_bound": replay["bound"],
+            "replay_completed": replay["completed"],
+            "carves_committed": int(carves),
+            "appends": appends,
+            "mean_append_us": round(mean_append_s * 1e6, 2),
+            "carve_tax_s": round(carve_tax_s, 6),
+            "overhead_pct": overhead_pct,
+        },
+        "overhead_pct": overhead_pct,
+        "tax_gate": (overhead_pct is not None and overhead_pct <= 1.0
+                     and carves > 0),
+        "gang_loop": {
+            "gangs": G,
+            "preemptions": preemptions,
+            "launch_errors": launch_errors,
+            "wall_s": round(loop_wall, 4),
+            "journal_tax_s": round(loop_tax_s, 6),
+            "journal_appends": tax1[1] - tax0[1],
+        },
+        "open_carves": len(opens),
+        "non_carve_open_after": non_carve_open,
+        "recovery": {
+            "iters": RECOVERY_ITERS,
+            "wall_ms": _stats(walls),
+            "errors": errors,
+            "recovered_bitident": bool(bitident),
+        },
+    }
+
+
 def jax_devices_first():
     import jax
 
@@ -2583,6 +2821,7 @@ def run_all(degraded: bool, probe_note: str = ""):
         ("config_14_global_window", config_14_global_window),
         ("config_15_crash_recovery", config_15_crash_recovery),
         ("config_16_topology_carve", config_16_topology_carve),
+        ("config_17_carve_journal", config_17_carve_journal),
     ):
         if not _selected(key, only):
             continue

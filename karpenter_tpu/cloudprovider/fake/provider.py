@@ -85,14 +85,19 @@ def instance_types(total: int) -> List[InstanceType]:
 
 
 def tpu_catalog() -> List[InstanceType]:
-    """Multi-host TPU catalog for slice-carve tests and benches: two torus
-    hosts (4x4 and 4x8 chip grids, priced per size) plus a plain CPU type
-    so non-slice pods never land on TPU capacity by accident."""
+    """Multi-host TPU catalog for slice-carve tests and benches: two
+    2-D torus hosts (v5e 4x4 and 4x8 chip grids, priced per size), one
+    REAL 3-D torus host (v4-style 2x2x4 — 16 chips on a genuine
+    x·y·z grid, so the 3-D carve encoding runs end-to-end rather than
+    only in oracle tests), plus a plain CPU type so non-slice pods never
+    land on TPU capacity by accident."""
     return [
         make_instance_type("tpu-v5e-4x4", cpu="32", memory="64Gi",
                            pods="32", price=4.0, tpu_topology="v5e-4x4"),
         make_instance_type("tpu-v5e-4x8", cpu="64", memory="128Gi",
                            pods="64", price=8.0, tpu_topology="v5e-4x8"),
+        make_instance_type("tpu-v4-2x2x4", cpu="64", memory="128Gi",
+                           pods="64", price=6.0, tpu_topology="v4-2x2x4"),
         make_instance_type("cpu-standard", cpu="16", memory="64Gi",
                            pods="64", price=1.0),
     ]

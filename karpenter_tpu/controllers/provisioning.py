@@ -65,6 +65,7 @@ from karpenter_tpu.metrics.topology import (
     PREEMPTION_DISPLACED_PODS_TOTAL, PREEMPTIONS_TOTAL,
     TOPOLOGY_CARVE_WINDOWS_TOTAL, TOPOLOGY_CARVES_COMMITTED_TOTAL,
 )
+from karpenter_tpu.scheduling.preempt_budget import PreemptionBudget
 from karpenter_tpu.ops import topology as topo_ops
 from karpenter_tpu.ops.gang import GangBin, GangEncoding, encode_gang_window
 from karpenter_tpu.pressure.bands import RANK
@@ -185,6 +186,7 @@ class ProvisionerWorker:
         self.journal = journal
         self.solver_config = solver_config or SolverConfig()
         self.gang_config = GangConfig()
+        self.preempt_budget = PreemptionBudget()
         self.batcher = batcher or Batcher()
         self.pipeline_config = pipeline_config or PipelineConfig()
         self.shard = shard
@@ -582,8 +584,14 @@ class ProvisionerWorker:
         already carries — the same isolation the segment masks give fresh
         bins. Free capacity is the node's LIVE residual (allocatable minus
         running pods), so shape math and carve cells stay consistent."""
-        topo_ops.LEDGER.prune(
+        dropped = topo_ops.LEDGER.prune(
             [n.metadata.name for n in self.kube.list("Node")])
+        if self.journal is not None:
+            # a pruned node's carves are gone for good — fold their
+            # durable intents so compaction can drop the records
+            for rec in dropped:
+                if rec.intent_id:
+                    self.journal.close(rec.intent_id, outcome="node-pruned")
         snap = topo_ops.LEDGER.snapshot()
         if not snap:
             return []
@@ -738,6 +746,7 @@ class ProvisionerWorker:
                  if bn.node_name]
         if not seeds:
             return None
+        self.preempt_budget.tick()
         from karpenter_tpu.models.consolidate import (
             NANO, free_capacity_vector)
         from karpenter_tpu.solver.adapter import pod_vector
@@ -785,14 +794,38 @@ class ProvisionerWorker:
                     gang_key=rec.gang_key, bin_index=bi, node=ng.node,
                     band=rec.band, pods=live, cells=rec.cells.copy(),
                     refund=refund, displacement_cost=cost))
+        # anti-thrash gate: cooldown + per-band token filtering happens
+        # BEFORE the planner prices anything, so a budget-capped window
+        # falls back to fresh nodes instead of oscillating residents
+        cands = self.preempt_budget.admit(cands)
         return PreemptContext(cands) if cands else None
 
-    def _execute_preemption(self, cand: PreemptCandidate) -> None:
+    def _execute_preemption(self, cand: PreemptCandidate,
+                            beneficiary=None) -> Optional[str]:
         """Displace one resident gang: unbind its members, release its
         ledger carves, and requeue the whole group atomically through the
         band-aware batcher (shed-proof — the members were running). The
         requeued items route to the default engine; a multi-engine shard's
-        selection requeue re-offers any that miss their window."""
+        selection requeue re-offers any that miss their window.
+
+        The whole displacement is bracketed by a durable ``preempt``
+        intent: the victim list is on disk BEFORE the first unbind, and
+        the phase advances to ``victims-unbound`` only after the requeue
+        and the carve release both landed. A crash at any instant is
+        therefore replayable — still phase ``open`` with every member
+        bound means nothing happened (no-op); anything else rolls
+        forward through RecoveryController._resolve_preempt (victims
+        re-admitted, carve cells released). Returns the intent id so
+        _launch_gang can advance it to ``beneficiary-bound`` once the
+        winner's members land."""
+        journal = self.journal
+        piid = None
+        if journal is not None:
+            piid = journal.open_intent(
+                "preempt", gang=str(cand.gang_key), node=cand.node,
+                band=cand.band,
+                pods=[f"{pns}/{pname}" for pns, pname in cand.pods],
+                beneficiary=str(beneficiary) if beneficiary else "")
 
         def clear(obj):
             if getattr(obj.spec, "node_name", ""):
@@ -817,7 +850,14 @@ class ProvisionerWorker:
             entries.append(((None, p), (pns, pname), band, priority, gang))
         if entries:
             self.batcher.requeue_displaced(entries)
-        topo_ops.LEDGER.release_gang(cand.gang_key)
+        for _node, rec in topo_ops.LEDGER.pop_gang(cand.gang_key):
+            if journal is not None and rec.intent_id:
+                # fold the victim's durable carve: compaction may now
+                # drop both halves of the pair
+                journal.close(rec.intent_id, outcome="preempted")
+        self.preempt_budget.charge(cand.gang_key, cand.band)
+        if piid is not None:
+            journal.advance(piid, "victims-unbound")
         PREEMPTIONS_TOTAL.inc(band=cand.band)
         if entries:
             PREEMPTION_DISPLACED_PODS_TOTAL.inc(amount=float(len(entries)))
@@ -825,15 +865,23 @@ class ProvisionerWorker:
                  "displacement=$%.4f/h window_id=%s shard=%s",
                  cand.gang_key, cand.node, cand.band, len(entries),
                  cand.displacement_cost, self._window_id, self.shard or "0")
+        return piid
 
     def _commit_carves(self, prep: _ChunkPrep,
                        placement: GangPlacement) -> None:
         """Record a bound slice gang's carve cells in the occupancy
         ledger so later windows seed its nodes' residual grids back into
-        the pool (and can price this gang as a preemption victim)."""
+        the pool (and can price this gang as a preemption victim).
+
+        Each commit is durably journaled as a long-lived ``carve``
+        intent BEFORE the in-memory ledger mutates: the open intent IS
+        the durable form of the carve, so a restart rebuilds this exact
+        record (RecoveryController._resolve_carve) instead of seeing the
+        fragmented node as empty and double-carving it."""
         if not placement.carves:
             return
         enc = prep.gang_enc
+        journal = self.journal
         schedule = placement.gang.context
         sig = topo_ops.constraints_sig(schedule.constraints.labels,
                                        schedule.constraints.taints)
@@ -846,9 +894,19 @@ class ProvisionerWorker:
             if node is None or bn.grid is None:
                 continue
             _s, itype = prep.gang_types[bn.type_index]
+            cid = ""
+            if journal is not None:
+                cid = journal.open_intent(
+                    "carve", gang=str(placement.gang.key), node=node,
+                    grid=[int(d) for d in bn.grid], type=itype.name,
+                    sig=sig, cells=[int(c) for c in cells],
+                    band=placement.gang.band,
+                    pods=[f"{ns}/{nm}"
+                          for ns, nm in members.get(bi, [])])
             topo_ops.LEDGER.commit(
                 node, bn.grid, itype.name, sig, placement.gang.key,
-                cells, placement.gang.band, members.get(bi, []))
+                cells, placement.gang.band, members.get(bi, []),
+                intent_id=cid)
             TOPOLOGY_CARVES_COMMITTED_TOTAL.inc()
 
     def _launch_gang(self, prep: _ChunkPrep,
@@ -919,8 +977,12 @@ class ProvisionerWorker:
             journal.advance(iid, "nodes-created",
                             nodes=sorted(set(node_of.values())),
                             created=list(created))
+        preempt_iids: List[str] = []
         for cand in victims or ():
-            self._execute_preemption(cand)
+            piid = self._execute_preemption(
+                cand, beneficiary=placement.gang.key)
+            if piid is not None:
+                preempt_iids.append(piid)
         # phase 2: bind members node-set by node-set
         for bin_index, pods in placement.node_sets:
             name = node_of[bin_index]
@@ -933,9 +995,18 @@ class ProvisionerWorker:
             if errs:
                 self._unwind_gang_journaled(iid, prep, placement,
                                             node_of, created)
+                if journal is not None:
+                    # victims were already unbound + requeued in-process;
+                    # the displacement stands even though the winner
+                    # unwound, so the intents fold at victims-unbound
+                    for piid in preempt_iids:
+                        journal.close(piid, outcome="beneficiary-unwound")
                 return f"binding to {name}: " + "; ".join(errs)
         if iid is not None:
             journal.advance(iid, "bound")
+            for piid in preempt_iids:
+                journal.advance(piid, "beneficiary-bound")
+                journal.close(piid)
             journal.close(iid)
         log.info("gang %s bound: %d pod(s) across %d node(s) window_id=%s "
                  "shard=%s", placement.gang.key, len(placement.gang.pods),

@@ -41,6 +41,16 @@ node-delete   node gone but its instance still listed → finish the
               instance delete (forward). Node present at phase
               ``instance-deleted`` → strip the finalizer (forward);
               at ``open`` → noop, the termination controller re-drives.
+carve         replayed FIRST (before every other kind): node exists →
+              re-commit the record into the occupancy ledger and leave
+              the intent OPEN (an open carve IS the durable ledger
+              entry); node gone → close (noop). Idempotent re-commit.
+preempt       phase ``beneficiary-bound`` → pure close (forward).
+              Phase ``open`` with every journaled member still bound to
+              the journaled node → close, nothing happened (noop).
+              Otherwise roll forward once: finish the unbind, pop the
+              victim's rebuilt carve (closing its carve intents), and
+              re-admit live unbound victims via the batcher hook.
 
 After all intents resolve the journal is compacted, ``recovering()``
 flips false, and readyz goes 200. The controller also satisfies the
@@ -55,11 +65,15 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from karpenter_tpu import pressure
 from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.gang import gang_of
 from karpenter_tpu.cloudprovider.spi import CloudProvider
 from karpenter_tpu.metrics.recovery import (
+    LEDGER_RECOVERED_CARVES_TOTAL, LEDGER_RECOVERY_SECONDS,
     RECOVERY_INTENTS_TOTAL, RECOVERY_SECONDS)
 from karpenter_tpu.obs import flight
+from karpenter_tpu.ops import topology as topo_ops
 from karpenter_tpu.runtime.journal import Intent, IntentJournal
 from karpenter_tpu.runtime.kubecore import ApiError, KubeCore, NotFound
 
@@ -74,10 +88,16 @@ class RecoveryController:
     """One-shot journal replay; ``recovering()`` gates readyz."""
 
     def __init__(self, kube: KubeCore, cloud_provider: CloudProvider,
-                 journal: IntentJournal):
+                 journal: IntentJournal, requeue_displaced=None):
         self.kube = kube
         self.cloud_provider = cloud_provider
         self.journal = journal
+        # optional batcher hook (Batcher.requeue_displaced-shaped): when
+        # set, preempt roll-forward re-admits the victims directly; when
+        # None (main.py — no batcher exists yet at recovery time) the
+        # unbound victims are Pending and the selection controller
+        # re-enters them on its first pass
+        self.requeue_displaced = requeue_displaced
         self._done = threading.Event()
         self.stats: Dict[str, int] = {"forward": 0, "rollback": 0,
                                       "noop": 0, "errors": 0}
@@ -109,8 +129,18 @@ class RecoveryController:
             log.exception("list_instances failed during recovery; capacity-"
                           "side rollback skipped this startup")
             records = []
+        # carve intents replay FIRST so the occupancy ledger is whole
+        # before any other rule consults or releases it (a preempt
+        # roll-forward pops the victim's rebuilt carve; a gang-bind
+        # unwind's node teardown drops the node's carves), then preempts,
+        # then everything else in append order
+        order = {"carve": 0, "preempt": 1}
+        ledger_s = 0.0
+        saw_carve = False
         try:
-            for intent in sorted(open_intents.values(), key=lambda i: i.id):
+            for intent in sorted(open_intents.values(),
+                                 key=lambda i: (order.get(i.kind, 2), i.id)):
+                t_int = time.perf_counter()
                 try:
                     action = self._resolve(intent, records)
                 except Exception:  # noqa: BLE001 — one bad intent must not
@@ -119,12 +149,18 @@ class RecoveryController:
                                   intent.kind, intent.id)
                     self.stats["errors"] += 1
                     continue
+                finally:
+                    if intent.kind == "carve":
+                        saw_carve = True
+                        ledger_s += time.perf_counter() - t_int
                 self.stats[action] += 1
                 RECOVERY_INTENTS_TOTAL.inc(kind=intent.kind, action=action)
                 log.info("recovered %s intent %s (phase=%s): %s",
                          intent.kind, intent.id, intent.phase, action)
             self.journal.compact()
         finally:
+            if saw_carve:
+                LEDGER_RECOVERY_SECONDS.observe(ledger_s)
             RECOVERY_SECONDS.observe(time.perf_counter() - t0)
             self._done.set()
         if self.stats["rollback"]:
@@ -143,6 +179,8 @@ class RecoveryController:
             "gang-bind": self._resolve_gang_bind,
             "drain": self._resolve_drain,
             "node-delete": self._resolve_node_delete,
+            "carve": self._resolve_carve,
+            "preempt": self._resolve_preempt,
         }.get(intent.kind)
         if handler is None:
             self.journal.close(intent.id, outcome="unknown-kind")
@@ -296,6 +334,113 @@ class RecoveryController:
         self.journal.close(intent.id, outcome="unwound")
         return "rollback" if did else "noop"
 
+    def _resolve_carve(self, intent: Intent, records) -> str:
+        """Rebuild one occupancy-ledger entry from its durable carve
+        intent. Carve intents are LONG-LIVED: open = the carve is live,
+        so this handler re-commits the record and leaves the intent
+        OPEN — compaction keeps it, and it closes only when the gang
+        releases, is preempted, or its node is pruned/torn down.
+        Re-commit is idempotent (ledger overwrite semantics), so a
+        double replay yields the identical ledger."""
+        node = str(intent.data.get("node") or "")
+        if not node:
+            self.journal.close(intent.id, outcome="no-node")
+            return "noop"
+        try:
+            self.kube.get("Node", node, "")
+        except NotFound:
+            # the carved node did not survive the crash: the cells are
+            # not capacity anymore, fold the intent
+            self.journal.close(intent.id, outcome="node-gone")
+            return "noop"
+        dims = tuple(int(d) for d in intent.data.get("grid") or [])
+        cells = [int(c) for c in intent.data.get("cells") or []]
+        if not dims or not cells:
+            self.journal.close(intent.id, outcome="malformed")
+            return "noop"
+        sig = topo_ops.sig_from_json(intent.data.get("sig") or ((), ()))
+        pods = []
+        for ref in intent.data.get("pods") or []:
+            ns, _, pname = str(ref).partition("/")
+            pods.append((ns, pname))
+        topo_ops.LEDGER.commit(
+            node, dims, str(intent.data.get("type") or ""), sig,
+            str(intent.data.get("gang") or ""), cells,
+            str(intent.data.get("band") or "default"), pods,
+            intent_id=intent.id)
+        LEDGER_RECOVERED_CARVES_TOTAL.inc()
+        return "forward"
+
+    def _resolve_preempt(self, intent: Intent, records) -> str:
+        """Replay one crashed displacement (docs/robustness.md §6):
+
+        - phase ``beneficiary-bound`` — the displacement fully happened
+          and the winner's members landed; the crash hit mid-close, so
+          replay is a pure close (forward).
+        - phase ``open`` with EVERY journaled member still bound to the
+          journaled node — the crash beat the first unbind; nothing
+          happened, the victims keep running (noop).
+        - anything else (phase ``victims-unbound``, or ``open`` with a
+          partial unbind) — roll the displacement forward exactly once:
+          finish unbinding, release the victim's rebuilt carve cells
+          (closing their carve intents), and re-admit every live
+          unbound victim through the batcher hook.
+        """
+        gang = str(intent.data.get("gang") or "")
+        node = str(intent.data.get("node") or "")
+        if intent.phase == "beneficiary-bound":
+            self.journal.close(intent.id, outcome="bound")
+            return "forward"
+        live = []
+        bound_here = 0
+        for ref in intent.data.get("pods") or []:
+            ns, _, pname = str(ref).partition("/")
+            try:
+                pod = self.kube.get("Pod", pname, ns)
+            except NotFound:
+                continue
+            live.append((ns, pname))
+            if getattr(pod.spec, "node_name", "") == node:
+                bound_here += 1
+        if intent.phase == "open" and live and bound_here == len(live):
+            # crash before the first unbind: the displacement never
+            # started and the planner will re-price it (or not) fresh
+            self.journal.close(intent.id, outcome="not-started")
+            return "noop"
+
+        def clear(obj):
+            if getattr(obj.spec, "node_name", "") == node:
+                obj.spec.node_name = ""
+            else:
+                raise _NoChange
+
+        for ns, pname in live:
+            try:
+                self.kube.patch("Pod", pname, ns, clear)
+            except (_NoChange, NotFound):
+                pass
+        for _n, rec in topo_ops.LEDGER.pop_gang(gang):
+            if rec.intent_id:
+                self.journal.close(rec.intent_id, outcome="preempted")
+        if self.requeue_displaced is not None and live:
+            entries = []
+            for ns, pname in live:
+                try:
+                    p = self.kube.get("Pod", pname, ns)
+                except NotFound:
+                    continue
+                if getattr(p.spec, "node_name", ""):
+                    continue  # already re-bound elsewhere; not displaced
+                band, priority = pressure.classify(p)
+                gspec = gang_of(p)
+                g = ((gspec.key, gspec.size)
+                     if gspec is not None and not gspec.error else None)
+                entries.append(((None, p), (ns, pname), band, priority, g))
+            if entries:
+                self.requeue_displaced(entries)
+        self.journal.close(intent.id, outcome="victims-readmitted")
+        return "forward"
+
     def _teardown_node(self, name: str) -> bool:
         """Direct teardown — instance delete, finalizer strip, object
         delete — because the termination controller is not running yet.
@@ -323,6 +468,11 @@ class RecoveryController:
             self.kube.delete("Node", name, "")
         except (NotFound, ApiError):
             pass
+        # the node's carves (rebuilt by the carve-first replay above) go
+        # with it — release the cells and fold their durable intents
+        for rec in topo_ops.LEDGER.pop_node(name):
+            if rec.intent_id:
+                self.journal.close(rec.intent_id, outcome="node-torn-down")
         log.info("recovery tore down gang node %s", name)
         return True
 

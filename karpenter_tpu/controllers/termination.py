@@ -183,10 +183,22 @@ class Terminator:
         except NotFound:
             if iid is not None:
                 journal.close(iid)
+            self._release_carves(node.metadata.name)
             return
         if iid is not None:
             journal.close(iid)
+        self._release_carves(node.metadata.name)
         log.info("deleted node %s", node.metadata.name)
+
+    def _release_carves(self, name: str) -> None:
+        """A terminated node's occupancy-ledger carves die with it —
+        otherwise the next gang window would keep offering the dead
+        node's residual grid as a seed bin. Folding the durable carve
+        intents here also lets journal compaction drop the records."""
+        from karpenter_tpu.ops import topology as topo_ops
+        for rec in topo_ops.LEDGER.pop_node(name):
+            if self.journal is not None and rec.intent_id:
+                self.journal.close(rec.intent_id, outcome="node-terminated")
 
     def _get_evictable_pods(self, pods: List[Pod]) -> List[Pod]:
         evictable = []

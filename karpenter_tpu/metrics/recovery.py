@@ -26,6 +26,11 @@ registry.expose()):
   ``noop`` (already converged)
 - ``karpenter_recovery_seconds``            histogram — wall seconds of
   one full journal replay (readyz stays 503 ``recovering`` meanwhile)
+- ``karpenter_ledger_recovery_seconds``     histogram — wall seconds
+  spent rebuilding the topology occupancy ledger from open carve
+  intents during one journal replay (a slice of recovery_seconds)
+- ``karpenter_ledger_recovered_carves_total``  counter — carve records
+  re-committed into the occupancy ledger by startup recovery
 - ``karpenter_watch_relist_total``          counter, ``kind``/``reason``
   labels — full relist-and-reconcile passes a watch performed after a
   gap (``expired`` = resourceVersion too old / 410, ``reconnect`` =
@@ -72,6 +77,15 @@ RECOVERY_INTENTS_TOTAL = DEFAULT.counter(
 RECOVERY_SECONDS = DEFAULT.histogram(
     "recovery_seconds",
     "Wall seconds of one full journal replay at startup")
+
+LEDGER_RECOVERY_SECONDS = DEFAULT.histogram(
+    "ledger_recovery_seconds",
+    "Wall seconds rebuilding the occupancy ledger from open carve "
+    "intents during startup recovery")
+
+LEDGER_RECOVERED_CARVES_TOTAL = DEFAULT.counter(
+    "ledger_recovered_carves_total",
+    "Carve records re-committed into the occupancy ledger by recovery")
 
 WATCH_RELIST_TOTAL = DEFAULT.counter(
     "watch_relist_total",

@@ -21,9 +21,19 @@ registry.expose()):
   displacement price met or exceeded a fresh node for the beneficiary),
   ``no-victim`` (no strictly-lower-band resident to displace),
   ``unplaceable`` (displacement alone still left the beneficiary without
-  a carve; evictions rolled back)
+  a carve; evictions rolled back), ``budget`` (the anti-thrash
+  preemption budget had no token for the victim's band, or the victim
+  gang is still in its post-displacement cooldown)
 - ``karpenter_preemption_displaced_pods_total``  counter — member pods
   unbound and requeued through the band-aware batcher by preemptions
+- ``karpenter_preemption_budget_tokens``  gauge, ``band`` label —
+  displacement tokens currently available in the per-band token bucket
+- ``karpenter_preemption_budget_declines_total``  counter, ``reason``
+  label — candidates filtered by the budget: ``tokens`` (band bucket
+  empty) or ``cooldown`` (victim gang displaced within the last N
+  gang windows)
+- ``karpenter_preemption_budget_cooldowns``  gauge — victim gangs
+  currently inside their post-displacement cooldown window
 
 Carve self-heal rides the existing ``karpenter_filter_fallback_total``
 counter with ``reason="carve-mismatch"`` (metrics/filter.py).
@@ -57,8 +67,21 @@ PREEMPTIONS_TOTAL = DEFAULT.counter(
 PREEMPTION_DECLINED_TOTAL = DEFAULT.counter(
     "preemption_declined_total",
     "Preemption attempts declined, by reason (fresh-cheaper | no-victim "
-    "| unplaceable)")
+    "| unplaceable | budget)")
 
 PREEMPTION_DISPLACED_PODS_TOTAL = DEFAULT.counter(
     "preemption_displaced_pods_total",
     "Member pods unbound and requeued by gang preemptions")
+
+PREEMPTION_BUDGET_TOKENS = DEFAULT.gauge(
+    "preemption_budget_tokens",
+    "Displacement tokens available in the per-band preemption budget")
+
+PREEMPTION_BUDGET_DECLINES_TOTAL = DEFAULT.counter(
+    "preemption_budget_declines_total",
+    "Preemption candidates filtered by the anti-thrash budget, by reason "
+    "(tokens | cooldown)")
+
+PREEMPTION_BUDGET_COOLDOWNS = DEFAULT.gauge(
+    "preemption_budget_cooldowns",
+    "Victim gangs currently inside their post-displacement cooldown")

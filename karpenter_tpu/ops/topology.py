@@ -155,6 +155,17 @@ def constraints_sig(labels: Optional[dict], taints: Optional[Sequence]
     return (lab, tnt)
 
 
+def sig_from_json(obj):
+    """Re-tuplify a constraints signature that round-tripped through the
+    intent journal (JSON turns the nested tuples into lists). Recovery
+    must restore the EXACT tuple shape or the rebuilt ledger's nodes
+    would never match a window's ``constraints_sig`` and silently stop
+    being seed bins."""
+    if isinstance(obj, (list, tuple)):
+        return tuple(sig_from_json(x) for x in obj)
+    return obj
+
+
 # -- the process occupancy ledger -----------------------------------------
 
 @dataclass
@@ -165,6 +176,11 @@ class CarveRecord:
     cells: np.ndarray            # flat cell indices held on the node
     band: str
     pods: List[Tuple[str, str]]  # (namespace, name) of the members here
+    # the write-ahead carve intent backing this record (empty when no
+    # journal is attached): the id rides with the record so every release
+    # seam — preemption, gang unwind, node termination, prune — can close
+    # the durable half without a separate gang→intent map
+    intent_id: str = ""
 
 
 @dataclass
@@ -187,7 +203,14 @@ class OccupancyLedger:
     pods back into the bin pool and (b) enumerate preemption victims.
     ``prune(live)`` drops nodes the cluster no longer has — the encoder
     calls it with the live node set every window, so terminated nodes
-    self-clean without a dedicated hook."""
+    self-clean without a dedicated hook.
+
+    The in-memory state is the CACHE; the durable half is the set of
+    open ``carve`` intents in the write-ahead journal (one per
+    gang × node, ``intent_id`` on each record — docs/robustness.md §6).
+    Every mutation seam that removes a record returns it so the caller
+    can close its intent; startup recovery rebuilds this ledger from the
+    open intents before any controller runs."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -195,7 +218,8 @@ class OccupancyLedger:
 
     def commit(self, node: str, dims: Sequence[int], type_name: str,
                labels_sig: tuple, gang_key: Any, cells: Sequence[int],
-               band: str, pods: Sequence[Tuple[str, str]]) -> None:
+               band: str, pods: Sequence[Tuple[str, str]],
+               intent_id: str = "") -> None:
         with self._lock:
             ng = self._nodes.get(node)
             if ng is None or tuple(ng.dims) != tuple(dims):
@@ -206,13 +230,15 @@ class OccupancyLedger:
             idx = np.asarray(list(cells), np.int64)
             ng.occ[idx] = True
             ng.carves[gang_key] = CarveRecord(
-                gang_key=gang_key, cells=idx, band=band, pods=list(pods))
+                gang_key=gang_key, cells=idx, band=band, pods=list(pods),
+                intent_id=intent_id)
         self._gauge()
 
-    def release_gang(self, gang_key: Any) -> List[str]:
+    def pop_gang(self, gang_key: Any) -> List[Tuple[str, CarveRecord]]:
         """Free every cell the gang holds anywhere; empty nodes drop out.
-        Returns the nodes that were touched."""
-        touched: List[str] = []
+        Returns the removed ``(node, record)`` pairs — the records carry
+        the carve intent ids the caller must close in the journal."""
+        removed: List[Tuple[str, CarveRecord]] = []
         with self._lock:
             for name in list(self._nodes):
                 ng = self._nodes[name]
@@ -220,24 +246,46 @@ class OccupancyLedger:
                 if rec is None:
                     continue
                 ng.occ[rec.cells] = False
-                touched.append(name)
+                removed.append((name, rec))
                 if not ng.carves:
                     del self._nodes[name]
-        if touched:
+        if removed:
             self._gauge()
-        return touched
+        return removed
+
+    def release_gang(self, gang_key: Any) -> List[str]:
+        """:meth:`pop_gang` keeping only the touched node names (the
+        journal-free callers' shape)."""
+        return [name for name, _rec in self.pop_gang(gang_key)]
+
+    def pop_node(self, node: str) -> List[CarveRecord]:
+        """Drop one node's grid entirely (termination finalizer / GC
+        seam) and return its carve records so the caller can close their
+        journal intents — a terminated node must stop being a seed bin
+        AND stop being durable."""
+        with self._lock:
+            ng = self._nodes.pop(node, None)
+        self._gauge()
+        return list(ng.carves.values()) if ng is not None else []
 
     def forget_node(self, node: str) -> None:
         with self._lock:
             self._nodes.pop(node, None)
         self._gauge()
 
-    def prune(self, live: Sequence[str]) -> None:
+    def prune(self, live: Sequence[str]) -> List[CarveRecord]:
+        """Drop nodes the cluster no longer has; returns the dropped
+        carve records so a journal-aware caller can close their intents
+        (otherwise recovery's node-gone rule closes them at the next
+        restart)."""
         keep = set(live)
+        dropped: List[CarveRecord] = []
         with self._lock:
             for name in [n for n in self._nodes if n not in keep]:
+                dropped.extend(self._nodes[name].carves.values())
                 del self._nodes[name]
         self._gauge()
+        return dropped
 
     def snapshot(self) -> List[NodeGrid]:
         """Deep-enough copies for a window encode: occupancy planes and
@@ -247,7 +295,7 @@ class OccupancyLedger:
                 node=ng.node, dims=ng.dims, type_name=ng.type_name,
                 labels_sig=ng.labels_sig, occ=ng.occ.copy(),
                 carves={k: CarveRecord(r.gang_key, r.cells.copy(), r.band,
-                                       list(r.pods))
+                                       list(r.pods), r.intent_id)
                         for k, r in ng.carves.items()})
                 for ng in self._nodes.values()]
 

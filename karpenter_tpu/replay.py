@@ -95,6 +95,13 @@ class ReplayConfig:
     flood_pool: int = 512         # distinct flood pod objects (cycled)
     gang_fraction: float = 0.0    # of the cohort: all-or-nothing pod groups
     gang_size: int = 4            # members per injected gang
+    # slice shape stamped on every gang member (karpenter.sh/pod-group-
+    # slice). Non-empty → the catalog additionally offers a TPU host type
+    # per tenant zone and the gangs route through the topology-carve
+    # planner, journaling one durable carve intent per committed slice —
+    # the carve-journal-tax bench leg (config_17) measures exactly that
+    # against this run's paced wall
+    gang_slice: str = ""
     # fraction of the default-band cohort pinned to spot capacity
     # (node_selector capacity-type=spot). spot_fraction > 0 also registers
     # the termination + capacity-GC controllers and (chaos on) arms seeded
@@ -154,6 +161,21 @@ def tenant_catalog(tenants: int, types_per_zone: int = 6) -> list:
             price=0.04 * cpus[i % len(cpus)])
         for i in range(types_per_zone)
     ]
+
+
+def tpu_tenant_types(tenants: int, topology: str) -> list:
+    """One multi-host TPU type whose torus can carve ``topology``-shaped
+    slices, offered in every tenant zone — the capacity the gang_slice
+    cohort lands on. The v5e-4x4 host carves four 2x2 slices, so slice
+    gangs pack 4-to-a-node and the carve ledger sees real sharing."""
+    zones = [f"replay-zone-{i + 1}" for i in range(tenants)]
+    offerings = [Offering(ct, z) for z in zones
+                 for ct in ("on-demand", "spot")]
+    family = topology.split("-", 1)[0] if "-" in topology else "v5e"
+    host = f"{family}-4x4"
+    return [make_instance_type(
+        name=f"replay-tpu-{host}", cpu="32", memory="64Gi", pods="32",
+        offerings=offerings, price=4.0, tpu_topology=host)]
 
 
 def tenant_zone(tenant: int) -> str:
@@ -284,7 +306,10 @@ def run_replay(cfg: ReplayConfig) -> dict:
         window_l1_seconds=2.0))
     core = KubeCore()
     kube = inject.ChaosKube(core) if cfg.chaos else core
-    fake = FakeCloudProvider(catalog=tenant_catalog(cfg.tenants))
+    catalog = tenant_catalog(cfg.tenants)
+    if cfg.gang_slice:
+        catalog += tpu_tenant_types(cfg.tenants, cfg.gang_slice)
+    fake = FakeCloudProvider(catalog=catalog)
     provider = decorate(fake)
     journal = None
     if cfg.journal_dir:
@@ -492,6 +517,9 @@ def run_replay(cfg: ReplayConfig) -> dict:
                 pod.metadata.labels[wellknown.POD_GROUP_LABEL] = gname
                 pod.metadata.labels[wellknown.POD_GROUP_SIZE_LABEL] = \
                     str(cfg.gang_size)
+                if cfg.gang_slice:
+                    pod.metadata.labels[
+                        wellknown.POD_GROUP_SLICE_LABEL] = cfg.gang_slice
                 try:
                     kube.create(pod)
                 except Exception:

@@ -33,7 +33,23 @@ gang-bind     open → nodes-created → bound → closed
               (failure leg: … → unwinding → unwound → closed)
 drain         open → deleting → closed
 node-delete   open → instance-deleted → closed
+carve         open → closed
+preempt       open → victims-unbound → beneficiary-bound → closed
 ========== ======================================================
+
+Two of these make topology state crash-consistent (docs/robustness.md
+§6). A ``carve`` intent is LONG-LIVED: it opens when a slice gang's
+contiguous cell set is committed to the occupancy ledger and closes
+only when the carve is released (preemption, gang teardown, node
+termination) — so the set of open carve intents IS the durable form of
+:data:`karpenter_tpu.ops.topology.LEDGER`, and startup recovery
+rebuilds the ledger from them bit-for-bit before any controller runs.
+Compaction keeps open carve records and folds closed carve pairs like
+any other intent, so a long-lived fleet's journal stays bounded. A
+``preempt`` intent brackets one victim displacement: ``open`` before
+the first member unbind, ``victims-unbound`` once the members are
+requeued and the victim's ledger cells released, ``beneficiary-bound``
+after the displacing gang binds onto the freed capacity.
 
 A ``fleet-launch`` intent is stamped with the ``karpenter.sh/
 launch-nonce`` value *before* the provider create runs: the caller
@@ -79,6 +95,11 @@ MACHINES: Dict[str, Tuple[str, ...]] = {
                   "unwinding", "unwound", "closed"),
     "drain": ("open", "deleting", "closed"),
     "node-delete": ("open", "instance-deleted", "closed"),
+    # durable occupancy-ledger entry: open = carve committed and live,
+    # closed = released (long-lived; survives compaction while open)
+    "carve": ("open", "closed"),
+    # one victim displacement, bracketed end to end
+    "preempt": ("open", "victims-unbound", "beneficiary-bound", "closed"),
 }
 
 #: every named crash point the soak can arm: pre (record not yet
@@ -366,6 +387,14 @@ class IntentJournal:
         """Snapshot of the live index (open = not yet closed)."""
         with self._lock:
             return dict(self._intents)
+
+    def open_of_kind(self, kind: str) -> List[Intent]:
+        """Open intents of one kind, id-ordered. The carve/preempt paths
+        use this to find a gang's durable carve records after a restart,
+        when the in-memory gang→intent map is gone."""
+        with self._lock:
+            return sorted((i for i in self._intents.values()
+                           if i.kind == kind), key=lambda i: i.id)
 
     def covered_nonces(self) -> Set[str]:
         """Launch nonces owned by open intents — the GC ↔ recovery

@@ -1,0 +1,168 @@
+"""Anti-thrash preemption budget: a token bucket over displacements.
+
+Priced preemption (solver/gang.py) decides whether displacing a resident
+gang is *cheaper* than a fresh node — but price alone does not bound
+churn. Under a saturated repeat-window flood the same low-band residents
+can be displaced, requeued, re-placed, and displaced again every window:
+each individual displacement is locally optimal while the fleet as a
+whole oscillates. This module adds the missing global guard, two rules
+deep:
+
+1. **Per-band token bucket.** Each pressure band (pressure/bands.py) has
+   a displacement budget: a bucket with a fixed capacity that refills by
+   ``refill_per_window`` tokens at the start of every gang window.
+   Executing a preemption charges one token from the *victim's* band;
+   when a band's bucket is empty, further candidates from that band are
+   filtered out of the window's :class:`PreemptContext` before the
+   solver ever sees them. ``system-critical`` has no bucket because it
+   is never a victim by construction.
+
+2. **Per-gang cooldown.** A gang displaced once cannot be displaced
+   again for ``cooldown_windows`` gang windows, even if its band has
+   tokens. This is the direct no-thrash guarantee: a victim that was
+   just requeued gets at least N windows of residence before it can be
+   priced into another displacement.
+
+Both filters surface as ``karpenter_preemption_budget_*`` series and as
+the ``budget`` reason on ``karpenter_preemption_declined_total``, so a
+capped window is observable rather than silent (see
+docs/observability.md). The budget is deliberately in-memory and
+process-local: it is a *rate* guard, not correctness state, so losing it
+on restart only means one uncapped refill — the durable carve/preempt
+intents (runtime/journal.py) carry all crash-consistency obligations.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional
+
+from karpenter_tpu.metrics.topology import (
+    PREEMPTION_BUDGET_COOLDOWNS,
+    PREEMPTION_BUDGET_DECLINES_TOTAL,
+    PREEMPTION_BUDGET_TOKENS,
+    PREEMPTION_DECLINED_TOTAL,
+)
+from karpenter_tpu.pressure.bands import BANDS
+
+# Per-band bucket capacity: how many displacements a band can absorb in a
+# burst. Lower bands are cheaper to displace, so they get deeper buckets;
+# system-critical is never a victim and has no bucket at all.
+DEFAULT_CAPACITY: Dict[str, int] = {
+    "high": 1,
+    "default": 2,
+    "low": 4,
+    "besteffort": 4,
+}
+
+
+class PreemptionBudget:
+    """Token-bucket displacement budget with per-gang cooldown.
+
+    Lifecycle per gang window: the provisioning worker calls
+    :meth:`tick` once when it starts building a preempt context, then
+    :meth:`admit` to filter the candidate list, and :meth:`charge` for
+    each displacement actually executed. All three are lock-protected so
+    the worker thread and tests can interleave safely.
+    """
+
+    def __init__(self,
+                 capacity: Optional[Dict[str, int]] = None,
+                 refill_per_window: int = 1,
+                 cooldown_windows: int = 3) -> None:
+        self.capacity = dict(capacity or DEFAULT_CAPACITY)
+        self.refill_per_window = int(refill_per_window)
+        self.cooldown_windows = int(cooldown_windows)
+        self._lock = threading.Lock()
+        self._window = 0
+        # buckets start full so the first window is never throttled
+        self._tokens: Dict[str, int] = dict(self.capacity)
+        # gang_key(str) -> window index when it was last displaced
+        self._cooldown: Dict[str, int] = {}
+        self._publish_locked()
+
+    # -- window lifecycle --------------------------------------------------
+
+    def tick(self) -> None:
+        """Advance one gang window: refill every band's bucket (up to
+        capacity) and expire finished cooldowns."""
+        with self._lock:
+            self._window += 1
+            for band, cap in self.capacity.items():
+                self._tokens[band] = min(
+                    cap, self._tokens.get(band, 0) + self.refill_per_window)
+            # a gang charged at window W stays filtered through window
+            # W + cooldown_windows inclusive
+            horizon = self._window - self.cooldown_windows
+            self._cooldown = {g: w for g, w in self._cooldown.items()
+                              if w >= horizon}
+            self._publish_locked()
+
+    def admit(self, candidates: Iterable) -> List:
+        """Filter a window's preemption candidates down to what the
+        budget allows. Candidates whose gang is cooling down are dropped
+        first; the rest are ranked cheapest-displacement-first per band
+        and truncated to the band's available tokens (tokens are only
+        *reserved* here — :meth:`charge` consumes them when the
+        displacement actually executes). Declines are counted but the
+        admitted list preserves the caller's original order so solver
+        tie-breaking stays deterministic."""
+        cands = list(candidates)
+        if not cands:
+            return cands
+        with self._lock:
+            admitted = []
+            by_band: Dict[str, List] = {}
+            for c in cands:
+                key = str(c.gang_key)
+                if key in self._cooldown:
+                    self._decline_locked(c, "cooldown")
+                    continue
+                by_band.setdefault(c.band, []).append(c)
+            allowed = set()
+            for band, group in by_band.items():
+                budget = self._tokens.get(band)
+                if budget is None:  # unknown band: no bucket, no throttle
+                    allowed.update(id(c) for c in group)
+                    continue
+                ranked = sorted(group,
+                                key=lambda c: (c.displacement_cost,
+                                               str(c.gang_key)))
+                for c in ranked[:budget]:
+                    allowed.add(id(c))
+                for c in ranked[budget:]:
+                    self._decline_locked(c, "tokens")
+            admitted = [c for c in cands if id(c) in allowed]
+            return admitted
+
+    def charge(self, gang_key, band: str) -> None:
+        """Record one executed displacement: consume a token from the
+        victim's band and start the victim gang's cooldown."""
+        with self._lock:
+            if band in self._tokens:
+                self._tokens[band] = max(0, self._tokens[band] - 1)
+            self._cooldown[str(gang_key)] = self._window
+            self._publish_locked()
+
+    # -- introspection (tests) ---------------------------------------------
+
+    def tokens(self, band: str) -> int:
+        with self._lock:
+            return self._tokens.get(band, 0)
+
+    def in_cooldown(self, gang_key) -> bool:
+        with self._lock:
+            return str(gang_key) in self._cooldown
+
+    # -- internals ---------------------------------------------------------
+
+    def _decline_locked(self, cand, reason: str) -> None:
+        PREEMPTION_BUDGET_DECLINES_TOTAL.inc(reason=reason)
+        PREEMPTION_DECLINED_TOTAL.inc(reason="budget")
+
+    def _publish_locked(self) -> None:
+        for band in BANDS:
+            if band in self.capacity:
+                PREEMPTION_BUDGET_TOKENS.set(
+                    float(self._tokens.get(band, 0)), band=band)
+        PREEMPTION_BUDGET_COOLDOWNS.set(float(len(self._cooldown)))

@@ -1,0 +1,123 @@
+"""Anti-thrash preemption budget (scheduling/preempt_budget.py).
+
+Pins the two guards ISSUE 19 adds in front of priced preemption:
+
+- per-band token bucket: a band's candidates are truncated to its
+  available tokens (cheapest displacement first), tokens refill one per
+  gang window up to capacity, and an executed displacement consumes one;
+- per-gang cooldown: a gang displaced once is filtered out of every
+  preempt context for the next N windows, then becomes eligible again;
+- a saturated repeat-window flood converges: no gang is ever displaced
+  twice within the cooldown, and per-window displacements never exceed
+  the band cap;
+- declines surface on ``karpenter_preemption_budget_declines_total``
+  (tokens | cooldown) and as reason="budget" on
+  ``karpenter_preemption_declined_total``.
+"""
+
+import numpy as np
+
+from karpenter_tpu.metrics.topology import (
+    PREEMPTION_BUDGET_DECLINES_TOTAL, PREEMPTION_DECLINED_TOTAL,
+)
+from karpenter_tpu.scheduling.preempt_budget import PreemptionBudget
+from karpenter_tpu.solver.gang import PreemptCandidate
+
+
+def _count(metric, **labels) -> float:
+    return metric.collect().get(tuple(sorted(labels.items())), 0.0)
+
+
+def _cand(gang, band="low", cost=0.1):
+    return PreemptCandidate(
+        gang_key=gang, bin_index=0, node="n1", band=band,
+        pods=[("d", f"{gang}-m0")], cells=np.arange(4),
+        refund=[1, 1], displacement_cost=cost)
+
+
+class TestTokenBucket:
+    def test_starts_full_and_admits_up_to_capacity(self):
+        b = PreemptionBudget(capacity={"low": 2}, cooldown_windows=0)
+        cands = [_cand(f"g{i}", cost=0.1 * i) for i in range(4)]
+        out = b.admit(cands)
+        assert [c.gang_key for c in out] == ["g0", "g1"]
+
+    def test_truncation_keeps_cheapest_not_first(self):
+        b = PreemptionBudget(capacity={"low": 1})
+        expensive = _cand("pricey", cost=9.0)
+        cheap = _cand("bargain", cost=0.1)
+        out = b.admit([expensive, cheap])
+        assert [c.gang_key for c in out] == ["bargain"]
+
+    def test_charge_consumes_and_tick_refills_to_cap(self):
+        b = PreemptionBudget(capacity={"low": 2}, refill_per_window=1,
+                             cooldown_windows=0)
+        b.charge("g0", "low")
+        b.charge("g1", "low")
+        assert b.tokens("low") == 0
+        assert b.admit([_cand("g2")]) == []
+        b.tick()
+        assert b.tokens("low") == 1
+        b.tick()
+        b.tick()
+        assert b.tokens("low") == 2  # capped, never above capacity
+
+    def test_unknown_band_is_not_throttled(self):
+        b = PreemptionBudget(capacity={"low": 0})
+        exotic = _cand("g0", band="exotic-band")
+        assert b.admit([exotic]) == [exotic]
+
+    def test_decline_metrics(self):
+        t0 = _count(PREEMPTION_BUDGET_DECLINES_TOTAL, reason="tokens")
+        bud0 = _count(PREEMPTION_DECLINED_TOTAL, reason="budget")
+        b = PreemptionBudget(capacity={"low": 0})
+        assert b.admit([_cand("g0")]) == []
+        assert _count(PREEMPTION_BUDGET_DECLINES_TOTAL,
+                      reason="tokens") == t0 + 1
+        assert _count(PREEMPTION_DECLINED_TOTAL, reason="budget") == bud0 + 1
+
+
+class TestCooldown:
+    def test_displaced_gang_is_filtered_for_n_windows(self):
+        c0 = _count(PREEMPTION_BUDGET_DECLINES_TOTAL, reason="cooldown")
+        b = PreemptionBudget(capacity={"low": 8}, cooldown_windows=2)
+        b.charge("victim", "low")
+        assert b.in_cooldown("victim")
+        for _ in range(2):
+            b.tick()
+            assert b.admit([_cand("victim")]) == []
+        assert _count(PREEMPTION_BUDGET_DECLINES_TOTAL,
+                      reason="cooldown") == c0 + 2
+        b.tick()  # cooldown elapsed
+        assert not b.in_cooldown("victim")
+        assert [c.gang_key for c in b.admit([_cand("victim")])] == ["victim"]
+
+    def test_cooldown_is_per_gang(self):
+        b = PreemptionBudget(capacity={"low": 8}, cooldown_windows=3)
+        b.charge("a", "low")
+        out = b.admit([_cand("a"), _cand("b")])
+        assert [c.gang_key for c in out] == ["b"]
+
+
+class TestFloodConverges:
+    def test_no_gang_displaced_twice_within_cooldown(self):
+        """Saturated repeat-window flood: every window offers every
+        resident as a candidate; the budget must (1) never let one gang
+        be displaced twice within the cooldown and (2) never exceed the
+        band cap per window."""
+        cooldown = 3
+        b = PreemptionBudget(capacity={"low": 2}, refill_per_window=2,
+                             cooldown_windows=cooldown)
+        last_hit = {}
+        for window in range(1, 21):
+            b.tick()
+            admitted = b.admit([_cand(f"g{i}", cost=0.1) for i in range(6)])
+            assert len(admitted) <= 2  # band cap per window
+            for c in admitted:
+                key = str(c.gang_key)
+                if key in last_hit:
+                    assert window - last_hit[key] > cooldown, \
+                        f"{key} displaced twice within cooldown"
+                last_hit[key] = window
+                b.charge(c.gang_key, c.band)
+        assert last_hit  # the flood did displace, it just never thrashed

@@ -705,3 +705,77 @@ class TestCarveE2E:
         finally:
             for w in provisioning.workers.values():
                 w.stop()
+
+
+class TestCarve3DE2E:
+    """The real 3-D torus catalog type (tpu-v4-2x2x4) through the full
+    carve -> ledger -> seed-reuse worker path, closing the ROADMAP tail
+    "3-D grids are encoded and oracle-tested but no real 3-D catalog
+    type exercises them end-to-end"."""
+
+    def test_3d_carve_commits_and_second_gang_reuses_seed(self):
+        committed0 = _count(TOPOLOGY_CARVES_COMMITTED_TOTAL)
+        kube, provider, provisioning, selection = _harness()
+        try:
+            # a v4-family 2x2x2 cube only fits the 3-D 2x2x4 host (the
+            # v5e 2-D grids are a different family)
+            pods = [_gang_pod("cube", 2, i, slice_="v4-2x2x2")
+                    for i in range(2)]
+            expect_provisioned(kube, selection, provisioning, pods)
+            nodes = {expect_scheduled(kube, pod) for pod in pods}
+            assert len(nodes) == 1
+            node = kube.get("Node", next(iter(nodes)), "")
+            assert node.metadata.labels[
+                wellknown.LABEL_INSTANCE_TYPE] == "tpu-v4-2x2x4"
+            assert _count(TOPOLOGY_CARVES_COMMITTED_TOTAL) == committed0 + 1
+            snap = topo.LEDGER.snapshot()
+            assert [ng.node for ng in snap] == list(nodes)
+            assert snap[0].dims == (2, 2, 4)
+            assert int(snap[0].occ.sum()) == 8  # one 2x2x2 cube
+            # the second cube fills the REMAINING half of the same torus
+            # instead of launching a fresh $6/h host
+            pods2 = [_gang_pod("cube2", 2, i, slice_="v4-2x2x2")
+                     for i in range(2)]
+            expect_provisioned(kube, selection, provisioning, pods2)
+            nodes2 = {expect_scheduled(kube, pod) for pod in pods2}
+            assert nodes2 == nodes
+            assert int(topo.LEDGER.snapshot()[0].occ.sum()) == 16
+        finally:
+            for w in provisioning.workers.values():
+                w.stop()
+
+
+class TestTerminationReleasesLedger:
+    """Regression (ISSUE 19 satellite): a drained/GC'd carved node must
+    stop being offered as a seed bin — the termination finalizer pops the
+    node's ledger carves and folds their durable intents."""
+
+    def test_terminate_pops_ledger_and_closes_carve_intent(self, tmp_path):
+        from karpenter_tpu.api.core import Node, ObjectMeta
+        from karpenter_tpu.controllers.termination import Terminator
+        from karpenter_tpu.runtime.journal import IntentJournal
+
+        topo.LEDGER.reset()
+        kube = KubeCore()
+        provider = FakeCloudProvider(catalog=tpu_catalog())
+        journal = IntentJournal(str(tmp_path), fsync=False)
+        node = Node(metadata=ObjectMeta(
+            name="carved-n1", namespace="",
+            finalizers=[wellknown.TERMINATION_FINALIZER]))
+        kube.create(node)
+        node = kube.get("Node", "carved-n1", "")
+        cid = journal.open_intent(
+            "carve", gang="ns/g1", node="carved-n1", grid=[4, 4],
+            type="tpu-v5e-4x4", sig=[[], []], cells=[0, 1, 4, 5],
+            band="default", pods=["ns/p0"])
+        topo.LEDGER.commit("carved-n1", (4, 4), "tpu-v5e-4x4", ((), ()),
+                           "ns/g1", [0, 1, 4, 5], "default", [("ns", "p0")],
+                           intent_id=cid)
+        assert topo.LEDGER.node_count() == 1
+        term = Terminator(kube, provider, journal=journal)
+        try:
+            term.terminate(node)
+        finally:
+            term.eviction_queue.stop()
+        assert topo.LEDGER.node_count() == 0
+        assert cid not in journal.open_intents()

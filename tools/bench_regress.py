@@ -101,6 +101,11 @@ SERIES = [
     ("topology_carve_speedup",
      lambda l: _dig(l, "extra", "config_16_topology_carve", "speedup"),
      "higher", 0.80),
+    # cold-ledger rebuild over the gang loop's open carve intents: the
+    # same sub-10ms-wall jitter argument as recovery_time_p99_ms
+    ("ledger_recovery_p99_ms",
+     lambda l: _dig(l, "extra", "config_17_carve_journal", "recovery",
+                    "wall_ms", "p99_ms"), "lower", 2.00),
 ]
 
 # (name, extractor(line) -> bool|None): latest non-None entry must be True
@@ -174,6 +179,21 @@ FLAGS = [
                               "killswitch_gate"))
                 and bool(_dig(l, "extra", "config_16_topology_carve",
                               "killswitch_parity")))),
+    # the durable-ledger contract: carve-journal tax within the 1% gate,
+    # the cold rebuild bit-identical to the pre-death snapshot, every
+    # preempt/gang machine folded (only live carves stay open), and
+    # zero replay errors
+    ("preempt_crash_clean",
+     lambda l: (None if _dig(l, "extra", "config_17_carve_journal",
+                             "recovery") is None
+                else bool(_dig(l, "extra", "config_17_carve_journal",
+                               "tax_gate"))
+                and bool(_dig(l, "extra", "config_17_carve_journal",
+                              "recovery", "recovered_bitident"))
+                and _dig(l, "extra", "config_17_carve_journal",
+                         "recovery", "errors") == 0
+                and _dig(l, "extra", "config_17_carve_journal",
+                         "non_carve_open_after") == 0)),
 ]
 
 
