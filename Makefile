@@ -1,7 +1,7 @@
 # Reference: the root Makefile (test: ginkgo -r; battletest: race+coverage).
 # Python analog: pytest suite, native kernel build, benchmarks.
 
-.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-filter bench-policy bench-global bench-topology bench-carve-journal bench-replay bench-replay-smoke bench-history bench-regress replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-crash chaos-overload clean help
+.PHONY: test battletest bench bench-shapes bench-control bench-pipeline bench-consolidate bench-marshal bench-gang bench-filter bench-policy bench-affinity bench-global bench-topology bench-carve-journal bench-replay bench-replay-smoke bench-history bench-regress replay-smoke metrics-lint native dryrun lint chart chaos-soak chaos-crash chaos-overload clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-12s %s\n", $$1, $$2}'
@@ -46,6 +46,10 @@ bench-filter: ## Device-resident fused feasibility, bit-plane window filter vs h
 bench-policy: ## Device-vectorized policy scoring vs per-cell host loop + spot repack frontier (config_13); prints verdict line on stderr
 	python bench.py --only config_13 \
 		| python tools/policy_verdict.py
+
+bench-affinity: ## Soft-affinity scoring: co-location steering A/B + fused soft-row kernel vs host loop (config_18); prints verdict line on stderr
+	python bench.py --only config_18 \
+		| python tools/affinity_verdict.py
 
 bench-global: ## Whole-window global solve vs per-schedule FFD fleet cost A/B (config_14); prints verdict line on stderr
 	python bench.py --only config_14 \

@@ -2404,6 +2404,213 @@ def config_17_carve_journal():
     }
 
 
+def config_18_soft_affinity():
+    """Round-16 gate: preferred (soft) pod-affinity fused into the
+    window-scoring jit (docs/scheduling.md §8). A two-zone fleet carries
+    24 follower cohorts, each preferring co-location with an anchor
+    cohort pinned to an alternating zone; the preferred terms become
+    per-schedule zone vote maps.
+
+    Three legs:
+
+    - co-location A/B: with soft scoring on, `ops/policy.steer_zone`
+      pins every follower's launch to its anchor's zone; with
+      KARPENTER_SOFT_AFFINITY=0 the launcher falls back to its
+      deterministic first-allowed-zone pick, scattering the cohorts
+      whose anchor sits in the other zone. Gate: co-located cohorts
+      >= 2x the soft-off leg at <= 1% node-count regression (steering
+      must narrow zones, never inflate the fleet);
+    - kernel A/B: `score_fused_window` with per-(schedule, zone) soft
+      adjustment rows vs the per-cell host loop computing the same
+      exact-int algebra (micro-$ base + clamp(-w x scale), min over
+      viable zones) from raw offerings. Gate: >= 5x, with the probe
+      re-verification timed INSIDE the device leg;
+    - the filter contract: zero score-mismatch / soft-affinity-mismatch
+      fallbacks across the whole run — every soft row that reached the
+      pack kernel survived the probe against the scalar oracle.
+    """
+    import numpy as _np
+
+    from karpenter_tpu.api import wellknown as _wk
+    from karpenter_tpu.api.core import NodeSelectorRequirement as _Req
+    from karpenter_tpu.controllers.provisioning import universe_constraints
+    from karpenter_tpu.metrics.policy import POLICY_FALLBACK_TOTAL
+    from karpenter_tpu.models.cost import CostConfig
+    from karpenter_tpu.ops import device_filter
+    from karpenter_tpu.ops import policy as ops_policy
+    from karpenter_tpu.solver import policy as policy_registry
+    from karpenter_tpu.solver.adapter import marshal_pods_interned
+    from karpenter_tpu.solver.batch_solve import Problem, solve_batch
+    from karpenter_tpu.solver.policy import PolicyContext
+    from karpenter_tpu.solver.solve import (
+        SolverConfig, resolved_device_max_shapes,
+    )
+
+    if not ops_policy.enabled():
+        return {"skipped": "KARPENTER_POLICY_DEVICE=0"}
+    if not device_filter.enabled():
+        return {"skipped": "KARPENTER_DEVICE_FILTER=0 (no fused window)"}
+
+    T, S = 400, 24
+    catalog = make_catalog(T, zones=2)
+    constraints = universe_constraints(catalog)
+    zones = [f"bench-zone-{z}" for z in (1, 2)]
+    ctx = PolicyContext(soft_affinity_cost_per_weight=0.001)
+    cfg = SolverConfig(device_min_pods=1)
+    cheapest = policy_registry.get("cheapest")
+
+    per = 4800 // S
+    anchors, problems = [], []
+    for b in range(S):
+        anchor = zones[b % 2]
+        anchors.append(anchor)
+        pods = make_pods(per, MIXED_SHAPES[b % len(MIXED_SHAPES):]
+                         + MIXED_SHAPES[:b % len(MIXED_SHAPES)])
+        for j, p in enumerate(pods):
+            p.metadata.name = f"p{b}-{j}"
+        problems.append(Problem(
+            constraints=constraints.deepcopy(), pods=pods,
+            instance_types=catalog,
+            soft_affinity={(_wk.LABEL_TOPOLOGY_ZONE, anchor): 100}))
+
+    # -- co-location A/B: steered zone pick vs the soft-off default ------
+    def picks(env_on):
+        prev = os.environ.get("KARPENTER_SOFT_AFFINITY")
+        os.environ["KARPENTER_SOFT_AFFINITY"] = "1" if env_on else "0"
+        try:
+            steers, resolved = [], []
+            for prob in problems:
+                z = ops_policy.steer_zone(
+                    catalog, prob.constraints.requirements,
+                    cfg.cost_config, ctx, prob.soft_affinity)
+                steers.append(z)
+                # the launcher's deterministic fallback: first allowed zone
+                resolved.append(z if z is not None else zones[0])
+            return steers, resolved
+        finally:
+            if prev is None:
+                os.environ.pop("KARPENTER_SOFT_AFFINITY", None)
+            else:
+                os.environ["KARPENTER_SOFT_AFFINITY"] = prev
+
+    steers_on, picks_on = picks(True)
+    _, picks_off = picks(False)
+    steered = sum(1 for z in steers_on if z is not None)
+    coloc_on = sum(1 for z, a in zip(picks_on, anchors) if z == a)
+    coloc_off = sum(1 for z, a in zip(picks_off, anchors) if z == a)
+    coloc_gain = round(coloc_on / max(1, coloc_off), 2)
+
+    # node-count regression: the steered (zone-pinned) window vs the
+    # unpinned one — steering narrows the offering set, so the gate is
+    # that the narrowed fleet packs no more than 1% extra nodes
+    def pinned(zs):
+        out = []
+        for prob, z in zip(problems, zs):
+            tight = prob.constraints.deepcopy()
+            tight.requirements = tight.requirements.add(_Req(
+                key=_wk.LABEL_TOPOLOGY_ZONE, operator="In", values=[z]))
+            out.append(Problem(constraints=tight, pods=prob.pods,
+                               instance_types=catalog))
+        return out
+
+    def total_nodes(rs):
+        return sum(sum(p.node_quantity for p in r.packings) for r in rs)
+
+    nodes_on = total_nodes(solve_batch(pinned(picks_on), cfg))
+    nodes_off = total_nodes(solve_batch(
+        [Problem(constraints=p.constraints, pods=p.pods,
+                 instance_types=catalog) for p in problems], cfg))
+    regression_pct = round(
+        (nodes_on - nodes_off) / max(1, nodes_off) * 100.0, 3)
+
+    # -- kernel A/B over one fused soft window ---------------------------
+    fb_before = dict(POLICY_FALLBACK_TOTAL.collect())
+    marshaled = [marshal_pods_interned(p.pods) for p in problems]
+    fused = device_filter.prepare_fused(problems, marshaled, cfg,
+                                        resolved_device_max_shapes(cfg))
+    if fused is None:
+        return {"error": "window not fused — soft scoring A/B needs the "
+                         "bit-plane window (config_12's stage)"}
+    try:
+        imax = int(ops_policy._INT32_MAX)
+        clamp = int(ops_policy._SOFT_CLAMP)
+        scale = int(round(ctx.soft_affinity_cost_per_weight * 1e6))
+        cost_config = cfg.cost_config or CostConfig()
+
+        def host_leg():
+            rows = []
+            for i in fused.batch_idx:
+                reqs = problems[i].constraints.requirements
+                votes = {z: w for (k, z), w in
+                         problems[i].soft_affinity.items()
+                         if k == _wk.LABEL_TOPOLOGY_ZONE}
+                cts = reqs.capacity_types()
+                zallow = reqs.zones()
+                row = []
+                for p in fused.packables:
+                    it = fused.uni_types[p.index]
+                    best = imax
+                    for ct in {o.capacity_type for o in it.offerings}:
+                        if cts is not None and ct not in cts:
+                            continue
+                        viable = [o.zone for o in it.offerings
+                                  if o.capacity_type == ct
+                                  and (zallow is None or o.zone in zallow)]
+                        if not viable:
+                            continue
+                        base = it.price * cost_config.spot_price_factor \
+                            if ct == _wk.CAPACITY_TYPE_SPOT else it.price
+                        cell = int(ops_policy._encode_micro(base))
+                        adj = min(max(-clamp,
+                                      min(-votes.get(z, 0) * scale, clamp))
+                                  for z in viable)
+                        best = min(best, max(0, min(cell + adj, imax)))
+                    row.append(best)
+                rows.append(_np.asarray(row, dtype=_np.int32))
+            return rows
+
+        def device_leg():
+            rows = ops_policy.score_fused_window(
+                fused, cheapest, cost_config, ctx)
+            assert rows is not None, "device scoring fell back mid-bench"
+            return rows
+
+        host_rows = host_leg()
+        dev_rows = device_leg()  # warm tables + jit before the clock
+        divergence = sum(
+            int(_np.sum(_np.asarray(d)[:len(h)] != h))
+            for d, h in zip(dev_rows, host_rows))
+        host_times = run_timed(host_leg, budget_s=30.0)
+        device_times = run_timed(device_leg, budget_s=15.0)
+    finally:
+        fused.release()
+    st_host = _stats(host_times)
+    st_device = _stats(device_times)
+    speedup = round(st_host["p50_ms"] / (st_device["p50_ms"] or 1e-9), 2)
+
+    fb_after = dict(POLICY_FALLBACK_TOTAL.collect())
+    fallbacks = {dict(k).get("reason", "?"): fb_after[k] - fb_before.get(k, 0)
+                 for k in fb_after
+                 if fb_after[k] - fb_before.get(k, 0.0) > 0}
+    unverified = int(fallbacks.get("soft-affinity-mismatch", 0)
+                     + fallbacks.get("score-mismatch", 0))
+    return {
+        "pods": per * S, "types": T, "schedules_per_window": S,
+        "cohorts": S, "steered": int(steered),
+        "coloc_on": int(coloc_on), "coloc_off": int(coloc_off),
+        "coloc_gain": coloc_gain,
+        "nodes_on": int(nodes_on), "nodes_off": int(nodes_off),
+        "node_regression_pct": regression_pct,
+        "host_p50_ms": st_host["p50_ms"], "host_p99_ms": st_host["p99_ms"],
+        "device_p50_ms": st_device["p50_ms"],
+        "device_p99_ms": st_device["p99_ms"],
+        "speedup": speedup,
+        "row_divergence": int(divergence),
+        "unverified": unverified,
+        "policy_fallbacks": fallbacks,
+    }
+
+
 def jax_devices_first():
     import jax
 
@@ -2822,6 +3029,7 @@ def run_all(degraded: bool, probe_note: str = ""):
         ("config_15_crash_recovery", config_15_crash_recovery),
         ("config_16_topology_carve", config_16_topology_carve),
         ("config_17_carve_journal", config_17_carve_journal),
+        ("config_18_soft_affinity", config_18_soft_affinity),
     ):
         if not _selected(key, only):
             continue

@@ -105,8 +105,18 @@ class PodAffinityTerm:
 
 
 @dataclass
+class WeightedPodAffinityTerm:
+    """PreferredDuringSchedulingIgnoredDuringExecution entry: a soft
+    (anti-)affinity term scored with ``weight`` (kube range 1-100)."""
+
+    weight: int = 1
+    term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
 class PodAffinity:
     required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
 
 
 @dataclass

@@ -34,6 +34,10 @@ LABEL_CAPACITY_TYPE = KARPENTER_DOMAIN + "/capacity-type"
 # leaked instance is attributable to the exact launch that leaked it —
 # the GC controller logs it when terminating orphans
 LAUNCH_NONCE_TAG = KARPENTER_DOMAIN + "/launch-nonce"
+# operator-defined placement domain: a topology key for pod-(anti-)affinity
+# whose vocabulary comes from the provisioner's own requirements
+# (scheduling/affinity.py) — well-known so tighten() keeps its pin
+LABEL_NODE_GROUP = KARPENTER_DOMAIN + "/node-group"
 
 CAPACITY_TYPE_SPOT = "spot"
 CAPACITY_TYPE_ON_DEMAND = "on-demand"
@@ -55,6 +59,7 @@ WELL_KNOWN_LABELS = frozenset({
     LABEL_OS,
     LABEL_CAPACITY_TYPE,
     LABEL_HOSTNAME,  # used internally for hostname topology spread
+    LABEL_NODE_GROUP,  # topology-keyed affinity domain (affinity.py)
 })
 
 # NormalizedLabels (requirements.go:65-70): aliased concepts → well-known

@@ -41,8 +41,9 @@ from karpenter_tpu.metrics.consolidation import (
 from karpenter_tpu.models.consolidate import (
     fleet_prices, node_bin, reschedulable_pods)
 from karpenter_tpu.models.cost import CostConfig
+from karpenter_tpu.metrics.policy import SOFT_AFFINITY_BLOCKED_DRAINS_TOTAL
 from karpenter_tpu.obs import trace as obtrace
-from karpenter_tpu.ops.whatif import encode_window
+from karpenter_tpu.ops.whatif import encode_window, soft_affinity_loss
 from karpenter_tpu.runtime.kubecore import KubeCore, NotFound
 from karpenter_tpu.solver.whatif import (
     WhatIfConfig, dispatch_window, plan_window)
@@ -143,6 +144,7 @@ class ConsolidationController:
                  whatif_config: Optional[WhatIfConfig] = None,
                  cost_config: CostConfig = CostConfig(),
                  repack_cost_per_hour: float = 0.0,
+                 soft_affinity_cost_per_weight: float = 0.001,
                  journal=None):
         self.kube = kube
         self.provider = provider
@@ -154,6 +156,9 @@ class ConsolidationController:
         # interruption-priced handoff: spot nodes' keep-cost carries their
         # reclaim tax, so savings rank risk as well as discount
         self.repack_cost_per_hour = repack_cost_per_hour
+        # a drain that scatters a preferred co-located set pays the
+        # scheduler's soft-affinity price back out of its savings
+        self.soft_affinity_cost_per_weight = soft_affinity_cost_per_weight
 
     def kind(self) -> str:
         return "Provisioner"
@@ -228,9 +233,17 @@ class ConsolidationController:
                 continue
             if len(cand_idx) >= self.window_size:
                 break
+            price = prices.get(node.metadata.name, 0.0)
+            loss = soft_affinity_loss(node, movable, fleet, pods_by_node,
+                                      self.soft_affinity_cost_per_weight)
+            if loss > 0.0 and loss >= price:
+                # scattering the co-located set costs more than the node
+                CONSOLIDATION_FILTERED_TOTAL.inc(reason="soft-affinity")
+                SOFT_AFFINITY_BLOCKED_DRAINS_TOTAL.inc()
+                continue
             cand_idx.append(i)
             cand_movable.append(movable)
-            savings.append(prices.get(node.metadata.name, 0.0))
+            savings.append(price - loss)
 
         CONSOLIDATION_WINDOW_CANDIDATES.set(float(len(cand_idx)))
         obtrace.add_span("gather", t_gather, time.perf_counter(),

@@ -51,6 +51,7 @@ from karpenter_tpu import pressure
 from karpenter_tpu.metrics.gang import (
     GANG_WINDOWS_TOTAL, GANGS_PLACED_TOTAL, GANGS_UNPLACEABLE_TOTAL,
 )
+from karpenter_tpu.metrics.policy import SOFT_AFFINITY_STEERED_TOTAL
 from karpenter_tpu.metrics.pressure import WINDOW_SPLITS_TOTAL
 from karpenter_tpu.metrics.registry import HISTOGRAMS
 from karpenter_tpu.obs import slo
@@ -454,7 +455,8 @@ class ProvisionerWorker:
                     pods=s.pods,
                     instance_types=self.cloud_provider.get_instance_types(
                         s.constraints),
-                    daemons=self._get_daemons(s.constraints))
+                    daemons=self._get_daemons(s.constraints),
+                    soft_affinity=s.soft_affinity)
                 for s in schedules
             ]
         prep = _ChunkPrep(schedules=schedules, problems=problems, pods=pods)
@@ -679,7 +681,7 @@ class ProvisionerWorker:
                 result = global_results[idx]
             last_result = result
             for packing in result.packings:
-                err = self._launch(schedule.constraints, packing)
+                err = self._launch(self._steer(schedule, packing), packing)
                 if err is not None:
                     log.error("could not launch node: %s", err)
         if prep.gang_enc is not None:
@@ -726,7 +728,6 @@ class ProvisionerWorker:
                                     pre_of.pop(placement.gang.index, []))
             if err is None:
                 GANGS_PLACED_TOTAL.inc()
-                self._commit_carves(prep, placement)
             else:
                 GANGS_UNPLACEABLE_TOTAL.inc(reason="bind-failed")
                 log.error("gang %s bind failed (unwound): %s window_id=%s "
@@ -867,8 +868,40 @@ class ProvisionerWorker:
                  cand.displacement_cost, self._window_id, self.shard or "0")
         return piid
 
-    def _commit_carves(self, prep: _ChunkPrep,
-                       placement: GangPlacement) -> None:
+    def _carve_payload(self, prep: _ChunkPrep,
+                       placement: GangPlacement) -> List[dict]:
+        """JSON-ready carve records for a placement, one per carved bin —
+        the exact data a ``carve`` intent carries. Built BEFORE the
+        gang-bind record advances to ``bound`` so the payload rides that
+        append: the bind close and the carve commits are then covered by
+        one durable record, and a crash between them no longer loses the
+        carve (RecoveryController._resolve_gang_bind re-commits from it)."""
+        if not getattr(placement, "carves", None):
+            return []
+        enc = prep.gang_enc
+        schedule = placement.gang.context
+        sig = topo_ops.constraints_sig(schedule.constraints.labels,
+                                       schedule.constraints.taints)
+        members = {bi: [(p.metadata.namespace, p.metadata.name)
+                        for p in pods]
+                   for bi, pods in placement.node_sets}
+        payload: List[dict] = []
+        for bi, cells in placement.carves.items():
+            node = prep.gang_nodes.get(bi)
+            bn = enc.bins[bi]
+            if node is None or bn.grid is None:
+                continue
+            _s, itype = prep.gang_types[bn.type_index]
+            payload.append(dict(
+                gang=str(placement.gang.key), node=node,
+                grid=[int(d) for d in bn.grid], type=itype.name,
+                sig=sig, cells=[int(c) for c in cells],
+                band=placement.gang.band,
+                pods=[f"{ns}/{nm}" for ns, nm in members.get(bi, [])]))
+        return payload
+
+    def _commit_carves(self, prep: _ChunkPrep, placement: GangPlacement,
+                       carves: Optional[List[dict]] = None) -> None:
         """Record a bound slice gang's carve cells in the occupancy
         ledger so later windows seed its nodes' residual grids back into
         the pool (and can price this gang as a preemption victim).
@@ -877,35 +910,30 @@ class ProvisionerWorker:
         intent BEFORE the in-memory ledger mutates: the open intent IS
         the durable form of the carve, so a restart rebuilds this exact
         record (RecoveryController._resolve_carve) instead of seeing the
-        fragmented node as empty and double-carving it."""
-        if not placement.carves:
-            return
-        enc = prep.gang_enc
+        fragmented node as empty and double-carving it. ``carves`` is
+        the pre-built payload when the caller already journaled it onto
+        the gang-bind ``bound`` append (so a crash BEFORE these opens is
+        equally covered); None builds it here."""
         journal = self.journal
-        schedule = placement.gang.context
-        sig = topo_ops.constraints_sig(schedule.constraints.labels,
-                                       schedule.constraints.taints)
-        members = {bi: [(p.metadata.namespace, p.metadata.name)
-                        for p in pods]
-                   for bi, pods in placement.node_sets}
-        for bi, cells in placement.carves.items():
-            node = prep.gang_nodes.get(bi)
-            bn = enc.bins[bi]
-            if node is None or bn.grid is None:
-                continue
-            _s, itype = prep.gang_types[bn.type_index]
+        if carves is None:
+            carves = self._carve_payload(prep, placement)
+        live: Dict[Tuple[str, str], str] = {}
+        if journal is not None and carves:
+            # idempotent at the journal layer too: a re-drive (or the
+            # gang-bind path having already committed) reuses the live
+            # carve intent instead of leaking a duplicate open one
+            live = {(str(c.data.get("gang") or ""),
+                     str(c.data.get("node") or "")): c.id
+                    for c in journal.open_of_kind("carve")}
+        for rec in carves:
             cid = ""
             if journal is not None:
-                cid = journal.open_intent(
-                    "carve", gang=str(placement.gang.key), node=node,
-                    grid=[int(d) for d in bn.grid], type=itype.name,
-                    sig=sig, cells=[int(c) for c in cells],
-                    band=placement.gang.band,
-                    pods=[f"{ns}/{nm}"
-                          for ns, nm in members.get(bi, [])])
+                cid = (live.get((rec["gang"], rec["node"]))
+                       or journal.open_intent("carve", **rec))
             topo_ops.LEDGER.commit(
-                node, bn.grid, itype.name, sig, placement.gang.key,
-                cells, placement.gang.band, members.get(bi, []),
+                rec["node"], tuple(rec["grid"]), rec["type"], rec["sig"],
+                placement.gang.key, rec["cells"], rec["band"],
+                [tuple(str(p).partition("/")[::2]) for p in rec["pods"]],
                 intent_id=cid)
             TOPOLOGY_CARVES_COMMITTED_TOTAL.inc()
 
@@ -1002,8 +1030,15 @@ class ProvisionerWorker:
                     for piid in preempt_iids:
                         journal.close(piid, outcome="beneficiary-unwound")
                 return f"binding to {name}: " + "; ".join(errs)
+        # the carve payload rides the ``bound`` append: one durable record
+        # covers both the bind close and the carve commits, so a crash
+        # between them re-commits the carves from the gang-bind intent
+        # instead of losing them (the PR 19 one-append durability gap)
+        carves = self._carve_payload(prep, placement)
         if iid is not None:
-            journal.advance(iid, "bound")
+            journal.advance(iid, "bound", carves=carves)
+        self._commit_carves(prep, placement, carves)
+        if iid is not None:
             for piid in preempt_iids:
                 journal.advance(piid, "beneficiary-bound")
                 journal.close(piid)
@@ -1142,6 +1177,32 @@ class ProvisionerWorker:
             if constraints.validate_pod(pod) is None:
                 daemons.append(pod)
         return daemons
+
+    def _steer(self, schedule, packing) -> Constraints:
+        """Soft-affinity zone steering: the scoring kernel priced this
+        schedule's row at its best-case zone (ops/policy.py soft term); the
+        fleet launch would otherwise pick lowest-price among ALL allowed
+        zones and could scatter the cohort. steer_zone re-derives the
+        winning zone on host in the same exact int micro-$ fixed point and
+        the launch narrows to it — a copy, never the cached schedule
+        constraints. No votes / kill switch off / already pinned → the
+        original constraints object, bit-for-bit the pre-soft launch."""
+        soft = getattr(schedule, "soft_affinity", None)
+        if not soft:
+            return schedule.constraints
+        from karpenter_tpu.ops import policy as ops_policy
+
+        cfg = self.solver_config
+        zone = ops_policy.steer_zone(
+            packing.instance_type_options, schedule.constraints.requirements,
+            cfg.cost_config, cfg.policy_context, soft)
+        if zone is None:
+            return schedule.constraints
+        steered = schedule.constraints.deepcopy()
+        steered.requirements.items.append(Req(
+            key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In", values=[zone]))
+        SOFT_AFFINITY_STEERED_TOTAL.inc()
+        return steered
 
     def _launch(self, constraints: Constraints, packing) -> Optional[str]:
         """Limits check + CloudProvider.Create with bind callback

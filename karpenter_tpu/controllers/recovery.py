@@ -277,6 +277,12 @@ class RecoveryController:
 
     def _resolve_gang_bind(self, intent: Intent, records) -> str:
         if intent.phase == "bound":
+            # the bind landed; the crash may have beaten the carve-intent
+            # opens that follow it. The ``bound`` append carries the full
+            # carve payload, so re-commit any carve that has no open
+            # carve intent of its own yet (dedupe by (gang, node) — a
+            # crash AFTER the opens must not double-journal the carve)
+            self._recommit_carves(intent)
             self.journal.close(intent.id, outcome="bound")
             return "forward"
         if intent.phase == "unwound":
@@ -333,6 +339,48 @@ class RecoveryController:
                 did += 1
         self.journal.close(intent.id, outcome="unwound")
         return "rollback" if did else "noop"
+
+    def _recommit_carves(self, intent: Intent) -> None:
+        """Re-open the carve intents a crashed gang bind journaled only
+        inside its ``bound`` record. Idempotent: carves whose own intent
+        already exists (the crash hit after the opens) are skipped, and
+        ledger commits overwrite, so replaying twice yields the same
+        state. Carves on nodes that did not survive are dropped — their
+        cells are not capacity anymore."""
+        carves = intent.data.get("carves") or []
+        if not carves:
+            return
+        live = {(str(c.data.get("gang") or ""), str(c.data.get("node") or ""))
+                for c in self.journal.open_of_kind("carve")}
+        for rec in carves:
+            if not isinstance(rec, dict):
+                continue
+            gang = str(rec.get("gang") or "")
+            node = str(rec.get("node") or "")
+            if not node or (gang, node) in live:
+                continue
+            try:
+                self.kube.get("Node", node, "")
+            except NotFound:
+                continue
+            dims = tuple(int(d) for d in rec.get("grid") or [])
+            cells = [int(c) for c in rec.get("cells") or []]
+            if not dims or not cells:
+                continue
+            sig = topo_ops.sig_from_json(rec.get("sig") or ((), ()))
+            pods = []
+            for ref in rec.get("pods") or []:
+                ns, _, pname = str(ref).partition("/")
+                pods.append((ns, pname))
+            cid = self.journal.open_intent(
+                "carve", gang=gang, node=node, grid=list(dims),
+                type=str(rec.get("type") or ""), sig=sig, cells=cells,
+                band=str(rec.get("band") or "default"),
+                pods=[f"{ns}/{nm}" for ns, nm in pods])
+            topo_ops.LEDGER.commit(
+                node, dims, str(rec.get("type") or ""), sig, gang, cells,
+                str(rec.get("band") or "default"), pods, intent_id=cid)
+            LEDGER_RECOVERED_CARVES_TOTAL.inc()
 
     def _resolve_carve(self, intent: Intent, records) -> str:
         """Rebuild one occupancy-ledger entry from its durable carve

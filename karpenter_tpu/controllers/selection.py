@@ -62,17 +62,20 @@ def validate(p: Pod) -> Optional[str]:
     """Supported-feature validation (controller.go:123-174)."""
     errs: List[str] = []
     if p.spec.affinity is not None:
-        # required hostname-keyed pod-(anti-)affinity is compiled into the
-        # columnar filter (scheduling/affinity.py); anything else still sheds
+        # required pod-(anti-)affinity is compiled into the columnar filter
+        # for ANY topology key (scheduling/affinity.py: hostname gets fresh
+        # domains, valued keys draw from the provisioner's vocabulary; a
+        # key the provisioner doesn't carry sheds at injection, not here).
+        # Preferred terms are soft votes and always pass validation.
         for side, what in ((p.spec.affinity.pod_affinity, "pod affinity"),
                            (p.spec.affinity.pod_anti_affinity,
                             "pod anti-affinity")):
             if side is None:
                 continue
             for term in side.required:
-                if term.topology_key != wellknown.LABEL_HOSTNAME:
-                    errs.append(f"{what} topology key "
-                                f"{term.topology_key!r} is not supported")
+                if not term.topology_key:
+                    errs.append(f"{what} term without a topology key "
+                                "is not supported")
         na = p.spec.affinity.node_affinity
         if na is not None:
             terms = list(na.required or [])

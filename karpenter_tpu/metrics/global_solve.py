@@ -18,6 +18,9 @@ registry.expose()):
   (accepted through the same exact cheaper/verify gates)
 - ``karpenter_global_iterations``      gauge — projected-gradient
   iterations configured for the last dispatched window
+- ``karpenter_global_support_threshold`` gauge — the adaptive absolute
+  support threshold currently in force (EWMA acceptance-rate driven,
+  between the widened 0.05 floor and the strict 0.4 ceiling)
 - ``karpenter_global_solve_seconds``   histogram — dispatch+fetch wall
   seconds of the batched global solve (rounding + verification included)
 """
@@ -43,6 +46,11 @@ GLOBAL_FALLBACK_TOTAL = DEFAULT.counter(
 GLOBAL_WIDENED_ACCEPT_TOTAL = DEFAULT.counter(
     "global_widened_accept_total",
     "No-support schedules recovered by the widened-support rounding retry")
+
+GLOBAL_SUPPORT_THRESHOLD = DEFAULT.gauge(
+    "global_support_threshold",
+    "Adaptive absolute support threshold in force (EWMA acceptance-rate "
+    "interpolation between the widened floor and the strict ceiling)")
 
 GLOBAL_ITERATIONS = DEFAULT.gauge(
     "global_iterations",

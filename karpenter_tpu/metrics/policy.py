@@ -31,3 +31,17 @@ POLICY_CELLS_SCORED_TOTAL = DEFAULT.counter(
 POLICY_SPOT_SELECTED_TOTAL = DEFAULT.counter(
     "policy_spot_selected_total",
     "Placements whose winning offering was spot, by policy")
+
+# Preferred (soft) affinity series — karpenter_soft_affinity_* —
+# the weighted score terms fused into the same scoring jit
+# (docs/scheduling.md §8, docs/observability.md)
+SOFT_AFFINITY_TERMS_TOTAL = DEFAULT.counter(
+    "soft_affinity_terms_total",
+    "Preferred pod-(anti-)affinity terms that produced soft votes")
+SOFT_AFFINITY_STEERED_TOTAL = DEFAULT.counter(
+    "soft_affinity_steered_total",
+    "Launches whose zone choice was narrowed by preferred-affinity votes")
+SOFT_AFFINITY_BLOCKED_DRAINS_TOTAL = DEFAULT.counter(
+    "soft_affinity_blocked_drains_total",
+    "Consolidation drains skipped because the soft-affinity loss "
+    "exceeded the price savings")

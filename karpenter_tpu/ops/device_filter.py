@@ -468,12 +468,16 @@ class FusedBatch:
 
     def __init__(self, batch_idx, encs, packables, uni_types, verify,
                  mask_d, last_valid_d, any_d, probe_d, probe_idx,
-                 residency: _PlanesResidency):
+                 residency: _PlanesResidency, soft=None):
         self.batch_idx = list(batch_idx)
         self.encs = list(encs)
         self.packables = packables
         self.uni_types = uni_types
         self.verify = list(verify)         # [(allowed, required)] per member
+        # per-member preferred-affinity vote map ({(key, value): signed
+        # weight} or None) — consumed by the scoring kernel (ops/policy.py)
+        self.soft = list(soft) if soft is not None \
+            else [None] * len(self.batch_idx)
         self.mask_d = mask_d
         self.last_valid_d = last_valid_d
         self.any_d = any_d
@@ -616,6 +620,7 @@ def prepare_fused(problems, marshaled, config, max_shapes: int):
         batch_idx: List[int] = []
         encs = []
         verify = []
+        soft = []
         for i, prob in enumerate(problems):
             vecs, required, sids = marshaled[i]
             if len(required & set(_GPU_CLASSES)) >= 3:
@@ -642,6 +647,7 @@ def prepare_fused(problems, marshaled, config, max_shapes: int):
             batch_idx.append(i)
             encs.append(penc)
             verify.append((allowed, required))
+            soft.append(getattr(prob, "soft_affinity", None))
         if len(batch_idx) < 2:
             return None
 
@@ -670,7 +676,7 @@ def prepare_fused(problems, marshaled, config, max_shapes: int):
             raise
         fused = FusedBatch(
             batch_idx, encs, packables, uni_types, verify, mask_d, lv_d,
-            any_d, probe_out, probe_idx, residency)
+            any_d, probe_out, probe_idx, residency, soft=soft)
         FILTER_DEVICE_SECONDS.observe(time.perf_counter() - t0,
                                       stage="dispatch")
         return fused

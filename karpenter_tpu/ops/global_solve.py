@@ -293,15 +293,70 @@ def host_global_support(win: GlobalWindowEncoding,
     return out
 
 
-def support_positions(n_row: np.ndarray, num_types: int) -> List[int]:
+#: the hand-tuned strict/widened keep-rule corners the adaptive
+#: controller interpolates between (absolute floor, fraction of max)
+STRICT_SUPPORT = (0.4, 0.02)
+WIDE_SUPPORT = (0.05, 0.005)
+
+
+def support_positions(n_row: np.ndarray, num_types: int,
+                      abs_thr: float = STRICT_SUPPORT[0],
+                      frac_thr: float = STRICT_SUPPORT[1]) -> List[int]:
     """relax.py's keep rule over one fetched node-count row: a type
     carries the support when the optimum provisions a meaningful fraction
-    of a node there (0.4 absorbs rounding noise; n is in nodes)."""
+    of a node there (the absolute floor absorbs rounding noise; n is in
+    nodes). Defaults are the hand-tuned strict corner; the adaptive
+    :class:`SupportController` feeds EWMA-interpolated thresholds."""
     n = np.asarray(n_row[:num_types], dtype=np.float64)
     if n.size == 0 or not np.all(np.isfinite(n)):
         return []
     return [t for t in range(num_types)
-            if n[t] >= max(0.4, 0.02 * float(n.max()))]
+            if n[t] >= max(abs_thr, frac_thr * float(n.max()))]
+
+
+class SupportController:
+    """Acceptance-rate-driven support threshold, replacing the fixed
+    ``max(0.4, 0.02 x max n)`` keep rule with an EWMA interpolation
+    between the strict and widened corners.
+
+    The strict rule is right when the relaxation's optima are crisp (most
+    attempts round to an accepted plan) and too aggressive for fleets of
+    small schedules whose node counts all optimize fractional — there it
+    declines with no-support, pays the widened retry every window, and
+    the hand-tuned corner never learns. The controller tracks the
+    STRICT-pass acceptance rate as an EWMA (seeded at 1.0 — trust the
+    tuned rule until evidence): as acceptance falls, thresholds slide
+    toward the widened corner, so the first rounding attempt starts
+    where the retry would have ended up; as acceptance recovers, they
+    tighten back. The widened retry itself stays untouched BELOW the
+    adaptive pass as the unconditional floor, so the accept set is never
+    smaller than the two-pass scheme's — every accept still clears the
+    exact infeasible/costlier/unverified gates, which is what makes a
+    widened accept as sound as a strict one.
+    """
+
+    def __init__(self, alpha: float = 0.2) -> None:
+        self.alpha = float(alpha)
+        self.rate = 1.0
+
+    def thresholds(self) -> tuple:
+        """(abs, frac) in force: linear in the EWMA acceptance rate —
+        rate 1.0 is the strict corner, rate 0.0 the widened one."""
+        f = 1.0 - min(max(self.rate, 0.0), 1.0)
+        a = STRICT_SUPPORT[0] + f * (WIDE_SUPPORT[0] - STRICT_SUPPORT[0])
+        r = STRICT_SUPPORT[1] + f * (WIDE_SUPPORT[1] - STRICT_SUPPORT[1])
+        return a, r
+
+    def note(self, accepted: bool) -> None:
+        self.rate += self.alpha * ((1.0 if accepted else 0.0) - self.rate)
+
+    def reset(self) -> None:
+        self.rate = 1.0
+
+
+#: process-wide controller (same lifetime as the solve caches); the
+#: gauge karpenter_global_support_threshold mirrors thresholds()[0]
+SUPPORT = SupportController()
 
 
 def widened_support_positions(n_row: np.ndarray,

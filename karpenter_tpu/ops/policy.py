@@ -19,6 +19,17 @@ Table algebra (host-built, cached per (planes, policy, cost config, ctx)):
 - ``rate_tz (TB, Z) float32``: spot interruption rate per (type, zone),
   +inf where the type has no spot offering in the zone. Only built for the
   interruption-priced policy.
+- ``soft_bz (B, Z) int32`` (per WINDOW, not per planes): the schedule's
+  preferred-affinity votes as fixed-point micro-$ adjustments,
+  ``clamp(-weight x round(soft_cost x 1e6))`` per voted zone, 0 elsewhere
+  (scheduling/affinity.py builds the votes from the probe-verified pair
+  planes). A cell's adjustment is the best case over its viable zones
+  (min), applied with an offset-uint32 exact add — operands are clamped
+  to ±(2^30-1) so the sum can never wrap — floored at 0 and saturated at
+  INT32_MAX. A zero vote row (or weight scale 0, or the
+  KARPENTER_SOFT_AFFINITY kill switch) skips the term entirely: the jit
+  is compiled without it, so the default path stays bit-for-bit the
+  pre-soft-affinity program (docs/scheduling.md §8).
 
 Device kernel per window: the offering viability product
 ``zc & ct_allowed`` (the same algebra as device_filter._mask_expr), plus —
@@ -53,10 +64,15 @@ from karpenter_tpu.metrics.policy import (
 
 _ENV = "KARPENTER_POLICY_DEVICE"
 _INT32_MAX = np.int32(np.iinfo(np.int32).max)
+# soft adjustments clamp to ±(2^30 - 1) so (adj + 2^30) fits int32 and the
+# offset-uint32 add below can never wrap
+_SOFT_CLAMP = (1 << 30) - 1
+_SOFT_OFF = np.uint32(1 << 30)
 
 _LOCK = threading.Lock()
 _TABLE_CACHE: dict = {}
 _TABLE_CACHE_CAP = 16
+_TCZ_CACHE: dict = {}
 
 
 def enabled() -> bool:
@@ -150,8 +166,55 @@ def tables_for(planes, uni_types, policy, cost_config, ctx) -> Optional[_Tables]
     return t
 
 
+def _offer_tcz(planes) -> np.ndarray:
+    """(TB, C, Z) bool unpack of the offer plane's zone words, cached per
+    planes identity — the soft-affinity term's per-zone viability view."""
+    with _LOCK:
+        hit = _TCZ_CACHE.get(planes.key)
+    if hit is not None:
+        return hit
+    Z = max(1, len(planes.zone_vocab))
+    z = np.arange(Z)
+    tcz = ((planes.offer_plane[:, :, z // 32] >> (z % 32).astype(np.uint32))
+           & np.uint32(1)).astype(bool)
+    tcz.flags.writeable = False
+    with _LOCK:
+        if len(_TCZ_CACHE) >= _TABLE_CACHE_CAP:
+            _TCZ_CACHE.pop(next(iter(_TCZ_CACHE)))
+        _TCZ_CACHE[planes.key] = tcz
+    return tcz
+
+
+def _soft_rows(planes, soft_list, ctx) -> Optional[np.ndarray]:
+    """(B, Z) int32 fixed-point soft-affinity rows, or None when no member
+    carries a usable zone vote (the jit then compiles without the term).
+    Votes for zones outside the planes vocabulary can never launch here
+    and are dropped."""
+    from karpenter_tpu.scheduling.affinity import soft_enabled
+    from karpenter_tpu.solver.policy import soft_zone_votes
+
+    if soft_list is None or not soft_enabled():
+        return None
+    scale = int(round(ctx.soft_affinity_cost_per_weight * 1e6))
+    if scale <= 0:
+        return None
+    Z = max(1, len(planes.zone_vocab))
+    rows = np.zeros((len(soft_list), Z), np.int32)
+    any_vote = False
+    for b, soft in enumerate(soft_list):
+        for zone, w in soft_zone_votes(soft).items():
+            z = planes.zone_vocab.get(zone)
+            if z is None:
+                continue
+            rows[b, z] = np.int32(
+                max(-_SOFT_CLAMP, min(-w * scale, _SOFT_CLAMP)))
+            any_vote = any_vote or rows[b, z] != 0
+    return rows if any_vote else None
+
+
 def _cells_expr(xp, offer_p, price_ct, zone_words, ct_allowed,
-                rate_tz, zone_allowed, repack, spot_idx, use_pen):
+                rate_tz, zone_allowed, repack, spot_idx, use_pen,
+                soft_bz=None, offer_tcz=None, use_soft=False):
     """The shared (B, TB, C) cell algebra — numpy and jax.numpy run the
     same expression, so the host mirror IS the device program on xp=np."""
     zc = ((offer_p[None, :, :, :] & zone_words[:, None, None, :]) != 0).any(-1)
@@ -178,20 +241,40 @@ def _cells_expr(xp, offer_p, price_ct, zone_words, ct_allowed,
             cells[:, :, spot_idx] = spot
         else:
             cells = cells.at[:, :, spot_idx].set(spot)
+    if use_soft:
+        # preferred-affinity term: per (schedule, type, ct) the BEST case
+        # over viable zones (min of the signed fixed-point votes — the
+        # launch steering realizes the winning zone). Exact int add via a
+        # +2^30 offset in uint32: adj ∈ [-(2^30-1), 2^30-1] and cells ∈
+        # [0, 2^31-1], so the sum < 2^32 never wraps; the result floors at
+        # 0 and saturates at INT32_MAX. Infeasible/saturated cells keep
+        # INT32_MAX — a bonus can never revive a cell feasibility rejected.
+        zmask = offer_tcz[None, :, :, :] & zone_allowed[:, None, None, :]
+        adj = xp.min(xp.where(zmask, soft_bz[:, None, None, :], _INT32_MAX),
+                     axis=-1)                                  # (B, TB, C)
+        adj = xp.where(adj == _INT32_MAX, xp.int32(0), adj)
+        cell_u = cells.astype(xp.uint32) \
+            + (adj + xp.int32(1 << 30)).astype(xp.uint32)
+        soft_cells = xp.minimum(
+            xp.maximum(cell_u, _SOFT_OFF) - _SOFT_OFF,
+            xp.uint32(_INT32_MAX)).astype(xp.int32)
+        cells = xp.where(cells != _INT32_MAX, soft_cells, cells)
     best = xp.min(cells, axis=-1).astype(xp.int32)                # (B, TB)
     return best, viable
 
 
 @functools.lru_cache(maxsize=8)
-def _score_jit(spot_idx: int, use_pen: bool):
+def _score_jit(spot_idx: int, use_pen: bool, use_soft: bool = False):
     import jax
     import jax.numpy as jnp
 
     def body(offer_p, price_ct, zone_words, ct_allowed, rate_tz,
-             zone_allowed, repack):
+             zone_allowed, repack, soft_bz, offer_tcz):
         best, viable = _cells_expr(jnp, offer_p, price_ct, zone_words,
                                    ct_allowed, rate_tz, zone_allowed,
-                                   repack, spot_idx, use_pen)
+                                   repack, spot_idx, use_pen,
+                                   soft_bz=soft_bz, offer_tcz=offer_tcz,
+                                   use_soft=use_soft)
         return best, jnp.sum(viable)
 
     return jax.jit(body)
@@ -219,22 +302,27 @@ def _rows_host(planes, verify) -> tuple:
 
 
 def _host_best(t: _Tables, planes, zone_words, ct_allowed, zone_allowed,
-               cols: Optional[np.ndarray] = None) -> np.ndarray:
+               cols: Optional[np.ndarray] = None,
+               soft_bz: Optional[np.ndarray] = None) -> np.ndarray:
     """Numpy mirror of the device program (optionally restricted to the
     probe type columns) — the scalar-oracle leg of the filter contract."""
     offer_p = planes.offer_plane
     price_ct = t.price_ct
     rate_tz = t.rate_tz
+    offer_tcz = _offer_tcz(planes) if soft_bz is not None else None
     if cols is not None:
         offer_p = offer_p[cols]
         price_ct = price_ct[cols]
         rate_tz = rate_tz[cols] if rate_tz is not None else None
+        offer_tcz = offer_tcz[cols] if offer_tcz is not None else None
     if rate_tz is None:
         rate_tz = np.zeros((price_ct.shape[0], zone_allowed.shape[1]),
                            np.float32)
     best, _ = _cells_expr(np, offer_p, price_ct, zone_words, ct_allowed,
                           rate_tz.copy(), zone_allowed, t.repack_micro,
-                          t.spot_idx, t.use_pen)
+                          t.spot_idx, t.use_pen,
+                          soft_bz=soft_bz, offer_tcz=offer_tcz,
+                          use_soft=soft_bz is not None)
     return best
 
 
@@ -259,10 +347,20 @@ def score_fused_window(fused, policy, cost_config, ctx) -> Optional[List[np.ndar
     zone_words, ct_allowed, zone_allowed = _rows_host(planes, fused.verify)
     rate_tz = tables.rate_tz if tables.rate_tz is not None else \
         np.zeros((planes.TB, zone_allowed.shape[1]), np.float32)
+    soft_bz = _soft_rows(planes, getattr(fused, "soft", None), ctx)
+    use_soft = soft_bz is not None
+    if use_soft:
+        offer_tcz = _offer_tcz(planes)
+    else:
+        # the no-preference window compiles WITHOUT the soft term (the
+        # extra operands are dead inputs) — bit-for-bit the pre-soft path
+        soft_bz = np.zeros((1, 1), np.int32)
+        offer_tcz = np.zeros((1, 1, 1), bool)
     try:
-        best_d, ncells = _score_jit(tables.spot_idx, tables.use_pen)(
+        best_d, ncells = _score_jit(tables.spot_idx, tables.use_pen,
+                                    use_soft)(
             planes.offer_plane, tables.price_ct, zone_words, ct_allowed,
-            rate_tz, zone_allowed, tables.repack_micro)
+            rate_tz, zone_allowed, tables.repack_micro, soft_bz, offer_tcz)
         best = np.asarray(best_d)
         POLICY_CELLS_SCORED_TOTAL.inc(amount=float(np.asarray(ncells)))
     except Exception:
@@ -276,14 +374,19 @@ def score_fused_window(fused, policy, cost_config, ctx) -> Optional[List[np.ndar
     t1 = time.perf_counter()
     cols = np.unique(fused.probe_idx[fused.probe_idx < planes.n])
     ref = _host_best(tables, planes, zone_words, ct_allowed, zone_allowed,
-                     cols=cols)                                # (B, K)
+                     cols=cols,
+                     soft_bz=soft_bz if use_soft else None)    # (B, K)
     got = best[:, cols]
     for b in range(len(fused.verify)):
         if not np.array_equal(got[b], ref[b]):
-            POLICY_FALLBACK_TOTAL.inc(reason="score-mismatch")
+            soft_member = use_soft and bool(soft_bz[b].any())
+            POLICY_FALLBACK_TOTAL.inc(
+                reason="soft-affinity-mismatch" if soft_member
+                else "score-mismatch")
             best[b] = _host_best(
                 tables, planes, zone_words[b:b + 1], ct_allowed[b:b + 1],
-                zone_allowed[b:b + 1])[0]
+                zone_allowed[b:b + 1],
+                soft_bz=soft_bz[b:b + 1] if use_soft else None)[0]
     POLICY_SCORE_SECONDS.observe(time.perf_counter() - t1, stage="verify")
 
     # gather the planes axis to each member's packable order and pad to TB
@@ -299,7 +402,66 @@ def score_fused_window(fused, policy, cost_config, ctx) -> Optional[List[np.ndar
     return out
 
 
+def steer_zone(instance_types, requirements, cost_config, ctx,
+               soft) -> Optional[str]:
+    """Launch-time zone steering, the scalar half of the soft contract: the
+    scoring kernel priced the best-case zone into the row; this picks that
+    zone so the fleet launch actually lands there. Exact int micro-$ over
+    every allowed offering of the packed node's type options:
+    ``base_micro(offering) + clamp(-weight x scale)`` (the same fixed point
+    as the device term), argmin with (higher vote, zone name) as the
+    deterministic tiebreak — the saturation floor at 0 can erase the vote
+    discount on cheap offerings (price 0 ties every zone at 0), and a tie
+    must still land on the preferred zone, not the alphabetical one.
+    Returns None — launch unchanged — when there are no usable
+    votes, the kill switch is off, the zone is already pinned, or no
+    offering is viable; a Some answer always keeps >=1 offering viable by
+    construction (the winning offering is in that zone)."""
+    from karpenter_tpu.scheduling.affinity import soft_enabled
+    from karpenter_tpu.solver.policy import soft_zone_votes
+
+    votes = soft_zone_votes(soft)
+    if not votes or not soft_enabled():
+        return None
+    scale = int(round(ctx.soft_affinity_cost_per_weight * 1e6))
+    if scale <= 0:
+        return None
+    zones = requirements.zones()
+    if zones is not None and len(zones) <= 1:
+        return None  # already pinned — nothing to steer
+    cts = requirements.capacity_types()
+    factor = cost_config.spot_price_factor
+    best: Optional[tuple] = None
+    for it in instance_types:
+        for o in it.offerings:
+            if zones is not None and o.zone not in zones:
+                continue
+            if cts is not None and o.capacity_type not in cts:
+                continue
+            base = it.price * factor \
+                if o.capacity_type == wellknown.CAPACITY_TYPE_SPOT \
+                else it.price
+            adj = max(-_SOFT_CLAMP,
+                      min(-votes.get(o.zone, 0) * scale, _SOFT_CLAMP))
+            total = max(0, min(int(_encode_micro(base)) + adj,
+                               int(_INT32_MAX)))
+            cand = (total, -votes.get(o.zone, 0), o.zone)
+            if best is None or cand < best:
+                best = cand
+    if best is None:
+        return None
+    # no vote touches a viable zone → every total is the plain price:
+    # don't narrow (the unsteered lowest-price launch is already optimal)
+    if all(votes.get(z, 0) == 0 for z in
+           {o.zone for it in instance_types for o in it.offerings
+            if (zones is None or o.zone in zones)
+            and (cts is None or o.capacity_type in cts)}):
+        return None
+    return best[2]
+
+
 def clear_caches() -> None:
     """Tests only."""
     with _LOCK:
         _TABLE_CACHE.clear()
+        _TCZ_CACHE.clear()

@@ -311,3 +311,64 @@ def verify_and_commit(
     for j in range(enc.b):
         free_state[j][:] = trial[j]
     return placed_bins
+
+
+def soft_affinity_loss(node, movable: Sequence[Pod], fleet: Sequence,
+                       pods_by_node: Dict[str, List[Pod]],
+                       cost_per_weight: float) -> float:
+    """$/h a drain of ``node`` would forfeit in currently-satisfied
+    preferred pod-affinity: for each movable pod, each preferred affinity
+    term whose selector matches a same-namespace peer in the node's
+    topology domain (same node for hostname, same node label value
+    otherwise) counts its weight once. The scheduler paid ``weight x
+    soft_affinity_cost_per_weight`` to co-locate that set (solver/policy
+    soft_zone_adjust / ops/policy soft rows); the drain's savings must
+    beat that price or consolidation is just undoing placement work.
+
+    Preferred ANTI-affinity pays nothing: a drain reschedules the pod and
+    the scheduler can re-satisfy anti terms elsewhere, whereas a scattered
+    co-located set stays scattered until its peers churn. Scalar oracle —
+    evaluated with api.core.LabelSelector.matches, the same authority the
+    pair bit-planes are probe-verified against. Gated by the
+    KARPENTER_SOFT_AFFINITY kill switch (scheduling.affinity.soft_enabled);
+    off or zero-cost ⇒ 0.0, bit-for-bit the pre-soft savings."""
+    if cost_per_weight <= 0.0 or not movable:
+        return 0.0
+    from karpenter_tpu.scheduling.affinity import (
+        _preferred_terms, soft_enabled)
+    if not soft_enabled():
+        return 0.0
+
+    def domain(n, key: str):
+        if key == "kubernetes.io/hostname":
+            return n.metadata.name
+        return n.metadata.labels.get(key)
+
+    weight = 0
+    for pod in movable:
+        terms = _preferred_terms(pod, False)
+        if not terms:
+            continue
+        for w, term in terms:
+            if not term.topology_key or term.label_selector is None:
+                continue
+            dom = domain(node, term.topology_key)
+            if dom is None:
+                continue
+            satisfied = False
+            for other in fleet:
+                if domain(other, term.topology_key) != dom:
+                    continue
+                for peer in pods_by_node.get(other.metadata.name, ()):
+                    if peer is pod:
+                        continue
+                    if peer.metadata.namespace != pod.metadata.namespace:
+                        continue
+                    if term.label_selector.matches(peer.metadata.labels):
+                        satisfied = True
+                        break
+                if satisfied:
+                    break
+            if satisfied:
+                weight += abs(int(w))
+    return weight * cost_per_weight

@@ -1,13 +1,15 @@
-"""Pod-pod affinity/anti-affinity as just-in-time hostname selectors.
+"""Pod-pod affinity/anti-affinity as just-in-time node selectors.
 
 The topology-spread trick (scheduling/topology.py, scheduler.go:69-72)
 carries over: affinity decisions are injected into pods as node selectors
 *before* constraint grouping, so the solver stays oblivious to them.
-Supported surface: **required** podAffinity / podAntiAffinity terms whose
-``topology_key`` is the hostname label, with selector operators In / NotIn /
-Exists / DoesNotExist — exactly what the columnar match engine
-(ops/feasibility.affinity_match_matrix) compiles; SelectionController's
-``validate`` rejects everything else up front.
+Supported surface: **required** podAffinity / podAntiAffinity terms on any
+topology key, with selector operators In / NotIn / Exists / DoesNotExist —
+exactly what the columnar match engine
+(ops/feasibility.affinity_match_matrix) compiles — plus **preferred**
+terms, which never constrain feasibility: they become weighted soft votes
+(see below) priced into the window-scoring kernel (ops/policy.py) and the
+consolidation what-if (ops/whatif.py).
 
 Because this provisioner only creates NEW nodes (fresh, unique hostnames),
 the peer set of an affinity decision is the provisioning window itself:
@@ -17,21 +19,47 @@ positive affinity can only be satisfied by co-provisioned peers. Within
 the window:
 
 - **Affinity** edges (i's required term matches j's labels, same
-  namespace) are symmetric co-location demands: connected components all
-  share ONE fresh hostname domain, so they group into one schedule and
-  pack together. Exact when the component fits a single node; a component
-  the packer must split across nodes keeps only per-node violations the
-  kube scheduler would also have produced — documented limitation
-  (docs/scheduling.md).
+  namespace, same topology key) are symmetric co-location demands:
+  connected components all share ONE domain, so they group into one
+  schedule and pack together. Exact when the component fits a single
+  node; a component the packer must split across nodes keeps only
+  per-node violations the kube scheduler would also have produced —
+  documented limitation (docs/scheduling.md).
 - **Anti-affinity** conflicts (either pod's required anti term matches the
-  other's labels, same namespace, distinct pods) force distinct hostnames:
-  every component touching a conflict gets its OWN fresh domain, which
-  puts the two sides into different schedules — and different schedules
-  launch disjoint node sets, so separation is exact.
+  other's labels, same namespace, same key) force distinct domains,
+  which puts the two sides into different schedules — and different
+  schedules launch disjoint node sets, so hostname separation is exact
+  and topology-valued separation is exact per assigned value.
 - A conflict INSIDE one co-location component is unsatisfiable: its pods
-  are marked ``_affinity_unsat``, stamped with the empty domain (failing
-  validation exactly like topology's no-domain case), and shed through
-  the band-aware requeue path.
+  are marked ``_affinity_unsat``, stamped with the empty hostname domain
+  (failing validation exactly like topology's no-domain case), and shed
+  through the band-aware requeue path.
+
+**Domains per topology key.** For the hostname key a domain is a fresh
+``secrets.token_hex(4)`` value appended to the window constraints
+(pre-PR behavior, bit-for-bit). For topology-*valued* keys (zone,
+``karpenter.sh/node-group``, any key the provisioner's requirements
+carry an In-vocabulary for) domains are interned topology VALUES: each
+component is assigned a concrete value from
+``constraints.requirements.requirement(key)`` intersected with every
+member's own pinned requirement for that key; anti-conflicting
+components greedily take distinct values in deterministic (min member
+index, sorted value) order. Vocabulary exhaustion or an empty
+intersection is unsatisfiable — mark-and-shed, never misplace. The
+columnar filter already interns these vocabularies, so the injected
+selector compiles into the feasibility mask exactly like a hostname
+term.
+
+**Preferred (soft) terms.** After required injection, each pod's
+preferred terms vote ``±weight`` for every (key, value) its matching
+window peers are pinned to — peers vote with their *determined*
+topology value, so preferences follow the hard placement, never fight
+it. The votes land on ``pod.__dict__["_soft_affinity"]`` as
+``{(key, value): signed_weight}``; the scheduler folds them into the
+group key and the scoring kernel prices the zone-keyed entries as an
+exact fixed-point bonus/penalty row (docs/scheduling.md §8). Preferences
+never inject selectors and never shed a pod. ``KARPENTER_SOFT_AFFINITY=0``
+disables extraction entirely, restoring the pre-PR pipeline bit-for-bit.
 
 The match matrix itself is columnar with the probe-verified scalar
 self-heal and the ``KARPENTER_POLICY_COLUMNAR=0`` kill switch — a
@@ -42,17 +70,27 @@ separate pods the scalar algebra would co-locate (or vice versa).
 
 from __future__ import annotations
 
+import os
 import secrets
 from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.constraints import Constraints
 from karpenter_tpu.api.core import NodeSelectorRequirement, Pod
+from karpenter_tpu.api.requirements import pod_requirements
 from karpenter_tpu.ops import feasibility
 
+SOFT_AFFINITY_ENV = "KARPENTER_SOFT_AFFINITY"
 
-def _hostname_terms(pod: Pod, anti: bool) -> list:
-    """Required hostname-keyed terms of one side (affinity / anti)."""
+
+def soft_enabled() -> bool:
+    """Preferred-term kill switch: default ON, 0/false/off disables."""
+    return os.environ.get(SOFT_AFFINITY_ENV, "1").strip().lower() not in (
+        "0", "false", "off")
+
+
+def _required_terms(pod: Pod, anti: bool) -> list:
+    """Required terms of one side (affinity / anti), any topology key."""
     aff = pod.spec.affinity
     if aff is None:
         return []
@@ -60,12 +98,28 @@ def _hostname_terms(pod: Pod, anti: bool) -> list:
     if side is None:
         return []
     return [t for t in side.required
-            if t.topology_key == wellknown.LABEL_HOSTNAME
-            and t.label_selector is not None]
+            if t.topology_key and t.label_selector is not None]
+
+
+def _preferred_terms(pod: Pod, anti: bool) -> list:
+    """(weight, term) pairs of one side's preferred list; zero-weight and
+    selector-less terms are inert (kube weight range is 1-100)."""
+    aff = pod.spec.affinity
+    if aff is None:
+        return []
+    side = aff.pod_anti_affinity if anti else aff.pod_affinity
+    if side is None:
+        return []
+    return [(int(w.weight), w.term) for w in side.preferred
+            if w.term.topology_key and w.term.label_selector is not None
+            and int(w.weight) != 0]
 
 
 def has_affinity(pod: Pod) -> bool:
-    return bool(_hostname_terms(pod, False) or _hostname_terms(pod, True))
+    if _required_terms(pod, False) or _required_terms(pod, True):
+        return True
+    return soft_enabled() and bool(
+        _preferred_terms(pod, False) or _preferred_terms(pod, True))
 
 
 class _UnionFind:
@@ -93,6 +147,7 @@ class AffinityGroups:
             return
         for pod in pods:
             pod.__dict__.pop("_affinity_unsat", None)
+            pod.__dict__.pop("_soft_affinity", None)
 
         # dedupe both matrix axes: selectors by signature (scalar-sig rows
         # keep their LabelSelector object for the oracle), peers by
@@ -120,13 +175,28 @@ class AffinityGroups:
                 peer_sigs.append(sig)
             pod_peer.append(i)
 
-        aff_terms: List[List[int]] = []   # pod -> selector rows (affinity)
-        anti_terms: List[List[int]] = []  # pod -> selector rows (anti)
-        for pod in pods:
-            aff_terms.append([sel_of(t.label_selector)
-                              for t in _hostname_terms(pod, False)])
-            anti_terms.append([sel_of(t.label_selector)
-                               for t in _hostname_terms(pod, True)])
+        # required terms bucketed by topology key: key -> per-pod selector
+        # rows for each side. Hostname first, then the valued keys in
+        # sorted order — keys are independent (distinct node_selector
+        # entries) so order only fixes determinism.
+        n = len(pods)
+        aff_by_key: Dict[str, List[List[int]]] = {}
+        anti_by_key: Dict[str, List[List[int]]] = {}
+        for i, pod in enumerate(pods):
+            for anti, table in ((False, aff_by_key), (True, anti_by_key)):
+                for t in _required_terms(pod, anti):
+                    rows = table.setdefault(t.topology_key, [[] for _ in range(n)])
+                    rows[i].append(sel_of(t.label_selector))
+
+        # preferred terms: pod -> [(signed weight, key, selector row)]
+        soft = soft_enabled()
+        pref: List[List[Tuple[int, str, int]]] = [[] for _ in range(n)]
+        if soft:
+            for i, pod in enumerate(pods):
+                for w, t in _preferred_terms(pod, False):
+                    pref[i].append((w, t.topology_key, sel_of(t.label_selector)))
+                for w, t in _preferred_terms(pod, True):
+                    pref[i].append((-w, t.topology_key, sel_of(t.label_selector)))
 
         matrix = feasibility.affinity_match_matrix(selectors, peer_sigs)
 
@@ -134,6 +204,22 @@ class AffinityGroups:
             pj = pod_peer[j]
             return any(matrix[s, pj] for s in rows)
 
+        keys = sorted(set(aff_by_key) | set(anti_by_key),
+                      key=lambda k: (k != wellknown.LABEL_HOSTNAME, k))
+        empty = [[] for _ in range(n)]
+        for key in keys:
+            self._inject_key(
+                constraints, pods, key,
+                aff_by_key.get(key, empty), anti_by_key.get(key, empty),
+                matches)
+
+        if soft and any(pref):
+            self._soft_votes(pods, pref, matches)
+
+    # -- required terms, one topology key ------------------------------------
+    def _inject_key(self, constraints: Constraints, pods: List[Pod],
+                    key: str, aff_terms: List[List[int]],
+                    anti_terms: List[List[int]], matches) -> None:
         n = len(pods)
         ns = [p.metadata.namespace for p in pods]
         uf = _UnionFind(n)
@@ -167,6 +253,7 @@ class AffinityGroups:
         for root, members in comp_pods.items():
             needs_domain[root] = len(members) > 1 and any(
                 aff_terms[i] or anti_terms[i] for i in members)
+        conflict_roots: Dict[int, set] = {}
         for i, j in conflicts:
             ri, rj = uf.find(i), uf.find(j)
             if ri == rj:
@@ -174,27 +261,105 @@ class AffinityGroups:
             else:
                 needs_domain[ri] = True
                 needs_domain[rj] = True
+                conflict_roots.setdefault(ri, set()).add(rj)
+                conflict_roots.setdefault(rj, set()).add(ri)
 
-        domains: List[str] = []
-        for root, members in comp_pods.items():
-            if unsat.get(root):
+        if key == wellknown.LABEL_HOSTNAME:
+            domains: List[str] = []
+            for root, members in comp_pods.items():
+                if unsat.get(root):
+                    self._mark_unsat(pods, members)
+                    continue
+                if not needs_domain.get(root):
+                    continue
+                domain = secrets.token_hex(4)
+                domains.append(domain)
                 for i in members:
-                    pods[i].__dict__["_affinity_unsat"] = True
                     pods[i].spec.node_selector = {
                         **pods[i].spec.node_selector,
-                        wellknown.LABEL_HOSTNAME: "",
+                        wellknown.LABEL_HOSTNAME: domain,
                     }
+            if domains:
+                # admit fresh domains exactly like hostname topology spread
+                constraints.requirements.items.append(NodeSelectorRequirement(
+                    key=wellknown.LABEL_HOSTNAME, operator="In",
+                    values=domains))
+            return
+
+        # topology-valued key: domains are interned values from the window
+        # constraints' vocabulary; no fresh domains, no requirement append
+        vocab = constraints.requirements.requirement(key)
+        chosen: Dict[int, str] = {}
+        roots = sorted(comp_pods, key=lambda r: min(comp_pods[r]))
+        for root in roots:
+            members = comp_pods[root]
+            if unsat.get(root):
+                self._mark_unsat(pods, members)
                 continue
             if not needs_domain.get(root):
                 continue
-            domain = secrets.token_hex(4)
-            domains.append(domain)
+            if vocab is None:
+                # the provisioner doesn't label nodes with this key: no
+                # launched node can ever satisfy the term — shed
+                self._mark_unsat(pods, members)
+                continue
+            allowed = set(vocab)
+            for i in members:
+                own = pod_requirements(pods[i]).requirement(key)
+                if own is not None:
+                    allowed &= own
+            taken = {chosen[r] for r in conflict_roots.get(root, ())
+                     if r in chosen}
+            pick = sorted(v for v in allowed if v not in taken)
+            if not pick:
+                self._mark_unsat(pods, members)  # vocabulary exhausted
+                continue
+            chosen[root] = pick[0]
             for i in members:
                 pods[i].spec.node_selector = {
-                    **pods[i].spec.node_selector,
-                    wellknown.LABEL_HOSTNAME: domain,
-                }
-        if domains:
-            # admit the fresh domains exactly like hostname topology spread
-            constraints.requirements.items.append(NodeSelectorRequirement(
-                key=wellknown.LABEL_HOSTNAME, operator="In", values=domains))
+                    **pods[i].spec.node_selector, key: pick[0]}
+
+    @staticmethod
+    def _mark_unsat(pods: List[Pod], members: List[int]) -> None:
+        for i in members:
+            pods[i].__dict__["_affinity_unsat"] = True
+            pods[i].spec.node_selector = {
+                **pods[i].spec.node_selector,
+                wellknown.LABEL_HOSTNAME: "",
+            }
+
+    # -- preferred terms → soft votes -----------------------------------------
+    @staticmethod
+    def _soft_votes(pods: List[Pod],
+                    pref: List[List[Tuple[int, str, int]]], matches) -> None:
+        """Each preferred term votes its signed weight once per (key, value)
+        any matching same-namespace window peer is pinned to. Peers vote
+        with their DETERMINED value (node_selector after required/topology
+        injection), so soft scoring follows hard placement. Pods already
+        proven unsatisfiable carry no votes and receive none."""
+        from karpenter_tpu.metrics.policy import SOFT_AFFINITY_TERMS_TOTAL
+
+        n = len(pods)
+        ns = [p.metadata.namespace for p in pods]
+        for i in range(n):
+            if not pref[i] or pods[i].__dict__.get("_affinity_unsat"):
+                continue
+            votes: Dict[Tuple[str, str], int] = {}
+            for w, key, row in pref[i]:
+                vals = set()
+                for j in range(n):
+                    if i == j or ns[i] != ns[j]:
+                        continue
+                    if pods[j].__dict__.get("_affinity_unsat"):
+                        continue
+                    if not matches([row], j):
+                        continue
+                    v = pods[j].spec.node_selector.get(key)
+                    if v:
+                        vals.add(v)
+                for v in vals:
+                    votes[(key, v)] = votes.get((key, v), 0) + w
+            votes = {kv: w for kv, w in votes.items() if w}
+            if votes:
+                pods[i].__dict__["_soft_affinity"] = votes
+                SOFT_AFFINITY_TERMS_TOTAL.inc(len(pref[i]))

@@ -40,6 +40,10 @@ class Schedule:
     constraints: Constraints
     pods: List[Pod] = field(default_factory=list)
     gang: Optional[GangSpec] = None
+    # preferred-affinity votes shared by every member ({(key, value):
+    # signed weight}); the soft signature is folded into the group key so
+    # all pods of one schedule carry the SAME votes. None = no preference.
+    soft_affinity: Optional[Dict] = None
 
 
 def _constraints_key(c: Constraints, gpu_requests) -> tuple:
@@ -121,10 +125,16 @@ class Scheduler:
                 # schedule holds exactly its members, so the co-pack
                 # window sees whole gangs and nothing else
                 key = key + (gspec.group_part,)
+            soft = pod.__dict__.get("_soft_affinity")
+            if soft:
+                # fold the soft-vote signature in too: scoring prices a
+                # schedule's preference row once, so members must agree
+                key = key + (tuple(sorted(soft.items())),)
             schedule = schedules.get(key)
             if schedule is None:
                 schedule = schedules[key] = Schedule(
-                    constraints=tightened, pods=[], gang=gspec)
+                    constraints=tightened, pods=[], gang=gspec,
+                    soft_affinity=dict(soft) if soft else None)
                 # warm the allowed-sets memo at window assembly: the solver
                 # (batched and fused device-filter paths alike) reads these
                 # five sets per schedule, and the tighten cache hands back

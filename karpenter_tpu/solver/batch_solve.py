@@ -31,7 +31,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import List, Mapping, Optional, Sequence
 
 import numpy as np
 
@@ -65,6 +65,10 @@ class Problem:
     pods: Sequence[Pod]
     instance_types: Sequence[InstanceType]
     daemons: Sequence[Pod] = ()
+    # preferred-affinity votes shared by the schedule's pods
+    # ({(topology_key, value): signed weight}); the scoring kernel prices
+    # the zone-keyed entries (ops/policy.py), everything else is inert here
+    soft_affinity: Optional[Mapping] = None
 
 
 def solve_batch(problems: Sequence[Problem],
@@ -149,10 +153,15 @@ def _dispatch_batch(problems: Sequence[Problem],
             packables, sorted_types = prepared[i][0], prepared[i][1]
         if not (packables and any(it.price for it in sorted_types)):
             return None
+        from karpenter_tpu.solver.policy import soft_zone_adjust, soft_zone_votes
+
+        votes = soft_zone_votes(getattr(problems[i], "soft_affinity", None))
+        reqs = problems[i].constraints.requirements
         return [
-            policy.score(sorted_types[p.index],
-                         problems[i].constraints.requirements,
+            policy.score(sorted_types[p.index], reqs,
                          config.cost_config, config.policy_context)[0]
+            + soft_zone_adjust(sorted_types[p.index], reqs, votes,
+                               config.policy_context)
             for p in packables
         ]
 

@@ -40,10 +40,11 @@ import numpy as np
 
 from karpenter_tpu.metrics.global_solve import (
     GLOBAL_FALLBACK_TOTAL, GLOBAL_ITERATIONS, GLOBAL_SOLVE_SECONDS,
-    GLOBAL_USED_TOTAL, GLOBAL_WIDENED_ACCEPT_TOTAL, GLOBAL_WINDOWS_TOTAL)
+    GLOBAL_SUPPORT_THRESHOLD, GLOBAL_USED_TOTAL,
+    GLOBAL_WIDENED_ACCEPT_TOTAL, GLOBAL_WINDOWS_TOTAL)
 from karpenter_tpu.obs import trace as obtrace
 from karpenter_tpu.ops.global_solve import (
-    GlobalWindowEncoding, encode_window, host_global_support,
+    SUPPORT, GlobalWindowEncoding, encode_window, host_global_support,
     plan_cost_micro, support_positions, verify_plan,
     widened_support_positions)
 from karpenter_tpu.solver import host_ffd
@@ -215,7 +216,14 @@ def _round_window(win: GlobalWindowEncoding, n_rows: Optional[np.ndarray],
         elif s.row < 0 or n_rows is None:
             info.reason = "fallback-error"
         else:
-            keep = support_positions(n_rows[s.row], s.num_types)
+            # adaptive keep rule: the EWMA acceptance rate slides the
+            # thresholds between the strict and widened corners, so a
+            # fleet of small schedules stops paying the no-support +
+            # widened-retry round trip every window
+            abs_thr, frac_thr = SUPPORT.thresholds()
+            GLOBAL_SUPPORT_THRESHOLD.set(abs_thr)
+            keep = support_positions(n_rows[s.row], s.num_types,
+                                     abs_thr, frac_thr)
             info.support = len(keep)
             ffd = host_ffd.pack(s.pod_vecs, s.pod_ids, s.packables,
                                 max_instance_types=solver_config
@@ -270,6 +278,10 @@ def _round_window(win: GlobalWindowEncoding, n_rows: Optional[np.ndarray],
                 reason, accepted = attempt(keep)
                 info.reason = reason
                 info.used = accepted is not None
+            # the controller learns from the ADAPTIVE pass only: a
+            # widened-retry rescue counts as a strict-pass miss (evidence
+            # to widen), a strict accept as a hit (evidence to tighten)
+            SUPPORT.note(info.used and not info.widened)
         if info.used:
             GLOBAL_USED_TOTAL.inc()
         else:

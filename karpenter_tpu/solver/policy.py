@@ -55,15 +55,23 @@ class PolicyContext:
     spot node of this window's shape: ~0 when its pods refit on existing
     free capacity, else the $/h of the cheapest on-demand replacement.
     ``throughput`` maps instance-type name -> relative throughput for the
-    throughput-per-dollar policy (absent types default to 1.0)."""
+    throughput-per-dollar policy (absent types default to 1.0).
+    ``soft_affinity_cost_per_weight`` converts one unit of preferred
+    pod-(anti-)affinity weight (kube range 1-100) into $/h: a zone a
+    schedule's peers voted +w for scores ``w x cost`` cheaper there,
+    an anti vote the opposite. 0 disables soft pricing entirely — the
+    scoring rows are then bit-for-bit the pre-soft-affinity output
+    (docs/scheduling.md §8)."""
 
     repack_cost_per_hour: float = 0.0
     throughput: Mapping[str, float] = field(default_factory=dict)
+    soft_affinity_cost_per_weight: float = 0.001
 
     def token(self) -> tuple:
         """Hashable identity for device-side table caching (ops/policy.py)."""
         return (round(self.repack_cost_per_hour, 9),
-                tuple(sorted(self.throughput.items())))
+                tuple(sorted(self.throughput.items())),
+                round(self.soft_affinity_cost_per_weight, 9))
 
 
 class ScoringPolicy:
@@ -144,6 +152,37 @@ class ThroughputPerDollar(ScoringPolicy):
         if tput <= 0.0:
             return (float("inf"), None)  # zero-throughput types never win
         return (price / tput, ct)
+
+
+def soft_zone_votes(soft: Optional[Mapping]) -> Dict[str, int]:
+    """Zone-keyed entries of a schedule's soft-affinity vote map
+    ({(topology_key, value): signed weight} → {zone: weight}). The scoring
+    seams price zones only — other keys are consolidation-side."""
+    if not soft:
+        return {}
+    return {v: int(w) for (k, v), w in soft.items()
+            if k == wellknown.LABEL_TOPOLOGY_ZONE and int(w)}
+
+
+def soft_zone_adjust(it: InstanceType, requirements: Requirements,
+                     votes: Mapping[str, int], ctx: PolicyContext) -> float:
+    """$/h soft-affinity adjustment when scoring ``it``: the best case over
+    the type's allowed-zone offerings, ``min over z of -w(z) x cost`` (a
+    positive vote is a discount — the launch zone steering realizes it).
+    0 with no votes, zero cost, or no viable zone. This is the HOST-loop
+    (float) leg; the device kernel applies the same min-over-zones in
+    exact int micro-$ (ops/policy.py)."""
+    if not votes or ctx.soft_affinity_cost_per_weight <= 0.0:
+        return 0.0
+    zones = requirements.zones()
+    best: Optional[float] = None
+    for o in it.offerings:
+        if zones is not None and o.zone not in zones:
+            continue
+        adj = -votes.get(o.zone, 0) * ctx.soft_affinity_cost_per_weight
+        if best is None or adj < best:
+            best = adj
+    return best if best is not None else 0.0
 
 
 _POLICIES: Dict[str, ScoringPolicy] = {}

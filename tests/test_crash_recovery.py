@@ -740,6 +740,57 @@ class TestCarveLedgerCompaction:
         assert j2.open_intents() == {}
         j2.close_journal()
 
+    def test_crash_between_bound_and_carve_open_recovers_carve(
+            self, tmp_path):
+        """The one-append durability gap: a crash AFTER the gang-bind
+        ``bound`` append but BEFORE any carve-intent open used to leave
+        the carve undurable (the bind rolled forward, the node looked
+        empty, later windows double-carved it). The carve payload now
+        rides the bound append, so recovery re-commits the ledger entry
+        and re-opens the long-lived carve intent from it."""
+        topo_ops.LEDGER.reset()
+        cluster = carve_cluster(str(tmp_path))
+        journal = cluster.open_journal()
+        worker = make_worker(cluster, journal)
+        kube = cluster.kube
+        lo = [ensure_pod(kube, n) for n in CARVE_VICTIM]
+        prep = carve_prep(cluster, "carve-lo")
+        placement = carve_placement(cluster, lo, "carve-lo", "low",
+                                    VICTIM_CELLS)
+        inject.install(inject.FaultPlan(1, [
+            inject.FaultSpec("journal", "gang-bind:bound",
+                             "crash-point", 1)], window=1))
+        with pytest.raises(inject.SimulatedCrash):
+            worker._launch_gang(prep, placement)
+        inject.uninstall()
+        # the crash beat every carve-intent open: the bound append is
+        # the ONLY durable trace of the carve
+        assert journal.open_of_kind("carve") == []
+        assert ledger_rec("carve-lo") is None
+        journal.close_journal()
+
+        topo_ops.LEDGER.reset()
+        j2, stats = restart(cluster)
+        assert stats["errors"] == 0
+        assert all(bound_node(kube, n) for n in CARVE_VICTIM)
+        found = ledger_rec("carve-lo")
+        assert found is not None, "carve lost across the crash"
+        _node, rec = found
+        assert sorted(int(c) for c in rec.cells) == VICTIM_CELLS
+        # the re-commit re-opened the durable long-lived carve intent,
+        # exactly one (deduped by (gang, node))
+        carve_intents = j2.open_of_kind("carve")
+        assert len(carve_intents) == 1
+        assert str(carve_intents[0].data.get("gang")) == "carve-lo"
+        # a second replay over the settled journal changes nothing
+        before = canonical_ledger()
+        rec2 = RecoveryController(cluster.kube, cluster.provider, j2)
+        stats2 = rec2.run()
+        assert stats2["errors"] == 0
+        assert canonical_ledger() == before
+        assert len(j2.open_of_kind("carve")) == 1
+        j2.close_journal()
+
     def test_torn_tail_inside_carve_record(self, tmp_path):
         """A crash tearing the tail bytes of a carve open record: replay
         drops exactly that record (CRC framing), counts it, rebuilds the

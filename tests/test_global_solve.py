@@ -59,6 +59,17 @@ MIRROR = GlobalConfig(device_min_cells=1 << 30)
 FORCE_DEVICE = GlobalConfig(device_min_cells=0)
 
 
+@pytest.fixture(autouse=True)
+def fresh_support_controller():
+    """Every test starts at the strict corner: the adaptive support
+    controller is process-global and learns across windows, which is the
+    point in production and cross-test noise here."""
+    from karpenter_tpu.ops.global_solve import SUPPORT
+    SUPPORT.reset()
+    yield
+    SUPPORT.reset()
+
+
 @pytest.fixture()
 def fresh_watchdog(monkeypatch):
     wd = solve_mod._DeviceWatchdog()
@@ -402,7 +413,7 @@ class TestWidenedSupportRetry:
         # retry must recover the accepts the strict threshold would have
         # taken, through the SAME cheaper/verify gates
         monkeypatch.setattr(global_solve, "support_positions",
-                            lambda n, t: [])
+                            lambda n, t, *thr: [])
         before = self._widened_total()
         accepted = 0
         for seed in SEEDS:
@@ -425,7 +436,7 @@ class TestWidenedSupportRetry:
 
     def test_decline_parity_when_widened_also_fails(self, monkeypatch):
         monkeypatch.setattr(global_solve, "support_positions",
-                            lambda n, t: [])
+                            lambda n, t, *thr: [])
         monkeypatch.setattr(global_solve, "widened_support_positions",
                             lambda n, t: [])
         before = self._widened_total()
@@ -436,3 +447,87 @@ class TestWidenedSupportRetry:
         assert all(i.reason == "fallback-no-support" and not i.widened
                    for i in plan.infos)
         assert self._widened_total() == before
+
+
+class TestAdaptiveSupportThreshold:
+    """ISSUE 20 satellite: the fixed ``max(0.4, 0.02 x max n)`` keep rule
+    is now the strict corner of an acceptance-rate-driven EWMA
+    interpolation toward the widened corner. Seeded at rate 1.0 the rule
+    is bit-for-bit the hand-tuned one; sustained declines slide it
+    toward the widened thresholds; accepts tighten it back. The gauge
+    karpenter_global_support_threshold mirrors the absolute bar."""
+
+    def test_seeded_at_strict_corner(self):
+        from karpenter_tpu.ops.global_solve import (
+            STRICT_SUPPORT, SupportController)
+        c = SupportController()
+        assert c.thresholds() == STRICT_SUPPORT
+
+    def test_declines_widen_and_accepts_tighten(self):
+        from karpenter_tpu.ops.global_solve import (
+            STRICT_SUPPORT, WIDE_SUPPORT, SupportController)
+        c = SupportController()
+        for _ in range(200):
+            c.note(False)
+        a, r = c.thresholds()
+        assert a < STRICT_SUPPORT[0] and r < STRICT_SUPPORT[1]
+        # converges toward (never meaningfully past) the widened corner
+        assert a >= WIDE_SUPPORT[0] - 1e-9 and r >= WIDE_SUPPORT[1] - 1e-9
+        assert a == pytest.approx(WIDE_SUPPORT[0], abs=1e-6)
+        for _ in range(200):
+            c.note(True)
+        assert c.thresholds() == pytest.approx(STRICT_SUPPORT, abs=1e-6)
+
+    def test_interpolation_is_monotone_in_rate(self):
+        from karpenter_tpu.ops.global_solve import SupportController
+        c = SupportController()
+        bars = []
+        for _ in range(10):
+            bars.append(c.thresholds()[0])
+            c.note(False)
+        assert bars == sorted(bars, reverse=True)
+        assert len(set(bars)) == len(bars)
+
+    def test_widened_thresholds_keep_more_positions(self):
+        from karpenter_tpu.ops.global_solve import (
+            WIDE_SUPPORT, support_positions)
+        n = np.array([5.0, 0.3, 0.04, 0.0])
+        strict = support_positions(n, 4)
+        widened = support_positions(n, 4, *WIDE_SUPPORT)
+        assert set(strict) <= set(widened)
+        assert 1 in widened and 1 not in strict
+
+    def test_windows_drive_rate_and_gauge(self):
+        """End to end: solving real windows moves the EWMA off its seed
+        and publishes the in-force bar on the gauge."""
+        from karpenter_tpu.metrics.registry import DEFAULT as REGISTRY
+        from karpenter_tpu.ops.global_solve import STRICT_SUPPORT, SUPPORT
+        SUPPORT.reset()
+        # one type only → every schedule declines "costlier" (the
+        # restricted rounding can never beat full FFD), so each window
+        # drives the acceptance EWMA down deterministically
+        catalog = [mk_type("only", "8", "16Gi", 1.0)]
+        _, problems = random_window(3, n_scheds=3, catalog=catalog)
+        solve_window_global(problems, SolverConfig(), MIRROR)
+        assert SUPPORT.rate < 1.0
+        g = REGISTRY.gauge("global_support_threshold").collect()
+        bar = next(iter(g.values()))
+        assert 0.0 < bar <= STRICT_SUPPORT[0]
+
+    def test_adaptive_pass_still_exact_gated(self):
+        """With the controller pinned at the widened corner, every accept
+        still clears the strictly-cheaper + host-verify gates and every
+        plan conserves its pods — widening never trades exactness."""
+        from karpenter_tpu.ops.global_solve import SUPPORT
+        SUPPORT.rate = 0.0  # thresholds() == WIDE_SUPPORT
+        for seed in SEEDS:
+            _, problems = random_window(seed)
+            plan = solve_window_global(problems, SolverConfig(), MIRROR)
+            for info, result, problem in zip(plan.infos, plan.results,
+                                             problems):
+                if info.used:
+                    assert result is not None
+                    assert_conserved(result, problem.pods)
+                    assert info.relax_cost_micro < info.ffd_cost_micro
+                else:
+                    assert result is None

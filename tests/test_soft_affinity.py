@@ -1,0 +1,614 @@
+"""Topology-keyed pair planes + preferred (soft) pod-affinity scoring.
+
+ISSUE 20 contracts under test:
+
+- TOPOLOGY-KEYED INJECTION FUZZ: seeded windows (1/7/42, >=540 cases
+  total) of pods carrying required zone-/node-group-/hostname-keyed
+  (anti-)affinity run through AffinityGroups.inject; the final
+  assignment must satisfy the scalar ``LabelSelector.matches`` oracle on
+  every pair — co-located sets share one interned topology value drawn
+  from the provisioner vocabulary, anti pairs get distinct values,
+  impossible components shed with the unsat marker. Zero divergence.
+- PREFERRED-TERM SCORING FUZZ: fused windows with random zone vote maps
+  scored by ops/policy.score_fused_window must equal an independent
+  scalar oracle over raw offerings (exact int micro-$, same fixed
+  point) on every cell, with zero soft-affinity-mismatch fallbacks on
+  clean runs.
+- VERDICT IS A FILTER: a sabotaged device row on a soft window is caught
+  by the probe, counted as ``policy_fallback_total{reason=
+  "soft-affinity-mismatch"}``, and healed to the host mirror.
+- KILL SWITCH: KARPENTER_SOFT_AFFINITY=0 produces bit-for-bit the
+  no-preference rows, injects no votes, steers no launches, and prices
+  no consolidation loss.
+- CONSOLIDATION: a drain that scatters a preferred co-located set is
+  blocked exactly when its soft-affinity loss >= the price savings.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.core import (
+    Affinity, LabelSelector, PodAffinity, PodAffinityTerm,
+    WeightedPodAffinityTerm,
+)
+from karpenter_tpu.cloudprovider.fake.provider import instance_types
+from karpenter_tpu.controllers.provisioning import universe_constraints
+from karpenter_tpu.metrics.policy import POLICY_FALLBACK_TOTAL
+from karpenter_tpu.models.cost import CostConfig
+from karpenter_tpu.ops import device_filter
+from karpenter_tpu.ops import policy as ops_policy
+from karpenter_tpu.scheduling.affinity import AffinityGroups, soft_enabled
+from karpenter_tpu.solver import policy as policy_registry
+from karpenter_tpu.solver.adapter import marshal_pods_interned
+from karpenter_tpu.solver.batch_solve import Problem
+from karpenter_tpu.solver.policy import PolicyContext, soft_zone_votes
+from karpenter_tpu.solver.solve import (
+    SolverConfig, resolved_device_max_shapes,
+)
+from tests.test_pack_parity import make_pod
+from tests.test_policy import _catalog
+
+SEEDS = (1, 7, 42)
+ZONES = ("test-zone-1", "test-zone-2", "test-zone-3")
+_LBL_KEYS = ("app", "tier", "track")
+_LBL_VALS = ("web", "db", "cache", "batch", "canary")
+_TOPO_KEYS = (wellknown.LABEL_TOPOLOGY_ZONE, wellknown.LABEL_HOSTNAME)
+
+
+def _pod(name, labels, aff_terms=(), anti_terms=(), preferred=()):
+    p = make_pod({"cpu": "100m", "memory": "64Mi"})
+    p.metadata.name = name
+    p.metadata.namespace = "default"
+    p.metadata.labels = dict(labels)
+    if aff_terms or anti_terms or preferred:
+        aff = Affinity()
+        if aff_terms or preferred:
+            aff.pod_affinity = PodAffinity(
+                required=list(aff_terms),
+                preferred=[WeightedPodAffinityTerm(weight=w, term=t)
+                           for w, t in preferred])
+        if anti_terms:
+            aff.pod_anti_affinity = PodAffinity(required=list(anti_terms))
+        p.spec.affinity = aff
+    return p
+
+
+def _rand_term(rng):
+    key = rng.choice(_TOPO_KEYS)
+    sel = LabelSelector(match_labels={
+        rng.choice(_LBL_KEYS): rng.choice(_LBL_VALS)})
+    return PodAffinityTerm(topology_key=key, label_selector=sel)
+
+
+def _rand_window(rng):
+    pods = []
+    for i in range(rng.randint(3, 9)):
+        labels = {k: rng.choice(_LBL_VALS)
+                  for k in rng.sample(_LBL_KEYS, rng.randint(1, 2))}
+        aff, anti = [], []
+        roll = rng.random()
+        if roll < 0.45:
+            aff.append(_rand_term(rng))
+        elif roll < 0.75:
+            anti.append(_rand_term(rng))
+        if rng.random() < 0.15:
+            anti.append(_rand_term(rng))
+        pods.append(_pod(f"p{i}", labels, aff, anti))
+    return pods
+
+
+def _required_of(pod, anti):
+    aff = getattr(pod.spec, "affinity", None)
+    side = getattr(aff, "pod_anti_affinity" if anti else "pod_affinity",
+                   None) if aff else None
+    return [t for t in (getattr(side, "required", None) or [])
+            if t.topology_key and t.label_selector is not None]
+
+
+class TestTopologyKeyedInjectionFuzz:
+    """Seeded fuzz of the full injection path: the final (value or unsat)
+    assignment per pod must satisfy the scalar matches() oracle."""
+
+    def _constraints(self):
+        return universe_constraints(instance_types(5))
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_assignment_satisfies_scalar_oracle(self, seed):
+        rng = random.Random(seed)
+        cases = 180
+        for _ in range(cases):
+            cons = self._constraints()
+            pods = _rand_window(rng)
+            AffinityGroups().inject(cons, pods)
+            unsat = {id(p) for p in pods
+                     if p.__dict__.get("_affinity_unsat")}
+            for p in pods:
+                if id(p) in unsat:
+                    # the unsat marker must shed at validation: hostname
+                    # pinned to a value outside every vocabulary
+                    assert p.spec.node_selector.get(
+                        wellknown.LABEL_HOSTNAME) == ""
+                    continue
+                for term in _required_of(p, anti=False):
+                    v = p.spec.node_selector.get(term.topology_key)
+                    if v is not None and \
+                            term.topology_key != wellknown.LABEL_HOSTNAME:
+                        vocab = cons.requirements.requirement(
+                            term.topology_key)
+                        assert vocab is not None and v in vocab, \
+                            f"{v!r} not in provisioner vocabulary"
+                    # every matching live peer shares the domain value
+                    # (singletons with only a self-anchor keep no pin)
+                    for q in pods:
+                        if q is p or id(q) in unsat:
+                            continue
+                        if q.metadata.namespace != p.metadata.namespace:
+                            continue
+                        if term.label_selector.matches(q.metadata.labels):
+                            assert v is not None, (
+                                f"{p.metadata.name} has live matching peer "
+                                f"{q.metadata.name} but no "
+                                f"{term.topology_key} pin")
+                            assert q.spec.node_selector.get(
+                                term.topology_key) == v, (
+                                f"{p.metadata.name} and matching peer "
+                                f"{q.metadata.name} split across "
+                                f"{term.topology_key} domains")
+                for term in _required_of(p, anti=True):
+                    v = p.spec.node_selector.get(term.topology_key)
+                    for q in pods:
+                        if q is p or id(q) in unsat:
+                            continue
+                        if q.metadata.namespace != p.metadata.namespace:
+                            continue
+                        if term.label_selector.matches(q.metadata.labels):
+                            qv = q.spec.node_selector.get(term.topology_key)
+                            assert v and qv and v != qv, (
+                                f"anti pair {p.metadata.name}/"
+                                f"{q.metadata.name} shares domain {v!r}")
+
+    def test_zone_vocabulary_never_invented(self):
+        """Valued-key domains are interned values, never fresh tokens:
+        every injected zone comes from the provisioner requirement."""
+        rng = random.Random(42)
+        for _ in range(60):
+            cons = self._constraints()
+            pods = _rand_window(rng)
+            AffinityGroups().inject(cons, pods)
+            vocab = cons.requirements.requirement(
+                wellknown.LABEL_TOPOLOGY_ZONE)
+            for p in pods:
+                v = p.spec.node_selector.get(wellknown.LABEL_TOPOLOGY_ZONE)
+                if v is not None and not p.__dict__.get("_affinity_unsat"):
+                    assert v in vocab
+
+    def test_node_group_key_uses_provisioner_vocabulary(self):
+        from karpenter_tpu.api.core import NodeSelectorRequirement as Req
+        cons = self._constraints()
+        cons.requirements = cons.requirements.add(Req(
+            key=wellknown.LABEL_NODE_GROUP, operator="In",
+            values=["pool-a", "pool-b"]))
+        sel = LabelSelector(match_labels={"app": "web"})
+        term = PodAffinityTerm(topology_key=wellknown.LABEL_NODE_GROUP,
+                               label_selector=sel)
+        a = _pod("a", {"app": "web"}, aff_terms=[term])
+        b = _pod("b", {"app": "web"})
+        AffinityGroups().inject(cons, [a, b])
+        va = a.spec.node_selector.get(wellknown.LABEL_NODE_GROUP)
+        vb = b.spec.node_selector.get(wellknown.LABEL_NODE_GROUP)
+        assert va == vb and va in ("pool-a", "pool-b")
+
+    def test_no_vocabulary_sheds(self):
+        # a topology key the provisioner has no requirement for cannot
+        # host a domain: the component sheds instead of inventing values
+        sel = LabelSelector(match_labels={"app": "web"})
+        term = PodAffinityTerm(topology_key="example.com/unheard-of",
+                               label_selector=sel)
+        cons = self._constraints()
+        a = _pod("a", {"app": "web"}, aff_terms=[term])
+        b = _pod("b", {"app": "web"})
+        AffinityGroups().inject(cons, [a, b])
+        assert a.__dict__.get("_affinity_unsat")
+        assert b.__dict__.get("_affinity_unsat")
+        assert a.spec.node_selector.get(wellknown.LABEL_HOSTNAME) == ""
+
+
+def _soft_oracle_row(it, reqs, votes, ctx, cost_config, use_soft):
+    """Independent scalar score of one type: min over allowed offerings
+    of sat(micro(price_ct) + min-over-viable-zones clamp(-w x scale)),
+    floored at 0 — the device kernel's contract, from raw offerings."""
+    zones = reqs.zones()
+    cts = reqs.capacity_types()
+    scale = int(round(ctx.soft_affinity_cost_per_weight * 1e6))
+    imax = int(ops_policy._INT32_MAX)
+    clamp = ops_policy._SOFT_CLAMP
+    best = imax
+    for ct in {o.capacity_type for o in it.offerings}:
+        if cts is not None and ct not in cts:
+            continue
+        viable = [o.zone for o in it.offerings
+                  if o.capacity_type == ct
+                  and (zones is None or o.zone in zones)]
+        if not viable:
+            continue
+        base = it.price * cost_config.spot_price_factor \
+            if ct == wellknown.CAPACITY_TYPE_SPOT else it.price
+        cell = int(ops_policy._encode_micro(base))
+        if use_soft:
+            adj = min(max(-clamp, min(-votes.get(z, 0) * scale, clamp))
+                      for z in viable)
+            cell = max(0, min(cell + adj, imax))
+        best = min(best, cell)
+    return best
+
+
+def _soft_problems(catalog, seed, n=4):
+    """Problems mixing pinned and open zones, each with a random (possibly
+    empty) zone vote map riding Problem.soft_affinity."""
+    from karpenter_tpu.api.core import NodeSelectorRequirement as Req
+    rng = random.Random(seed)
+    constraints = universe_constraints(catalog)
+    zones = sorted({o.zone for it in catalog for o in it.offerings})
+    problems = []
+    for b in range(n):
+        tightened = constraints.deepcopy()
+        if rng.random() < 0.5:
+            tightened.requirements = tightened.requirements.add(Req(
+                key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In",
+                values=[rng.choice(zones)]))
+        pods = []
+        for j in range(rng.randint(30, 80)):
+            pods.append(make_pod({
+                "cpu": f"{rng.choice([100, 250, 500])}m",
+                "memory": f"{rng.choice([128, 512])}Mi"}))
+            pods[-1].metadata.name = f"p{b}-{j}"
+        soft = None
+        if rng.random() < 0.75:
+            soft = {(wellknown.LABEL_TOPOLOGY_ZONE, z):
+                    rng.choice([-100, -7, 1, 42, 100])
+                    for z in rng.sample(zones, rng.randint(1, len(zones)))}
+        problems.append(Problem(constraints=tightened, pods=pods,
+                                instance_types=catalog,
+                                soft_affinity=soft))
+    return problems
+
+
+def _fused(problems, config):
+    marshaled = [marshal_pods_interned(p.pods) for p in problems]
+    return device_filter.prepare_fused(
+        problems, marshaled, config, resolved_device_max_shapes(config))
+
+
+class TestPreferredScoringFuzz:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_rows_match_scalar_oracle(self, seed):
+        """score_fused_window with soft votes vs the independent scalar
+        oracle: exact int equality on every (member, type) cell, zero
+        soft-affinity-mismatch fallbacks burned."""
+        catalog = _catalog(seed=seed)
+        config = SolverConfig(device_min_pods=1)
+        problems = _soft_problems(catalog, seed)
+        fused = _fused(problems, config)
+        if fused is None:
+            pytest.skip("no device backend for the fused window")
+        mm_key = (("reason", "soft-affinity-mismatch"),)
+        before = POLICY_FALLBACK_TOTAL.collect().get(mm_key, 0.0)
+        try:
+            ctx = PolicyContext(soft_affinity_cost_per_weight=0.001)
+            policy = policy_registry.get("cheapest")
+            rows = ops_policy.score_fused_window(
+                fused, policy, config.cost_config, ctx)
+            assert rows is not None
+            use_soft = soft_enabled() and any(
+                any(soft_zone_votes(s).values())
+                for s in fused.soft if s is not None)
+            assert use_soft, "fuzz window carried no usable votes"
+            div = 0
+            for b, i in enumerate(fused.batch_idx):
+                reqs = problems[i].constraints.requirements
+                votes = soft_zone_votes(problems[i].soft_affinity)
+                for k, p in enumerate(fused.packables):
+                    want = _soft_oracle_row(
+                        fused.uni_types[p.index], reqs, votes, ctx,
+                        config.cost_config, use_soft)
+                    div += int(int(rows[b][k]) != want)
+            assert div == 0, f"{div} cells diverged from the scalar oracle"
+        finally:
+            fused.release()
+        assert POLICY_FALLBACK_TOTAL.collect().get(mm_key, 0.0) == before
+
+    def test_zero_weight_context_is_bit_for_bit_plain(self):
+        """soft_affinity_cost_per_weight=0 disables pricing entirely: the
+        rows equal the no-votes rows exactly (weight-0 fixed point)."""
+        catalog = _catalog(seed=1)
+        config = SolverConfig(device_min_pods=1)
+        problems = _soft_problems(catalog, 1)
+        plain = [Problem(constraints=p.constraints, pods=p.pods,
+                         instance_types=p.instance_types)
+                 for p in problems]
+        fused_soft = _fused(problems, config)
+        fused_plain = _fused(plain, config)
+        if fused_soft is None or fused_plain is None:
+            pytest.skip("no device backend for the fused window")
+        try:
+            policy = policy_registry.get("cheapest")
+            zero = PolicyContext(soft_affinity_cost_per_weight=0.0)
+            on = ops_policy.score_fused_window(
+                fused_soft, policy, config.cost_config, zero)
+            off = ops_policy.score_fused_window(
+                fused_plain, policy, config.cost_config, zero)
+            assert on is not None and off is not None
+            for a, b in zip(on, off):
+                assert np.array_equal(a, b)
+        finally:
+            fused_soft.release()
+            fused_plain.release()
+
+
+class TestSoftSabotageSelfHeal:
+    def test_sabotaged_soft_rows_heal_to_host_mirror(self, monkeypatch):
+        """A corrupted device verdict on a soft window must not survive:
+        the probe condemns the member as soft-affinity-mismatch and the
+        returned row is the host mirror's (which the fuzz pins to the
+        scalar oracle)."""
+        catalog = _catalog(seed=7)
+        config = SolverConfig(device_min_pods=1)
+        problems = _soft_problems(catalog, 7)
+        # every member votes, so every condemned member counts as a
+        # soft-affinity (not plain score) mismatch
+        zones = sorted({o.zone for it in catalog for o in it.offerings})
+        for p in problems:
+            p.soft_affinity = {(wellknown.LABEL_TOPOLOGY_ZONE, zones[0]): 50}
+        fused = _fused(problems, config)
+        if fused is None:
+            pytest.skip("no device backend for the fused window")
+
+        real = ops_policy._score_jit
+
+        def sabotaged(spot_idx, use_pen, use_soft=False):
+            fn = real(spot_idx, use_pen, use_soft)
+
+            def wrapper(*args):
+                best, ncells = fn(*args)
+                # off-by-one on every cell: any probed column sees it
+                return np.asarray(best) + np.int32(1), ncells
+
+            return wrapper
+
+        monkeypatch.setattr(ops_policy, "_score_jit", sabotaged)
+        mm_key = (("reason", "soft-affinity-mismatch"),)
+        before = POLICY_FALLBACK_TOTAL.collect().get(mm_key, 0.0)
+        try:
+            ctx = PolicyContext(soft_affinity_cost_per_weight=0.001)
+            policy = policy_registry.get("cheapest")
+            rows = ops_policy.score_fused_window(
+                fused, policy, config.cost_config, ctx)
+            assert rows is not None
+            after = POLICY_FALLBACK_TOTAL.collect().get(mm_key, 0.0)
+            assert after == before + len(fused.batch_idx), \
+                "sabotage not condemned on every member"
+            # healed rows equal the scalar oracle
+            for b, i in enumerate(fused.batch_idx):
+                reqs = problems[i].constraints.requirements
+                votes = soft_zone_votes(problems[i].soft_affinity)
+                for k, p in enumerate(fused.packables):
+                    want = _soft_oracle_row(
+                        fused.uni_types[p.index], reqs, votes, ctx,
+                        config.cost_config, True)
+                    assert int(rows[b][k]) == want
+        finally:
+            fused.release()
+
+
+class TestSoftKillSwitch:
+    def test_kill_switch_rows_bit_for_bit_plain(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOFT_AFFINITY", "0")
+        assert not soft_enabled()
+        catalog = _catalog(seed=42)
+        config = SolverConfig(device_min_pods=1)
+        problems = _soft_problems(catalog, 42)
+        plain = [Problem(constraints=p.constraints, pods=p.pods,
+                         instance_types=p.instance_types)
+                 for p in problems]
+        fused_soft = _fused(problems, config)
+        fused_plain = _fused(plain, config)
+        if fused_soft is None or fused_plain is None:
+            pytest.skip("no device backend for the fused window")
+        try:
+            ctx = PolicyContext(soft_affinity_cost_per_weight=0.001)
+            policy = policy_registry.get("cheapest")
+            on = ops_policy.score_fused_window(
+                fused_soft, policy, config.cost_config, ctx)
+            off = ops_policy.score_fused_window(
+                fused_plain, policy, config.cost_config, ctx)
+            assert on is not None and off is not None
+            for a, b in zip(on, off):
+                assert np.array_equal(a, b)
+        finally:
+            fused_soft.release()
+            fused_plain.release()
+
+    def test_kill_switch_injects_no_votes(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOFT_AFFINITY", "0")
+        sel = LabelSelector(match_labels={"app": "db"})
+        term = PodAffinityTerm(
+            topology_key=wellknown.LABEL_TOPOLOGY_ZONE, label_selector=sel)
+        a = _pod("a", {"app": "web"}, preferred=[(50, term)])
+        b = _pod("b", {"app": "db"})
+        b.spec.node_selector = {wellknown.LABEL_TOPOLOGY_ZONE: ZONES[0]}
+        cons = universe_constraints(instance_types(5))
+        AffinityGroups().inject(cons, [a, b])
+        assert a.__dict__.get("_soft_affinity") is None
+
+    def test_kill_switch_steers_nothing(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOFT_AFFINITY", "0")
+        catalog = _catalog(seed=1)
+        cons = universe_constraints(catalog)
+        soft = {(wellknown.LABEL_TOPOLOGY_ZONE, "zone-1"): 100}
+        assert ops_policy.steer_zone(
+            catalog, cons.requirements, CostConfig(),
+            PolicyContext(), soft) is None
+
+    def test_kill_switch_prices_no_loss(self, monkeypatch):
+        from karpenter_tpu.ops.whatif import soft_affinity_loss
+        monkeypatch.setenv("KARPENTER_SOFT_AFFINITY", "0")
+        sel = LabelSelector(match_labels={"app": "db"})
+        term = PodAffinityTerm(topology_key=wellknown.LABEL_HOSTNAME,
+                               label_selector=sel)
+        a = _pod("a", {"app": "web"}, preferred=[(100, term)])
+        b = _pod("b", {"app": "db"})
+        from tests.test_consolidation import priced_catalog, running_node
+        node = running_node("n1", priced_catalog()[0])
+        assert soft_affinity_loss(node, [a], [node],
+                                  {"n1": [a, b]}, 0.01) == 0.0
+
+
+class TestSteerZone:
+    def test_positive_vote_steers_to_voted_zone(self):
+        catalog = _catalog(seed=1)
+        cons = universe_constraints(catalog)
+        soft = {(wellknown.LABEL_TOPOLOGY_ZONE, "zone-2"): 100}
+        z = ops_policy.steer_zone(catalog, cons.requirements, CostConfig(),
+                                  PolicyContext(), soft)
+        assert z == "zone-2"
+
+    def test_pinned_zone_is_never_steered(self):
+        from karpenter_tpu.api.core import NodeSelectorRequirement as Req
+        catalog = _catalog(seed=1)
+        cons = universe_constraints(catalog)
+        reqs = cons.requirements.add(Req(
+            key=wellknown.LABEL_TOPOLOGY_ZONE, operator="In",
+            values=["zone-1"]))
+        soft = {(wellknown.LABEL_TOPOLOGY_ZONE, "zone-2"): 100}
+        assert ops_policy.steer_zone(catalog, reqs, CostConfig(),
+                                     PolicyContext(), soft) is None
+
+    def test_saturated_tie_resolves_to_voted_zone(self):
+        # price-0 catalog: every offering encodes to micro-$ 0 and the
+        # saturation floor erases the vote discount — all zones tie at
+        # total 0. The tie must land on the voted zone, not the
+        # alphabetically-first one (the e2e regression: web followers
+        # steered to test-zone-1 while their anchors sat in test-zone-2).
+        catalog = instance_types(5)
+        assert all(it.price == 0.0 for it in catalog)
+        cons = universe_constraints(catalog)
+        soft = {(wellknown.LABEL_TOPOLOGY_ZONE, "test-zone-2"): 80}
+        z = ops_policy.steer_zone(catalog, cons.requirements, CostConfig(),
+                                  PolicyContext(), soft)
+        assert z == "test-zone-2"
+
+    def test_irrelevant_votes_do_not_narrow(self):
+        catalog = _catalog(seed=1)
+        cons = universe_constraints(catalog)
+        soft = {(wellknown.LABEL_TOPOLOGY_ZONE, "nowhere-zone"): 100}
+        assert ops_policy.steer_zone(catalog, cons.requirements,
+                                     CostConfig(), PolicyContext(),
+                                     soft) is None
+
+
+class TestConsolidationSoftBlock:
+    """A drain that scatters a preferred co-located set pays its
+    soft-affinity loss out of the savings — and is blocked entirely when
+    the loss meets or beats them."""
+
+    def _env(self, cost_per_weight):
+        from karpenter_tpu.cloudprovider.fake.provider import (
+            FakeCloudProvider,
+        )
+        from karpenter_tpu.controllers.consolidation import (
+            ConsolidationController,
+        )
+        from karpenter_tpu.runtime.kubecore import KubeCore
+        from tests.expectations import make_provisioner
+        from tests.test_consolidation import (
+            priced_catalog, running_node, running_pod,
+        )
+        kube = KubeCore()
+        catalog = priced_catalog()
+        provider = FakeCloudProvider(catalog=catalog)
+        provisioner = make_provisioner(
+            constraints=universe_constraints(catalog),
+            consolidation_enabled=True)
+        kube.create(provisioner)
+        # node-0 is the priciest node in the fleet so every greedy leg
+        # ranks it first — unless the soft-affinity loss filters it out
+        for i, it in enumerate((catalog[2], catalog[1], catalog[1])):
+            node = running_node(f"node-{i}", it)
+            node.metadata.finalizers.append(wellknown.TERMINATION_FINALIZER)
+            kube.create(node)
+        # node-0: the preferred co-located pair (app=web wants app=db on
+        # the same host, weight 100); survivors carry filler load
+        sel = LabelSelector(match_labels={"app": "db"})
+        term = PodAffinityTerm(topology_key=wellknown.LABEL_HOSTNAME,
+                               label_selector=sel)
+        web = running_pod("web-0", cpu="500m")
+        web.metadata.labels = {"app": "web"}
+        web.spec.affinity = Affinity(pod_affinity=PodAffinity(
+            preferred=[WeightedPodAffinityTerm(weight=100, term=term)]))
+        db = running_pod("db-0", cpu="500m")
+        db.metadata.labels = {"app": "db"}
+        for pod in (web, db):
+            kube.create(pod)
+            kube.bind_pod(pod, "node-0")
+        for i in (1, 2):
+            for j in range(3):
+                pod = running_pod(f"pod-{i}-{j}", cpu="500m")
+                kube.create(pod)
+                kube.bind_pod(pod, f"node-{i}")
+        controller = ConsolidationController(
+            kube, provider=provider,
+            soft_affinity_cost_per_weight=cost_per_weight)
+        return kube, controller
+
+    def test_loss_above_savings_blocks_drain(self):
+        from karpenter_tpu.metrics.policy import (
+            SOFT_AFFINITY_BLOCKED_DRAINS_TOTAL,
+        )
+        # loss = 100 x 0.01 = $1.00/h >= large's $0.40/h: blocked
+        kube, controller = self._env(cost_per_weight=0.01)
+        before = sum(SOFT_AFFINITY_BLOCKED_DRAINS_TOTAL.collect().values())
+        controller.reconcile("default")
+        assert kube.get("Node", "node-0", "").metadata.deletion_timestamp \
+            is None, "drain scattered a co-located set it couldn't pay for"
+        after = sum(SOFT_AFFINITY_BLOCKED_DRAINS_TOTAL.collect().values())
+        assert after == before + 1
+
+    def test_loss_below_savings_drains_with_netted_savings(self):
+        # loss = 100 x 0.0001 = $0.01/h < $0.40/h: the drain proceeds
+        kube, controller = self._env(cost_per_weight=0.0001)
+        controller.reconcile("default")
+        assert kube.get("Node", "node-0", "").metadata.deletion_timestamp \
+            is not None
+
+    def test_zone_scattering_also_priced(self):
+        """A zone-keyed preferred term is satisfied by a peer on ANY node
+        in the zone — draining the pod's node still forfeits nothing only
+        if the pod can re-land in-zone; the loss oracle counts it."""
+        from karpenter_tpu.ops.whatif import soft_affinity_loss
+        from tests.test_consolidation import (
+            priced_catalog, running_node, running_pod,
+        )
+        catalog = priced_catalog()
+        n0 = running_node("n0", catalog[0])
+        n1 = running_node("n1", catalog[0])  # same test-zone-1
+        sel = LabelSelector(match_labels={"app": "db"})
+        term = PodAffinityTerm(
+            topology_key=wellknown.LABEL_TOPOLOGY_ZONE, label_selector=sel)
+        web = running_pod("web", cpu="250m")
+        web.metadata.labels = {"app": "web"}
+        web.spec.affinity = Affinity(pod_affinity=PodAffinity(
+            preferred=[WeightedPodAffinityTerm(weight=40, term=term)]))
+        db = running_pod("db", cpu="250m")
+        db.metadata.labels = {"app": "db"}
+        loss = soft_affinity_loss(
+            n0, [web], [n0, n1], {"n0": [web], "n1": [db]}, 0.001)
+        assert loss == pytest.approx(40 * 0.001)
+        # no matching peer in the domain -> nothing to forfeit
+        db.metadata.labels = {"app": "cache"}
+        assert soft_affinity_loss(
+            n0, [web], [n0, n1], {"n0": [web], "n1": [db]}, 0.001) == 0.0

@@ -1,0 +1,86 @@
+"""Soft-affinity verdict: one human-readable line from the bench JSON.
+
+`make bench-affinity` pipes bench.py (``--only config_18``) through this
+filter. The bench line passes through UNCHANGED on stdout (so
+`> BENCH_rNN.json` redirects still capture the pure JSON); the verdict
+goes to stderr:
+
+    soft affinity: 24 cohorts x 400 types, co-location 2.0x vs soft-off \
+(24/12) at 0.0% node regression, device soft scoring 51.3x vs per-cell \
+host loop, row_divergence=0, unverified=0 — PASS
+
+PASS needs (the round-16 acceptance gate):
+- co-located cohorts >= 2x the KARPENTER_SOFT_AFFINITY=0 leg — the
+  preferred-term votes actually steer follower launches onto their
+  anchors' zones;
+- node-count regression <= 1%: zone steering narrows offerings, it must
+  never inflate the fleet;
+- device soft scoring >= 5x the per-cell host loop computing the same
+  exact-int algebra (micro-$ base + clamp(-w x scale), min over viable
+  zones), with the probe re-verification timed INSIDE the device leg;
+- zero row divergence between the device rows and the host loop, and
+  zero unverified placements: no score-mismatch or
+  soft-affinity-mismatch fallback fired anywhere in the run.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+GATE_SPEEDUP = 5.0
+GATE_COLOC = 2.0
+GATE_REGRESSION_PCT = 1.0
+
+
+def verdict(line: dict) -> str:
+    extra = line.get("extra", {})
+    cfg = extra.get("config_18_soft_affinity", {})
+    if "error" in cfg or "speedup" not in cfg:
+        return ("soft affinity: no config_18_soft_affinity in bench line "
+                f"({cfg.get('error', cfg.get('skipped', 'config_18 not run'))})"
+                " — NO VERDICT")
+    speedup = cfg.get("speedup")
+    gain = cfg.get("coloc_gain")
+    reg = cfg.get("node_regression_pct")
+    div = cfg.get("row_divergence")
+    unverified = cfg.get("unverified")
+    head = (f"soft affinity: {cfg.get('cohorts')} cohorts x "
+            f"{cfg.get('types')} types, co-location {gain}x vs soft-off "
+            f"({cfg.get('coloc_on')}/{cfg.get('coloc_off')}) at {reg}% "
+            f"node regression, device soft scoring {speedup}x vs per-cell "
+            f"host loop, row_divergence={div}, unverified={unverified}")
+    ok = (speedup is not None and speedup >= GATE_SPEEDUP
+          and gain is not None and gain >= GATE_COLOC
+          and reg is not None and reg <= GATE_REGRESSION_PCT
+          and div == 0 and unverified == 0)
+    return (f"{head} — {'PASS' if ok else 'FAIL'} "
+            f"(gate >={GATE_COLOC}x co-location at <={GATE_REGRESSION_PCT}% "
+            f"regression, >={GATE_SPEEDUP}x kernel, 0 divergence, "
+            "0 unverified)")
+
+
+def main() -> int:
+    last = None
+    for raw in sys.stdin:
+        sys.stdout.write(raw)  # pass-through: stdout stays the pure JSON
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            line = json.loads(raw)
+            if isinstance(line, dict) and "metric" in line:
+                last = line
+        except ValueError:
+            continue
+    sys.stdout.flush()
+    if last is None:
+        print("soft affinity: no bench JSON line on stdin — NO VERDICT",
+              file=sys.stderr)
+        return 1
+    print(verdict(last), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,6 +106,12 @@ SERIES = [
     ("ledger_recovery_p99_ms",
      lambda l: _dig(l, "extra", "config_17_carve_journal", "recovery",
                     "wall_ms", "p99_ms"), "lower", 2.00),
+    ("soft_affinity_coloc_gain",
+     lambda l: _dig(l, "extra", "config_18_soft_affinity", "coloc_gain"),
+     "higher", 0.30),
+    ("soft_affinity_speedup",
+     lambda l: _dig(l, "extra", "config_18_soft_affinity", "speedup"),
+     "higher", 0.30),
 ]
 
 # (name, extractor(line) -> bool|None): latest non-None entry must be True
@@ -194,6 +200,18 @@ FLAGS = [
                          "recovery", "errors") == 0
                 and _dig(l, "extra", "config_17_carve_journal",
                          "non_carve_open_after") == 0)),
+    # the soft-row filter contract: device rows equal the host loop's
+    # exact-int algebra cell for cell, no probe fallback fired, and zone
+    # steering never inflated the fleet past the 1% gate
+    ("soft_affinity_clean",
+     lambda l: (None if _dig(l, "extra", "config_18_soft_affinity",
+                             "row_divergence") is None
+                else _dig(l, "extra", "config_18_soft_affinity",
+                          "row_divergence") == 0
+                and _dig(l, "extra", "config_18_soft_affinity",
+                         "unverified") == 0
+                and (_dig(l, "extra", "config_18_soft_affinity",
+                          "node_regression_pct") or 0.0) <= 1.0)),
 ]
 
 
